@@ -1,0 +1,40 @@
+"""crdt_tpu — a TPU-native CRDT framework.
+
+A brand-new JAX/XLA/Pallas implementation of a hybrid-logical-clock,
+last-writer-wins map CRDT with delta sync, matching the capabilities of
+the reference Dart package (siliconsorcery/crdt v4.0.2) with a TPU-first
+architecture:
+
+- Scalar host path (`Hlc`, `MapCrdt`) — the semantic oracle, matching
+  the reference's behavior including golden wire strings.
+- TPU path (`TpuMapCrdt`, `crdt_tpu.ops`) — HLCs packed into sortable
+  (int64 logical_time, int32 node-ordinal) lanes; merge is a batched
+  vectorized lattice join; multi-replica fan-in is a segmented
+  lexicographic max reduction.
+- Parallel path (`crdt_tpu.parallel`, in progress) — key-space sharding
+  over a `jax.sharding.Mesh` with replica fan-in collectives over
+  ICI/DCN.
+
+Barrel export mirrors the reference's `lib/crdt.dart`.
+"""
+
+from .hlc import (Hlc, ClockDriftException, DuplicateNodeException,
+                  OverflowException, MAX_COUNTER, MAX_DRIFT,
+                  wall_clock_millis)
+from .record import (Record, KeyDecoder, KeyEncoder, NodeIdDecoder,
+                     ValueDecoder, ValueEncoder)
+from .crdt import Crdt
+from .crdt_json import CrdtJson, dart_str
+from .watch import ChangeEvent, ChangeStream
+from .models.map_crdt import MapCrdt
+from .models.tpu_map_crdt import TpuMapCrdt
+
+__version__ = "0.1.0"
+
+__all__ = [
+    "Hlc", "ClockDriftException", "DuplicateNodeException",
+    "OverflowException", "MAX_COUNTER", "MAX_DRIFT", "wall_clock_millis",
+    "Record", "KeyDecoder", "KeyEncoder", "NodeIdDecoder", "ValueDecoder",
+    "ValueEncoder", "Crdt", "CrdtJson", "dart_str", "ChangeEvent",
+    "ChangeStream", "MapCrdt", "TpuMapCrdt",
+]

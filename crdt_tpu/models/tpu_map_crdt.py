@@ -1,0 +1,265 @@
+"""Device-resident columnar CRDT backend — the TPU execution path.
+
+Drop-in `Crdt` subclass (the reference's plugin pattern, README.md:39)
+whose record store lives in HBM as structure-of-arrays lanes
+(``crdt_tpu.ops.merge.Store``); `merge` is the fused batched
+lattice-join `merge_step` instead of the reference's sequential
+per-record loop (crdt.dart:77-94 → SURVEY.md §3.3/§7).
+
+Division of labor:
+
+- **Device**: HLC lanes, LWW compare, clock absorption, delta masks,
+  canonical-time reduction.
+- **Host**: key <-> slot assignment, node-id interning (order-preserving
+  ordinals), variable-length payloads (values never enter the
+  reduction), wall-clock reads, exception raising from reduced guard
+  masks, and `watch` events (emitted after kernel writes land —
+  reactivity never lives in jit).
+
+For dense-array workloads (the benchmark path) use
+`merge_changeset_arrays` to bypass per-record host encoding entirely.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, List, Optional, Sequence, TypeVar
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from ..crdt import Crdt
+from ..hlc import (ClockDriftException, DuplicateNodeException, Hlc, SHIFT)
+from ..record import Record
+from ..watch import ChangeHub, ChangeStream
+from ..ops.merge import (Changeset, Store, delta_mask, empty_store,
+                         grow_store, max_logical_time, merge_step,
+                         scatter_put)
+from ..ops.packing import NodeTable
+
+K = TypeVar("K")
+V = TypeVar("V")
+
+_MIN_CAPACITY = 8
+
+
+def _next_pow2(n: int) -> int:
+    return 1 << max(n - 1, 1).bit_length() if n > 2 else max(n, _MIN_CAPACITY)
+
+
+class TpuMapCrdt(Crdt[K, V]):
+    """LWW-map CRDT with a device-columnar record store."""
+
+    def __init__(self, node_id: Any,
+                 seed: Optional[Dict[K, Record[V]]] = None,
+                 wall_clock: Optional[Callable[[], int]] = None,
+                 capacity: int = _MIN_CAPACITY):
+        self._node_id = node_id
+        self._table = NodeTable([node_id])
+        self._store: Store = empty_store(max(capacity, _MIN_CAPACITY))
+        self._key_to_slot: Dict[K, int] = {}
+        self._slot_keys: List[K] = []       # slot -> key, insertion order
+        self._payload: List[Any] = []       # slot -> value (None = tombstone)
+        self._hub = ChangeHub()
+        if seed:
+            # Seed lands before the canonical clock is derived, so
+            # refresh_canonical_time absorbs it (map_crdt.dart:16-18 +
+            # crdt.dart:31-33).
+            self.put_records(dict(seed))
+        super().__init__(wall_clock=wall_clock)
+
+    # --- host bookkeeping ---
+
+    @property
+    def node_id(self) -> Any:
+        return self._node_id
+
+    def _my_ordinal(self) -> int:
+        return self._table.ordinal(self._node_id)
+
+    def _intern_nodes(self, node_ids: Sequence[Any]) -> None:
+        remap = self._table.intern(node_ids)
+        if remap is not None:
+            remap_dev = jnp.asarray(remap)
+            self._store = self._store._replace(
+                node=remap_dev[self._store.node],
+                mod_node=remap_dev[self._store.mod_node])
+
+    def _ensure_slots(self, keys: Sequence[K]) -> np.ndarray:
+        slots = np.empty(len(keys), dtype=np.int32)
+        for i, key in enumerate(keys):
+            slot = self._key_to_slot.get(key)
+            if slot is None:
+                slot = len(self._slot_keys)
+                self._key_to_slot[key] = slot
+                self._slot_keys.append(key)
+                self._payload.append(None)
+            slots[i] = slot
+        if len(self._slot_keys) > self._store.capacity:
+            self._store = grow_store(
+                self._store, _next_pow2(len(self._slot_keys)))
+        return slots
+
+    def _build_changeset(self, slots: np.ndarray, records: Sequence[Record]
+                         ) -> Changeset:
+        m = len(records)
+        padded = _next_pow2(m)
+        lt = np.zeros(padded, dtype=np.int64)
+        node = np.zeros(padded, dtype=np.int32)
+        tomb = np.zeros(padded, dtype=bool)
+        valid = np.zeros(padded, dtype=bool)
+        slot = np.zeros(padded, dtype=np.int32)
+        slot[:m] = slots
+        valid[:m] = True
+        for i, r in enumerate(records):
+            lt[i] = r.hlc.logical_time
+            node[i] = self._table.ordinal(r.hlc.node_id)
+            tomb[i] = r.value is None
+        return Changeset(slot=jnp.asarray(slot), lt=jnp.asarray(lt),
+                         node=jnp.asarray(node), tomb=jnp.asarray(tomb),
+                         valid=jnp.asarray(valid))
+
+    # --- storage primitives (crdt.dart:140-169) ---
+
+    def contains_key(self, key: K) -> bool:
+        return key in self._key_to_slot
+
+    def get_record(self, key: K) -> Optional[Record[V]]:
+        slot = self._key_to_slot.get(key)
+        if slot is None:
+            return None
+        # One batched device->host transfer for the whole row.
+        occ, lt, node, mod_lt, mod_node = (
+            int(x) for x in jax.device_get(
+                (self._store.occupied[slot], self._store.lt[slot],
+                 self._store.node[slot], self._store.mod_lt[slot],
+                 self._store.mod_node[slot])))
+        if not occ:
+            return None
+        return Record(
+            Hlc.from_logical_time(lt, self._table.id_of(node)),
+            self._payload[slot],
+            Hlc.from_logical_time(mod_lt, self._table.id_of(mod_node)))
+
+    def put_record(self, key: K, record: Record[V]) -> None:
+        self.put_records({key: record})
+
+    def put_records(self, record_map: Dict[K, Record[V]]) -> None:
+        if not record_map:
+            return
+        keys = list(record_map.keys())
+        records = list(record_map.values())
+        self._intern_nodes([r.hlc.node_id for r in records] +
+                           [r.modified.node_id for r in records])
+        slots = self._ensure_slots(keys)
+        cs = self._build_changeset(slots, records)
+        m, padded = len(records), cs.slot.shape[0]
+        mod_lt = np.zeros(padded, dtype=np.int64)
+        mod_node = np.zeros(padded, dtype=np.int32)
+        for i, r in enumerate(records):
+            mod_lt[i] = r.modified.logical_time
+            mod_node[i] = self._table.ordinal(r.modified.node_id)
+        self._store = scatter_put(self._store, cs, jnp.asarray(mod_lt),
+                                  jnp.asarray(mod_node))
+        for key, record in record_map.items():
+            self._payload[self._key_to_slot[key]] = record.value
+            self._hub.add(key, record.value)
+
+    def record_map(self, modified_since: Optional[Hlc] = None
+                   ) -> Dict[K, Record[V]]:
+        n = len(self._slot_keys)
+        if n == 0:
+            return {}
+        if modified_since is None:
+            mask = np.asarray(self._store.occupied[:n])
+        else:
+            since = jnp.int64(modified_since.logical_time)
+            mask = np.asarray(delta_mask(self._store, since)[:n])
+        lt = np.asarray(self._store.lt[:n])
+        node = np.asarray(self._store.node[:n])
+        mod_lt = np.asarray(self._store.mod_lt[:n])
+        mod_node = np.asarray(self._store.mod_node[:n])
+        out: Dict[K, Record[V]] = {}
+        for slot in np.nonzero(mask)[0]:
+            key = self._slot_keys[slot]
+            out[key] = Record(
+                Hlc.from_logical_time(int(lt[slot]),
+                                      self._table.id_of(int(node[slot]))),
+                self._payload[slot],
+                Hlc.from_logical_time(int(mod_lt[slot]),
+                                      self._table.id_of(int(mod_node[slot]))))
+        return out
+
+    def watch(self, key: Optional[K] = None) -> ChangeStream:
+        return self._hub.stream(key)
+
+    def purge(self) -> None:
+        self._store = empty_store(self._store.capacity)
+        self._key_to_slot.clear()
+        self._slot_keys.clear()
+        self._payload.clear()
+
+    # --- overridden hot paths ---
+
+    def refresh_canonical_time(self) -> None:
+        """Vectorized canonical-clock rebuild: one max-reduce over the
+        occupied lt lane (crdt.dart:114-121 'should be overridden')."""
+        if not hasattr(self, "_store") or not self._slot_keys:
+            self._canonical_time = Hlc.from_logical_time(0, self._node_id)
+            return
+        self._canonical_time = Hlc.from_logical_time(
+            int(max_logical_time(self._store)), self._node_id)
+
+    def merge(self, remote_records: Dict[K, Record[V]]) -> None:
+        """Fused device lattice join (crdt.dart:77-94 semantics)."""
+        wall = self._wall_clock()
+        if not remote_records:
+            # Dart still bumps the canonical clock on an empty merge
+            # (crdt.dart:93 runs unconditionally). Second wall read keeps
+            # clock-tick parity with the scalar oracle's merge.
+            self._canonical_time = Hlc.send(self._canonical_time,
+                                            millis=self._wall_clock())
+            return
+
+        keys = list(remote_records.keys())
+        records = list(remote_records.values())
+        self._intern_nodes([r.hlc.node_id for r in records])
+        n_slots_before = len(self._slot_keys)
+        slots = self._ensure_slots(keys)
+        cs = self._build_changeset(slots, records)
+
+        new_store, res = merge_step(
+            self._store, cs,
+            jnp.int64(self._canonical_time.logical_time),
+            jnp.int32(self._my_ordinal()),
+            jnp.int64(wall))
+
+        if bool(res.any_bad):
+            # Dart leaves the canonical clock partially advanced and the
+            # store untouched when recv throws mid-loop — roll back the
+            # speculative host-side slot allocations so contains_key
+            # matches the oracle.
+            for key in self._slot_keys[n_slots_before:]:
+                del self._key_to_slot[key]
+            del self._slot_keys[n_slots_before:]
+            del self._payload[n_slots_before:]
+            self._canonical_time = Hlc.from_logical_time(
+                int(res.canonical_at_fail), self._node_id)
+            i = int(res.first_bad)
+            if bool(res.first_is_dup):
+                raise DuplicateNodeException(str(self._node_id))
+            raise ClockDriftException(records[i].hlc.millis, wall)
+
+        self._store = new_store
+        win = np.asarray(res.win)
+        for i, key in enumerate(keys):
+            if win[i]:
+                value = records[i].value
+                self._payload[self._key_to_slot[key]] = value
+                self._hub.add(key, value)
+
+        self._canonical_time = Hlc.from_logical_time(
+            int(res.new_canonical), self._node_id)
+        self._canonical_time = Hlc.send(self._canonical_time,
+                                        millis=self._wall_clock())

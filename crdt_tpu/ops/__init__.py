@@ -1,0 +1,38 @@
+"""Device ops: HLC lane packing and the batched lattice-join merge.
+
+Everything in this package operates on the columnar HLC representation
+(SURVEY.md §7 "core representation"): an HLC is carried as
+
+- ``lt``   int64  — ``(millis << 16) | counter``, the reference's own
+  logicalTime packing (hlc.dart:16); millis < 2^47 keeps it positive.
+- ``node`` int32  — ordinal of the node id in a per-store
+  :class:`~crdt_tpu.ops.packing.NodeTable`, order-preserving so that
+  lexicographic ``(lt, node)`` compare == ``Hlc.compareTo``
+  (hlc.dart:158-161).
+
+int64 lanes require jax x64 mode; it is enabled here, before any
+tracing happens.
+"""
+
+import jax
+
+# int64 logicalTime lanes need x64 mode. This is a process-global JAX
+# setting; crdt_tpu documents it (README "Embedding") and fails loudly
+# rather than silently computing wrong clocks if the host app pinned
+# x64 off.
+jax.config.update("jax_enable_x64", True)
+if not jax.config.jax_enable_x64:  # pragma: no cover
+    raise ImportError(
+        "crdt_tpu requires jax x64 mode for int64 HLC lanes, but "
+        "jax_enable_x64 could not be enabled in this process.")
+
+from .packing import NodeTable, pack_logical_time, unpack_logical_time
+from .merge import (Store, Changeset, MergeResult, merge_step,
+                    empty_store, grow_store, max_logical_time,
+                    delta_mask)
+
+__all__ = [
+    "NodeTable", "pack_logical_time", "unpack_logical_time",
+    "Store", "Changeset", "MergeResult", "merge_step", "empty_store",
+    "grow_store", "max_logical_time", "delta_mask",
+]

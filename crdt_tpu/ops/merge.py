@@ -1,0 +1,179 @@
+"""The batched lattice-join merge — the framework's hot op.
+
+This collapses the reference's sequential three-pass merge loop
+(crdt.dart:77-94; call stack SURVEY.md §3.3) into data-parallel stages
+with no sequential carry:
+
+1. **Clock absorption** (crdt.dart:82): the per-record ``Hlc.recv`` fold
+   reduces to ``new_canonical = max(canonical, max(remote_lt))``. The
+   recv guard checks (duplicate node, drift — hlc.dart:85-94) are
+   computed as vectorized masks against the *running* canonical value
+   (an exclusive cumulative max), because recv's fast path skips the
+   checks whenever the canonical clock is already ahead; exceptions are
+   raised on the host from the reduced masks (SURVEY.md §7 hard part 5).
+2. **LWW filter** (crdt.dart:83-84): gather local lanes at the remote
+   slots, win iff local absent or ``(l_lt, l_node) < (r_lt, r_node)`` —
+   strict compare keeps local on exact tie.
+3. **Winner re-stamp + scatter** (crdt.dart:86-90): winners keep the
+   remote event hlc, ``modified`` lanes get the final canonical time;
+   losers' scatter indices are redirected out of bounds and dropped.
+
+All shapes are static (changesets are padded with ``valid=False``
+entries) so the whole step is one fused XLA program.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+from ..hlc import MAX_DRIFT, SHIFT
+
+_NEG = -(2 ** 62)
+
+
+class Store(NamedTuple):
+    """Columnar record store (structure-of-arrays in HBM).
+
+    One slot per key; key <-> slot assignment lives host-side (or is the
+    identity for dense integer key spaces). Values live in a payload
+    table indexed by slot — they never enter the reduction, only winning
+    indices do (SURVEY.md §7 hard part 4).
+    """
+    lt: jax.Array        # int64[C] record hlc logicalTime
+    node: jax.Array      # int32[C] record hlc node ordinal
+    mod_lt: jax.Array    # int64[C] modified logicalTime (local-only lane)
+    mod_node: jax.Array  # int32[C] modified node ordinal
+    occupied: jax.Array  # bool[C]
+    tomb: jax.Array      # bool[C]  value is None (record.dart:17)
+
+    @property
+    def capacity(self) -> int:
+        return self.lt.shape[0]
+
+
+class Changeset(NamedTuple):
+    """A padded batch of remote records addressed to store slots."""
+    slot: jax.Array  # int32[M] target slot; ignored when ~valid
+    lt: jax.Array    # int64[M]
+    node: jax.Array  # int32[M]
+    tomb: jax.Array  # bool[M]
+    valid: jax.Array  # bool[M]
+
+
+class MergeResult(NamedTuple):
+    win: jax.Array            # bool[M] remote record was adopted
+    new_canonical: jax.Array  # int64 scalar (pre final-send-bump)
+    any_bad: jax.Array        # bool — some recv guard tripped
+    first_bad: jax.Array      # int32 index of first offending record
+    first_is_dup: jax.Array   # bool — duplicate-node (vs drift) at first_bad
+    canonical_at_fail: jax.Array  # int64 canonical BEFORE failing record
+
+
+def empty_store(capacity: int) -> Store:
+    return Store(
+        lt=jnp.zeros((capacity,), jnp.int64),
+        node=jnp.zeros((capacity,), jnp.int32),
+        mod_lt=jnp.zeros((capacity,), jnp.int64),
+        mod_node=jnp.zeros((capacity,), jnp.int32),
+        occupied=jnp.zeros((capacity,), bool),
+        tomb=jnp.zeros((capacity,), bool),
+    )
+
+
+def grow_store(store: Store, capacity: int) -> Store:
+    pad = capacity - store.capacity
+    assert pad >= 0
+    if pad == 0:
+        return store
+    return Store(*(jnp.concatenate([lane, jnp.zeros((pad,), lane.dtype)])
+                   for lane in store))
+
+
+@jax.jit
+def merge_step(store: Store, cs: Changeset, canonical_lt: jax.Array,
+               local_node: jax.Array, wall_millis: jax.Array
+               ) -> tuple[Store, MergeResult]:
+    """One fused lattice-join step. See module docstring for the staging."""
+    masked_lt = jnp.where(cs.valid, cs.lt, _NEG)
+
+    # --- stage 1: clock absorption + recv guard masks ---
+    incl = jax.lax.cummax(masked_lt)
+    excl = jnp.concatenate([jnp.full((1,), _NEG, jnp.int64), incl[:-1]])
+    running_canonical = jnp.maximum(canonical_lt, excl)
+
+    slow_path = cs.valid & (cs.lt > running_canonical)  # hlc.dart:85
+    dup = slow_path & (cs.node == local_node)           # hlc.dart:88-90
+    drift = slow_path & ~dup & (
+        (cs.lt >> SHIFT) - wall_millis > MAX_DRIFT)     # hlc.dart:92-94
+    bad = dup | drift
+    any_bad = jnp.any(bad)
+    first_bad = jnp.argmax(bad).astype(jnp.int32)
+    first_is_dup = dup[first_bad]
+    canonical_at_fail = running_canonical[first_bad]
+
+    new_canonical = jnp.maximum(canonical_lt, jnp.max(masked_lt))
+
+    # --- stage 2: vectorized LWW compare (strict: local wins ties) ---
+    l_lt = store.lt.at[cs.slot].get(mode="fill", fill_value=0)
+    l_node = store.node.at[cs.slot].get(mode="fill", fill_value=0)
+    l_occ = store.occupied.at[cs.slot].get(mode="fill", fill_value=False)
+
+    remote_newer = (cs.lt > l_lt) | ((cs.lt == l_lt) & (cs.node > l_node))
+    win = cs.valid & (~l_occ | remote_newer)
+
+    # --- stage 3: re-stamp winners, scatter (losers dropped OOB) ---
+    target = jnp.where(win, cs.slot, store.capacity).astype(jnp.int32)
+    m = cs.slot.shape[0]
+    new_store = Store(
+        lt=store.lt.at[target].set(cs.lt, mode="drop"),
+        node=store.node.at[target].set(cs.node, mode="drop"),
+        mod_lt=store.mod_lt.at[target].set(
+            jnp.full((m,), 0, jnp.int64) + new_canonical, mode="drop"),
+        mod_node=store.mod_node.at[target].set(
+            jnp.full((m,), 0, jnp.int32) + local_node, mode="drop"),
+        occupied=store.occupied.at[target].set(True, mode="drop"),
+        tomb=store.tomb.at[target].set(cs.tomb, mode="drop"),
+    )
+
+    return new_store, MergeResult(
+        win=win,
+        new_canonical=new_canonical,
+        any_bad=any_bad,
+        first_bad=first_bad,
+        first_is_dup=first_is_dup,
+        canonical_at_fail=canonical_at_fail,
+    )
+
+
+@jax.jit
+def scatter_put(store: Store, cs: Changeset, mod_lt: jax.Array,
+                mod_node: jax.Array) -> Store:
+    """Raw storage-slot write (putRecords semantics, crdt.dart:150-155):
+    store records without clock logic, with explicit modified lanes."""
+    target = jnp.where(cs.valid, cs.slot, store.capacity).astype(jnp.int32)
+    return Store(
+        lt=store.lt.at[target].set(cs.lt, mode="drop"),
+        node=store.node.at[target].set(cs.node, mode="drop"),
+        mod_lt=store.mod_lt.at[target].set(mod_lt, mode="drop"),
+        mod_node=store.mod_node.at[target].set(mod_node, mode="drop"),
+        occupied=store.occupied.at[target].set(True, mode="drop"),
+        tomb=store.tomb.at[target].set(cs.tomb, mode="drop"),
+    )
+
+
+@jax.jit
+def max_logical_time(store: Store) -> jax.Array:
+    """refreshCanonicalTime's reduction (crdt.dart:114-121): max stored
+    record logicalTime, 0 for an empty store — one jnp.max over the lane."""
+    return jnp.max(jnp.where(store.occupied, store.lt, 0))
+
+
+@jax.jit
+def delta_mask(store: Store, since_lt: jax.Array) -> jax.Array:
+    """modifiedSince filter: INCLUSIVE bound on the modified lane
+    (map_crdt.dart:44-45)."""
+    return store.occupied & (store.mod_lt >= since_lt)

@@ -1,0 +1,97 @@
+"""HLC lane packing: scalar Hlc <-> (int64 lt, int32 node ordinal).
+
+The hard part (SURVEY.md §7 build step 1) is an order-preserving node-id
+encoding: ``Hlc.compareTo`` tie-breaks on the node id's natural
+comparison (hlc.dart:160), which for arbitrary-length strings cannot be
+embedded into a fixed-width integer in general. Instead each store keeps
+a :class:`NodeTable` — a sorted dictionary of every node id it has seen —
+and carries the *ordinal* in the lane. Ordinal comparison then equals
+string comparison exactly. When a new node id lands between existing
+ones, previously issued ordinals shift; the table reports a remap vector
+so stored lanes can be re-encoded with one gather (node counts are tiny —
+they are replicas, not records).
+"""
+
+from __future__ import annotations
+
+import bisect
+from typing import Any, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ..hlc import SHIFT, MAX_COUNTER, Hlc
+
+
+def pack_logical_time(millis: int, counter: int) -> int:
+    """(millis, counter) -> int64 logicalTime (hlc.dart:16)."""
+    return (millis << SHIFT) + counter
+
+
+def unpack_logical_time(lt: int) -> Tuple[int, int]:
+    return lt >> SHIFT, lt & MAX_COUNTER
+
+
+class NodeTable:
+    """Order-preserving node-id interning for one store.
+
+    Ordinals are indices into the sorted id list, so
+    ``ordinal(a) < ordinal(b)  <=>  a < b`` under the ids' natural
+    comparison — the exact tie-break ``Hlc.compareTo`` uses
+    (hlc.dart:158-161). Node ids must be mutually comparable (all str or
+    all int, as in the reference).
+    """
+
+    def __init__(self, ids: Optional[Sequence[Any]] = None):
+        self._sorted: List[Any] = sorted(set(ids)) if ids else []
+
+    def __len__(self) -> int:
+        return len(self._sorted)
+
+    def __contains__(self, node_id: Any) -> bool:
+        i = bisect.bisect_left(self._sorted, node_id)
+        return i < len(self._sorted) and self._sorted[i] == node_id
+
+    def ordinal(self, node_id: Any) -> int:
+        """Ordinal of an already-interned id."""
+        i = bisect.bisect_left(self._sorted, node_id)
+        if i == len(self._sorted) or self._sorted[i] != node_id:
+            raise KeyError(node_id)
+        return i
+
+    def id_of(self, ordinal: int) -> Any:
+        return self._sorted[ordinal]
+
+    def intern(self, node_ids: Sequence[Any]
+               ) -> Optional[np.ndarray]:
+        """Add any unseen ids. Returns an int32 remap vector mapping old
+        ordinal -> new ordinal if existing ordinals shifted, else None.
+        Apply it to stored node lanes via ``remap[lane]``."""
+        new = sorted(set(node_ids) - set(self._sorted))
+        if not new:
+            return None
+        old = self._sorted
+        merged = sorted(old + new)
+        remap = np.empty(len(old), dtype=np.int32)
+        positions = {v: i for i, v in enumerate(merged)}
+        for i, v in enumerate(old):
+            remap[i] = positions[v]
+        self._sorted = merged
+        if np.array_equal(remap, np.arange(len(old), dtype=np.int32)):
+            return None  # new ids all sort after existing ones
+        return remap
+
+    def encode(self, node_ids: Sequence[Any]) -> np.ndarray:
+        """Ordinals for already-interned ids (vectorized host path)."""
+        return np.array([self.ordinal(n) for n in node_ids], dtype=np.int32)
+
+
+def pack_hlcs(hlcs: Sequence[Hlc], table: NodeTable
+              ) -> Tuple[np.ndarray, np.ndarray]:
+    """Scalar Hlcs -> (lt int64, node int32) lanes. Ids must be interned."""
+    lt = np.array([h.logical_time for h in hlcs], dtype=np.int64)
+    node = table.encode([h.node_id for h in hlcs])
+    return lt, node
+
+
+def unpack_hlc(lt: int, node_ord: int, table: NodeTable) -> Hlc:
+    return Hlc.from_logical_time(int(lt), table.id_of(int(node_ord)))

@@ -1,0 +1,94 @@
+"""Change-notification streams (C13 — watch/reactivity).
+
+The reference exposes a Dart broadcast stream of ``MapEntry(key, value)``
+change events (map_crdt.dart:11,27-39,48-49; contract crdt.dart:162-164).
+This is the Python equivalent: a synchronous broadcast hub with
+filterable subscriptions. Device backends emit events host-side after
+kernel writes land — reactivity never lives inside jit (SURVEY.md §7
+hard part 6).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Iterator, List, NamedTuple, Optional
+
+
+class ChangeEvent(NamedTuple):
+    """A (key, value) change notification — value is None for deletes."""
+    key: Any
+    value: Any
+
+
+class ChangeStream:
+    """A filtered view over a :class:`ChangeHub`.
+
+    Supports callback subscription (``listen``), buffered collection for
+    tests (``record`` + ``events``), and further filtering (``where``).
+    """
+
+    def __init__(self, hub: "ChangeHub",
+                 predicate: Optional[Callable[[ChangeEvent], bool]] = None):
+        self._hub = hub
+        self._predicate = predicate
+        self._buffer: List[ChangeEvent] = []
+        self._recording = False
+        # Each subscription is a single-element list token so duplicate
+        # callbacks unsubscribe independently.
+        self._callbacks: List[List[Callable[[ChangeEvent], None]]] = []
+        hub._streams.append(self)
+
+    def _emit(self, event: ChangeEvent) -> None:
+        if self._predicate is not None and not self._predicate(event):
+            return
+        if self._recording:
+            self._buffer.append(event)
+        for token in list(self._callbacks):
+            token[0](event)
+
+    def listen(self, callback: Callable[[ChangeEvent], None]
+               ) -> Callable[[], None]:
+        """Subscribe; returns an idempotent unsubscribe function."""
+        token = [callback]
+        self._callbacks.append(token)
+
+        def unsubscribe() -> None:
+            if token in self._callbacks:
+                self._callbacks.remove(token)
+
+        return unsubscribe
+
+    def record(self) -> "ChangeStream":
+        """Start buffering events into ``events`` (test helper)."""
+        self._recording = True
+        return self
+
+    @property
+    def events(self) -> List[ChangeEvent]:
+        return list(self._buffer)
+
+    def where(self, predicate: Callable[[ChangeEvent], bool]
+              ) -> "ChangeStream":
+        prev = self._predicate
+        combined = (predicate if prev is None
+                    else (lambda e: prev(e) and predicate(e)))
+        return ChangeStream(self._hub, combined)
+
+    def cancel(self) -> None:
+        if self in self._hub._streams:
+            self._hub._streams.remove(self)
+
+
+class ChangeHub:
+    """Broadcast source owned by a storage backend."""
+
+    def __init__(self) -> None:
+        self._streams: List[ChangeStream] = []
+
+    def add(self, key: Any, value: Any) -> None:
+        event = ChangeEvent(key, value)
+        for stream in list(self._streams):
+            stream._emit(event)
+
+    def stream(self, key: Any = None) -> ChangeStream:
+        predicate = None if key is None else (lambda e: e.key == key)
+        return ChangeStream(self, predicate)

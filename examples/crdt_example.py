@@ -1,0 +1,42 @@
+"""Quickstart: put -> to_json -> (mock wire) -> merge_json round trip.
+
+Port of the reference `example/crdt_example.dart:1-25`.
+"""
+
+from crdt_tpu import Hlc, MapCrdt
+
+
+def send_to_remote(json_str: str) -> str:
+    """Mock sending the CRDT to a remote node and getting an update back.
+
+    The remote stamps its write one wall tick later so the LWW merge
+    deterministically adopts it (the Dart example relies on interpreter
+    latency to cross the millisecond boundary).
+    """
+    import time
+    time.sleep(0.002)
+    hlc = Hlc.now("another_nodeId")
+    return '{"a":{"hlc":"%s","value":2}}' % hlc
+
+
+def main() -> None:
+    crdt = MapCrdt("node_id")
+
+    # Insert a record
+    crdt.put("a", 1)
+    # Read the record
+    print(f"Record: {crdt.get('a')}")
+
+    # Export the CRDT as Json
+    json_str = crdt.to_json()
+    print(f"Wire JSON: {json_str}")
+    # Send to remote node
+    remote_json = send_to_remote(json_str)
+    # Merge remote CRDT with local
+    crdt.merge_json(remote_json)
+    # Verify updated record
+    print(f"Record after merging: {crdt.get('a')}")
+
+
+if __name__ == "__main__":
+    main()

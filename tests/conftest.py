@@ -1,0 +1,17 @@
+"""Test configuration: force an 8-virtual-device CPU JAX platform.
+
+Tests must run without TPU hardware; multi-chip sharding is validated on
+a virtual CPU mesh (the driver separately dry-runs the multichip path).
+The env vars must be set before jax initializes its backends.
+"""
+
+import os
+import sys
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8").strip()
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))

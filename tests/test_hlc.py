@@ -1,0 +1,231 @@
+"""HLC clock unit tests — port of the reference `test/hlc_test.dart`.
+
+Golden constants are language-neutral and pinned at hlc_test.dart:4-7:
+millis 1000000000000, ISO '2001-09-09T01:46:40.000Z',
+logicalTime 65536000000000066, packed '00cre66i9s001uabc'.
+"""
+
+import pytest
+
+from crdt_tpu import (ClockDriftException, DuplicateNodeException, Hlc,
+                      OverflowException)
+
+MILLIS = 1000000000000
+ISO_TIME = "2001-09-09T01:46:40.000Z"
+LOGICAL_TIME = 65536000000000066
+PACKED = "00cre66i9s001uabc"
+
+
+class TestConstructors:
+    hlc = Hlc(MILLIS, 0x42, "abc")
+
+    def test_default(self):
+        assert self.hlc.millis == MILLIS
+        assert self.hlc.counter == 0x42
+        assert self.hlc.node_id == "abc"
+
+    def test_default_with_microseconds(self):
+        assert Hlc(MILLIS * 1000, 0x42, "abc") == self.hlc
+
+    def test_default_with_copy_with(self):
+        assert self.hlc.copy_with(node_id="xyz").node_id == "xyz"
+
+    def test_zero(self):
+        assert Hlc.zero("abc") == self.hlc.apply(millis=0, counter=0)
+
+    def test_from_date(self):
+        from datetime import datetime, timezone
+        dt = datetime(2001, 9, 9, 1, 46, 40, tzinfo=timezone.utc)
+        assert Hlc.from_date(dt, "abc") == self.hlc.apply(counter=0)
+
+    def test_logical_time_ctor(self):
+        assert Hlc.from_logical_time(LOGICAL_TIME, "abc") == self.hlc
+
+    def test_parse(self):
+        assert Hlc.parse(f"{ISO_TIME}-0042-abc") == self.hlc
+
+
+class TestStringOperations:
+    def test_hlc_to_string(self):
+        hlc = Hlc.parse(f"{ISO_TIME}-0042-abc")
+        assert str(hlc) == f"{ISO_TIME}-0042-abc"
+
+    def test_parse_hlc(self):
+        assert Hlc.parse(f"{ISO_TIME}-0042-abc") == Hlc(MILLIS, 0x42, "abc")
+
+
+class TestNonStringNodeId:
+    def test_to_hlc(self):
+        hlc = Hlc.parse(f"{ISO_TIME}-0042-1", int)
+        assert hlc == Hlc(MILLIS, 0x42, 1)
+
+    def test_to_string(self):
+        hlc = Hlc(MILLIS, 0x42, 1)
+        assert str(hlc) == f"{ISO_TIME}-0042-1"
+
+
+class TestComparison:
+    def test_equality(self):
+        hlc1 = Hlc.parse(f"{ISO_TIME}-0042-abc")
+        hlc2 = Hlc.parse(f"{ISO_TIME}-0042-abc")
+        assert hlc1 == hlc2
+        assert hlc1 <= hlc2
+        assert hlc1 >= hlc2
+
+    def test_different_node_ids(self):
+        hlc1 = Hlc.parse(f"{ISO_TIME}-0042-abc")
+        hlc2 = Hlc.parse(f"{ISO_TIME}-0042-abcd")
+        assert hlc1 != hlc2
+
+    def test_less_than_millis(self):
+        assert Hlc(MILLIS, 0x42, "abc") < Hlc(MILLIS + 1, 0, "abc")
+        assert Hlc(MILLIS, 0x42, "abc") <= Hlc(MILLIS + 1, 0, "abc")
+
+    def test_less_than_counter(self):
+        hlc1 = Hlc.parse(f"{ISO_TIME}-0042-abc")
+        hlc2 = Hlc.parse(f"{ISO_TIME}-0043-abc")
+        assert hlc1 < hlc2
+        assert hlc1 <= hlc2
+
+    def test_less_than_node_id(self):
+        hlc1 = Hlc.parse(f"{ISO_TIME}-0042-abc")
+        hlc2 = Hlc.parse(f"{ISO_TIME}-0042-abb")
+        assert hlc1 > hlc2
+        assert hlc1 >= hlc2
+
+    def test_fail_less_than_if_equals(self):
+        hlc1 = Hlc.parse(f"{ISO_TIME}-0042-abc")
+        hlc2 = Hlc.parse(f"{ISO_TIME}-0042-abc")
+        assert not (hlc1 < hlc2)
+
+    def test_fail_less_than_if_millis_and_counter_disagree(self):
+        assert not (Hlc(MILLIS + 1, 0, "abc") < Hlc(MILLIS, 0x42, "abc"))
+
+    def test_more_than_millis(self):
+        assert Hlc(MILLIS + 1, 0x42, "abc") > Hlc(MILLIS, 0, "abc")
+        assert Hlc(MILLIS + 1, 0x42, "abc") >= Hlc(MILLIS, 0, "abc")
+
+    def test_more_than_node_id(self):
+        assert Hlc(MILLIS, 0x42, "abc") > Hlc(MILLIS, 0x42, "abb")
+        assert Hlc(MILLIS, 0x42, "abc") >= Hlc(MILLIS, 0x42, "abb")
+
+    def test_compare(self):
+        hlc = Hlc(MILLIS, 0x42, "abc")
+        assert hlc.compare_to(Hlc(MILLIS, 0x42, "abc")) == 0
+
+        assert hlc.compare_to(Hlc(MILLIS + 1, 0x42, "abc")) == -1
+        assert hlc.compare_to(Hlc(MILLIS, 0x43, "abc")) == -1
+        assert hlc.compare_to(Hlc(MILLIS, 0x42, "abd")) == -1
+
+        assert hlc.compare_to(Hlc(MILLIS - 1, 0x42, "abc")) == 1
+        assert hlc.compare_to(Hlc(MILLIS, 0x41, "abc")) == 1
+        assert hlc.compare_to(Hlc(MILLIS, 0x42, "abb")) == 1
+
+
+class TestLogicalTime:
+    def test_stability(self):
+        hlc = Hlc.from_logical_time(LOGICAL_TIME, "abc")
+        assert hlc.logical_time == LOGICAL_TIME
+
+    def test_hlc_as_logical_time(self):
+        assert Hlc.parse(f"{ISO_TIME}-0042-abc").logical_time == LOGICAL_TIME
+
+    def test_hlc_from_logical_time(self):
+        hlc = Hlc.parse(f"{ISO_TIME}-0042-abc")
+        assert Hlc.from_logical_time(LOGICAL_TIME, "abc") == hlc
+
+
+class TestPacking:
+    def test_pack(self):
+        assert Hlc(MILLIS, 0x42, "abc").pack() == PACKED
+
+    def test_unpack(self):
+        hlc = Hlc.unpack(PACKED)
+        assert hlc.millis == MILLIS
+        assert hlc.counter == 0x42
+        assert hlc.node_id == "abc"
+
+    def test_random_node_id(self):
+        nid = Hlc.random_node_id()
+        assert len(nid) == 10
+        assert all(c in "0123456789abcdefghijklmnopqrstuvwxyz" for c in nid)
+
+
+class TestSend:
+    def test_higher_canonical_time(self):
+        hlc = Hlc(MILLIS + 1, 0x42, "abc")
+        send_hlc = Hlc.send(hlc, millis=MILLIS)
+        assert send_hlc != hlc
+        assert send_hlc.millis == hlc.millis
+        assert send_hlc.counter == 0x43
+        assert send_hlc.node_id == hlc.node_id
+
+    def test_equal_canonical_time(self):
+        hlc = Hlc(MILLIS, 0x42, "abc")
+        send_hlc = Hlc.send(hlc, millis=MILLIS)
+        assert send_hlc != hlc
+        assert send_hlc.millis == MILLIS
+        assert send_hlc.counter == 0x43
+
+    def test_lower_canonical_time(self):
+        hlc = Hlc(MILLIS - 1, 0x42, "abc")
+        send_hlc = Hlc.send(hlc, millis=MILLIS)
+        assert send_hlc != hlc
+        assert send_hlc.millis == MILLIS
+        assert send_hlc.counter == 0
+
+    def test_fail_on_clock_drift(self):
+        hlc = Hlc(MILLIS + 60001, 0, "abc")
+        with pytest.raises(ClockDriftException):
+            Hlc.send(hlc, millis=MILLIS)
+
+    def test_fail_on_counter_overflow(self):
+        hlc = Hlc(MILLIS, 0xFFFF, "abc")
+        with pytest.raises(OverflowException):
+            Hlc.send(hlc, millis=MILLIS)
+
+
+class TestReceive:
+    canonical = Hlc.parse(f"{ISO_TIME}-0042-abc")
+
+    def test_higher_canonical_time(self):
+        remote = Hlc(MILLIS - 1, 0x42, "abcd")
+        assert Hlc.recv(self.canonical, remote, millis=MILLIS) == \
+            self.canonical
+
+    def test_same_remote_time(self):
+        remote = Hlc(MILLIS, 0x42, "abcd")
+        hlc = Hlc.recv(self.canonical, remote, millis=MILLIS)
+        assert hlc == Hlc(remote.millis, remote.counter,
+                          self.canonical.node_id)
+
+    def test_higher_remote_time(self):
+        remote = Hlc(MILLIS + 1, 0, "abcd")
+        hlc = Hlc.recv(self.canonical, remote, millis=MILLIS)
+        assert hlc == Hlc(remote.millis, remote.counter,
+                          self.canonical.node_id)
+
+    def test_higher_wall_clock_time(self):
+        remote = Hlc.parse(f"{ISO_TIME}-0000-abcd")
+        assert Hlc.recv(self.canonical, remote, millis=MILLIS + 1) == \
+            self.canonical
+
+    def test_skip_node_id_check_if_time_is_lower(self):
+        remote = Hlc(MILLIS - 1, 0x42, "abc")
+        assert Hlc.recv(self.canonical, remote, millis=MILLIS) == \
+            self.canonical
+
+    def test_skip_node_id_check_if_time_is_same(self):
+        remote = Hlc(MILLIS, 0x42, "abc")
+        assert Hlc.recv(self.canonical, remote, millis=MILLIS) == \
+            self.canonical
+
+    def test_fail_on_node_id(self):
+        remote = Hlc(MILLIS + 1, 0, "abc")
+        with pytest.raises(DuplicateNodeException):
+            Hlc.recv(self.canonical, remote, millis=MILLIS)
+
+    def test_fail_on_clock_drift(self):
+        remote = Hlc(MILLIS + 60001, 0x42, "abcd")
+        with pytest.raises(ClockDriftException):
+            Hlc.recv(self.canonical, remote, millis=MILLIS)

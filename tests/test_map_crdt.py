@@ -1,0 +1,291 @@
+"""MapCrdt + merge integration tests — port of `test/map_crdt_test.dart`.
+
+Covers: the conformance suite instantiation, seeded construction, the
+10 merge scenarios, golden-string serialization both directions, delta
+subset boundary semantics, and 3-replica delta-sync convergence (with an
+injected deterministic clock instead of real sleeps).
+"""
+
+from datetime import datetime
+
+from crdt_tpu import Crdt, CrdtJson, Hlc, MapCrdt, Record
+
+from conformance import CrdtConformance, FakeClock
+
+MILLIS = 1000000000000
+ISO_TIME = "2001-09-09T01:46:40.000Z"
+
+
+class TestMapCrdtConformance(CrdtConformance):
+    def make_crdt(self):
+        return MapCrdt("abc", wall_clock=FakeClock())
+
+
+def _now():
+    return Hlc.now("abc")
+
+
+class TestSeed:
+    def test_seed_item(self):
+        hlc_now = _now()
+        crdt = MapCrdt("abc", {"x": Record(hlc_now, 1, hlc_now)})
+        assert crdt.get("x") == 1
+
+    def test_seed_and_put(self):
+        hlc_now = _now()
+        crdt = MapCrdt("abc", {"x": Record(hlc_now, 1, hlc_now)})
+        crdt.put("x", 2)
+        assert crdt.get("x") == 2
+
+
+class TestMerge:
+    def setup_method(self):
+        self.clock = FakeClock()
+        self.crdt = MapCrdt("abc", wall_clock=self.clock)
+        self.hlc_now = Hlc.now("abc", millis=self.clock.millis)
+
+    def test_merge_older(self):
+        self.crdt.put("x", 2)
+        self.crdt.merge(
+            {"x": Record(Hlc(MILLIS - 1, 0, "xyz"), 1, self.hlc_now)})
+        assert self.crdt.get("x") == 2
+
+    def test_merge_very_old(self):
+        self.crdt.put("x", 2)
+        self.crdt.merge({"x": Record(Hlc(0, 0, "xyz"), 1, self.hlc_now)})
+        assert self.crdt.get("x") == 2
+
+    def test_merge_newer(self):
+        self.crdt.put("x", 1)
+        self.clock.advance(10)
+        newer = Hlc.now("xyz", millis=self.clock.millis + 1)
+        self.crdt.merge({"x": Record(newer, 2, self.hlc_now)})
+        assert self.crdt.get("x") == 2
+
+    def test_disambiguate_using_node_id(self):
+        self.crdt.merge(
+            {"x": Record(Hlc(MILLIS, 0, "nodeA"), 1, self.hlc_now)})
+        self.crdt.merge(
+            {"x": Record(Hlc(MILLIS, 0, "nodeB"), 2, self.hlc_now)})
+        assert self.crdt.get("x") == 2
+
+    def test_merge_same(self):
+        self.crdt.put("x", 2)
+        remote_ts = self.crdt.get_record("x").hlc
+        self.crdt.merge({"x": Record(remote_ts, 1, self.hlc_now)})
+        assert self.crdt.get("x") == 2
+
+    def test_merge_older_newer_counter(self):
+        self.crdt.put("x", 2)
+        self.crdt.merge(
+            {"x": Record(Hlc(MILLIS - 1, 2, "xyz"), 1, self.hlc_now)})
+        assert self.crdt.get("x") == 2
+
+    def test_merge_same_millis_newer_counter(self):
+        self.crdt.put("x", 1)
+        remote_ts = Hlc(self.crdt.get_record("x").hlc.millis, 2, "xyz")
+        self.crdt.merge({"x": Record(remote_ts, 2, self.hlc_now)})
+        assert self.crdt.get("x") == 2
+
+    def test_merge_new_item(self):
+        records = {"x": Record(Hlc.now("xyz", millis=self.clock.millis),
+                               2, self.hlc_now)}
+        self.crdt.merge(dict(records))
+        assert self.crdt.record_map() == records
+
+    def test_merge_deleted_item(self):
+        self.crdt.put("x", 1)
+        self.clock.advance(10)
+        newer = Hlc.now("xyz", millis=self.clock.millis + 1)
+        self.crdt.merge({"x": Record(newer, None, self.hlc_now)})
+        assert self.crdt.is_deleted("x") is True
+
+    def test_update_hlc_on_merge(self):
+        self.crdt.put("x", 1)
+        self.crdt.merge(
+            {"y": Record(Hlc(MILLIS - 1, 0, "xyz"), 2, self.hlc_now)})
+        assert self.crdt.values == [1, 2]
+
+    def test_canonical_absorbs_remote_clock(self):
+        # Clock absorption runs for winners AND losers (crdt.dart:82);
+        # the canonical time ends >= every remote hlc seen.
+        remote_hlc = Hlc(self.clock.millis + 50_000, 7, "xyz")
+        self.crdt.merge({"x": Record(remote_hlc, 1, self.hlc_now)})
+        assert self.crdt.canonical_time.logical_time > \
+            remote_hlc.logical_time  # final send bump (crdt.dart:93)
+        assert self.crdt.canonical_time.node_id == "abc"
+
+
+class TestSerialization:
+    hlc_now = Hlc.now("abc")
+
+    def test_to_map(self):
+        crdt = MapCrdt("abc",
+                       {"x": Record(Hlc(MILLIS, 0, "abc"), 1, self.hlc_now)})
+        assert crdt.record_map() == {
+            "x": Record(Hlc(MILLIS, 0, "abc"), 1, self.hlc_now)}
+
+    def test_json_encode_string_key(self):
+        crdt = MapCrdt("abc",
+                       {"x": Record(Hlc(MILLIS, 0, "abc"), 1, self.hlc_now)})
+        assert crdt.to_json() == \
+            '{"x":{"hlc":"%s-0000-abc","value":1}}' % ISO_TIME
+
+    def test_json_encode_int_key(self):
+        crdt = MapCrdt("abc",
+                       {1: Record(Hlc(MILLIS, 0, "abc"), 1, self.hlc_now)})
+        assert crdt.to_json() == \
+            '{"1":{"hlc":"%s-0000-abc","value":1}}' % ISO_TIME
+
+    def test_json_encode_datetime_key(self):
+        crdt = MapCrdt("abc", {
+            datetime(2000, 1, 1, 1, 20):
+                Record(Hlc(MILLIS, 0, "abc"), 1, self.hlc_now)})
+        assert crdt.to_json() == (
+            '{"2000-01-01 01:20:00.000":'
+            '{"hlc":"%s-0000-abc","value":1}}' % ISO_TIME)
+
+    def test_json_encode_custom_class_value(self):
+        crdt = MapCrdt("abc", {
+            "x": Record(Hlc(MILLIS, 0, "abc"), TestClass("test"),
+                        self.hlc_now)})
+        assert crdt.to_json() == (
+            '{"x":{"hlc":"%s-0000-abc","value":{"test":"test"}}}' % ISO_TIME)
+
+    def test_json_encode_custom_node_id(self):
+        crdt = MapCrdt("abc",
+                       {"x": Record(Hlc(MILLIS, 0, 1), 0, self.hlc_now)})
+        assert crdt.to_json() == \
+            '{"x":{"hlc":"%s-0000-1","value":0}}' % ISO_TIME
+
+    def test_json_decode_string_key(self):
+        crdt = MapCrdt("abc")
+        records = CrdtJson.decode(
+            '{"x":{"hlc":"%s-0000-abc","value":1}}' % ISO_TIME, self.hlc_now)
+        crdt.put_records(records)
+        assert crdt.record_map() == {
+            "x": Record(Hlc(MILLIS, 0, "abc"), 1, self.hlc_now)}
+
+    def test_json_decode_int_key(self):
+        crdt = MapCrdt("abc")
+        records = CrdtJson.decode(
+            '{"1":{"hlc":"%s-0000-abc","value":1}}' % ISO_TIME, self.hlc_now,
+            key_decoder=int)
+        crdt.put_records(records)
+        assert crdt.record_map() == {
+            1: Record(Hlc(MILLIS, 0, "abc"), 1, self.hlc_now)}
+
+    def test_json_decode_datetime_key(self):
+        crdt = MapCrdt("abc")
+        records = CrdtJson.decode(
+            '{"2000-01-01 01:20:00.000":{"hlc":"%s-0000-abc","value":1}}'
+            % ISO_TIME, self.hlc_now,
+            key_decoder=lambda k: datetime.fromisoformat(k.replace(" ", "T")))
+        crdt.put_records(records)
+        assert crdt.record_map() == {
+            datetime(2000, 1, 1, 1, 20):
+                Record(Hlc(MILLIS, 0, "abc"), 1, self.hlc_now)}
+
+    def test_json_decode_custom_class_value(self):
+        crdt = MapCrdt("abc")
+        records = CrdtJson.decode(
+            '{"x":{"hlc":"%s-0000-abc","value":{"test":"test"}}}' % ISO_TIME,
+            self.hlc_now,
+            value_decoder=lambda key, value: TestClass.from_json(value))
+        crdt.put_records(records)
+        assert crdt.record_map() == {
+            "x": Record(Hlc(MILLIS, 0, "abc"), TestClass("test"),
+                        self.hlc_now)}
+
+    def test_json_decode_custom_node_id(self):
+        crdt = MapCrdt("abc")
+        records = CrdtJson.decode(
+            '{"x":{"hlc":"%s-0000-1","value":0}}' % ISO_TIME, self.hlc_now,
+            node_id_decoder=int)
+        crdt.put_records(records)
+        assert crdt.record_map() == {
+            "x": Record(Hlc(MILLIS, 0, 1), 0, self.hlc_now)}
+
+
+class TestDeltaSubsets:
+    hlc1 = Hlc(MILLIS, 0, "abc")
+    hlc2 = Hlc(MILLIS + 1, 0, "abc")
+    hlc3 = Hlc(MILLIS + 2, 0, "abc")
+
+    def make(self):
+        return MapCrdt("abc", {
+            "x": Record(self.hlc1, 1, self.hlc1),
+            "y": Record(self.hlc2, 2, self.hlc2),
+        })
+
+    def test_null_modified_since(self):
+        assert len(self.make().record_map()) == 2
+
+    def test_modified_since_hlc1(self):
+        assert len(self.make().record_map(modified_since=self.hlc1)) == 2
+
+    def test_modified_since_hlc2(self):
+        assert len(self.make().record_map(modified_since=self.hlc2)) == 1
+
+    def test_modified_since_hlc3(self):
+        assert len(self.make().record_map(modified_since=self.hlc3)) == 0
+
+
+def _sync(local: Crdt, remote: Crdt):
+    """The reference's anti-entropy round (map_crdt_test.dart:273-279):
+    full-state push then delta pull keyed on pre-push canonical time."""
+    time = local.canonical_time
+    remote.merge(local.record_map())
+    local.merge(remote.record_map(modified_since=time))
+
+
+class TestDeltaSync:
+    def setup_method(self):
+        clock = FakeClock()
+        self.crdt_a = MapCrdt("a", wall_clock=clock)
+        self.crdt_b = MapCrdt("b", wall_clock=clock)
+        self.crdt_c = MapCrdt("c", wall_clock=clock)
+
+        self.crdt_a.put("x", 1)
+        clock.advance(100)
+        self.crdt_b.put("x", 2)
+
+    def test_merge_in_order(self):
+        _sync(self.crdt_a, self.crdt_c)
+        _sync(self.crdt_b, self.crdt_c)
+
+        assert self.crdt_a.get("x") == 1  # A still has the old value
+        assert self.crdt_b.get("x") == 2
+        assert self.crdt_c.get("x") == 2
+
+    def test_merge_in_reverse_order(self):
+        _sync(self.crdt_b, self.crdt_c)
+        _sync(self.crdt_a, self.crdt_c)
+        _sync(self.crdt_b, self.crdt_c)
+
+        assert self.crdt_a.get("x") == 2
+        assert self.crdt_b.get("x") == 2
+        assert self.crdt_c.get("x") == 2
+
+
+class TestClass:
+    __test__ = False  # custom value class, not a pytest suite
+
+    def __init__(self, test: str):
+        self.test = test
+
+    @staticmethod
+    def from_json(obj):
+        return TestClass(obj["test"])
+
+    def to_json(self):
+        return {"test": self.test}
+
+    def __eq__(self, other):
+        return isinstance(other, TestClass) and self.test == other.test
+
+    def __hash__(self):
+        return hash(self.test)
+
+    def __repr__(self):
+        return self.test

@@ -1,0 +1,66 @@
+"""Regression tests for review findings."""
+
+import copy
+import pickle
+
+import pytest
+
+from crdt_tpu import (DuplicateNodeException, Hlc, MapCrdt, Record,
+                      TpuMapCrdt)
+
+from conformance import FakeClock
+
+
+def test_empty_merge_clock_parity():
+    """Empty merges must consume the same number of wall-clock ticks on
+    both backends (the reference bumps the canonical clock even for an
+    empty changeset, crdt.dart:93)."""
+    oracle = MapCrdt("abc", wall_clock=FakeClock())
+    tpu = TpuMapCrdt("abc", wall_clock=FakeClock())
+    for c in (oracle, tpu):
+        c.put("x", 1)
+        c.merge({})
+        c.put("y", 2)
+    assert oracle.to_json() == tpu.to_json()
+    assert oracle.canonical_time == tpu.canonical_time
+
+
+def test_failed_merge_rolls_back_host_state():
+    """A merge raising from the recv guard must not leave phantom keys
+    (the oracle's store is untouched when recv throws mid-loop)."""
+    clock = FakeClock()
+    tpu = TpuMapCrdt("abc", wall_clock=clock)
+    oracle = MapCrdt("abc", wall_clock=FakeClock())
+    bad = Hlc(clock.millis + 1000, 0, "abc")  # duplicate node, ahead
+    for c in (tpu, oracle):
+        with pytest.raises(DuplicateNodeException):
+            c.merge({"phantom": Record(bad, 1, bad)})
+    assert tpu.contains_key("phantom") == oracle.contains_key("phantom") \
+        == False
+    assert tpu.record_map() == oracle.record_map() == {}
+
+
+def test_hlc_copy_and_pickle():
+    h = Hlc(1000000000000, 0x42, "abc")
+    assert copy.copy(h) is h
+    assert copy.deepcopy(h) is h
+    assert pickle.loads(pickle.dumps(h)) == h
+
+
+def test_parse_with_utc_offset():
+    # fractional seconds + explicit offset must not be silently mis-parsed
+    h = Hlc.parse("2024-01-01T12:00:00.123+02:00-0001-n")
+    assert h.millis == 1704103200123
+    assert h.counter == 1
+
+
+def test_unsubscribe_idempotent():
+    crdt = MapCrdt("abc", wall_clock=FakeClock())
+    stream = crdt.watch()
+    seen = []
+    unsub = stream.listen(seen.append)
+    crdt.put("x", 1)
+    unsub()
+    unsub()  # second call must not raise
+    crdt.put("y", 2)
+    assert len(seen) == 1

@@ -1,0 +1,158 @@
+"""TpuMapCrdt: full conformance suite + differential tests vs the oracle.
+
+The TPU path must be behaviorally indistinguishable from MapCrdt —
+including byte-identical `to_json` output (the north-star parity
+requirement) — under arbitrary op sequences.
+"""
+
+import json
+import random
+
+import pytest
+
+from crdt_tpu import (ClockDriftException, DuplicateNodeException, Hlc,
+                      MapCrdt, Record, TpuMapCrdt)
+
+from conformance import CrdtConformance, FakeClock
+
+MILLIS = 1000000000000
+
+
+class TestTpuConformance(CrdtConformance):
+    def make_crdt(self):
+        return TpuMapCrdt("abc", wall_clock=FakeClock())
+
+
+class TestTpuSpecifics:
+    def setup_method(self):
+        self.clock = FakeClock()
+        self.crdt = TpuMapCrdt("abc", wall_clock=self.clock)
+
+    def test_seed(self):
+        hlc = Hlc(MILLIS, 0, "abc")
+        crdt = TpuMapCrdt("abc", seed={"x": Record(hlc, 1, hlc)})
+        assert crdt.get("x") == 1
+        assert crdt.canonical_time.logical_time == hlc.logical_time
+
+    def test_capacity_growth(self):
+        for i in range(100):
+            self.crdt.put(f"k{i}", i)
+        assert self.crdt.length == 100
+        assert self.crdt.get("k42") == 42
+
+    def test_merge_duplicate_node_raises(self):
+        remote = Hlc(self.clock.millis + 1000, 0, "abc")
+        with pytest.raises(DuplicateNodeException):
+            self.crdt.merge({"x": Record(remote, 1, remote)})
+
+    def test_merge_drift_raises(self):
+        remote = Hlc(self.clock.millis + 120_000, 0, "xyz")
+        with pytest.raises(ClockDriftException):
+            self.crdt.merge({"x": Record(remote, 1, remote)})
+
+    def test_merge_drift_fast_path_skips_check(self):
+        # recv's fast path skips guard checks when canonical >= remote
+        # (hlc.dart:85) — an old record from "our own" node id must NOT
+        # raise DuplicateNodeException.
+        self.crdt.put("x", 1)
+        old = Hlc(0, 1, "abc")
+        self.crdt.merge({"y": Record(old, 2, old)})  # no raise
+        assert self.crdt.get("x") == 1
+
+    def test_node_table_remap(self):
+        # A node id sorting BEFORE existing ones shifts ordinals; stored
+        # lanes must be re-encoded so tie-breaks stay correct.
+        self.crdt.merge({"x": Record(Hlc(MILLIS, 0, "zzz"), 1,
+                                     Hlc(MILLIS, 0, "zzz"))})
+        self.crdt.merge({"x": Record(Hlc(MILLIS, 0, "aaa"), 2,
+                                     Hlc(MILLIS, 0, "aaa"))})
+        # zzz > aaa at equal logical time: local (zzz) wins
+        assert self.crdt.get("x") == 1
+        # but a later write from aaa wins
+        self.crdt.merge({"x": Record(Hlc(MILLIS + 1, 0, "aaa"), 3,
+                                     Hlc(MILLIS, 0, "aaa"))})
+        assert self.crdt.get("x") == 3
+
+    def test_tombstone_roundtrip(self):
+        self.crdt.put("x", 1)
+        self.crdt.delete("x")
+        assert self.crdt.is_deleted("x") is True
+        rm = self.crdt.record_map()
+        assert rm["x"].is_deleted
+
+
+def _apply_ops(crdt, ops):
+    for op, args in ops:
+        getattr(crdt, op)(*args)
+
+
+def _random_ops(rng: random.Random, peers, n_ops=60):
+    """A reproducible op script exercising puts, deletes, batches,
+    tombstones, merges and tie-break-heavy timestamps."""
+    keys = [f"k{i}" for i in range(12)]
+    ops = []
+    for _ in range(n_ops):
+        kind = rng.randrange(5)
+        if kind == 0:
+            ops.append(("put", (rng.choice(keys), rng.randrange(100))))
+        elif kind == 1:
+            ops.append(("put_all", ({k: rng.randrange(100)
+                                     for k in rng.sample(keys, 3)},)))
+        elif kind == 2:
+            ops.append(("delete", (rng.choice(keys),)))
+        elif kind == 3:
+            # crafted remote changeset with tie-break-prone timestamps
+            base = 1_700_000_000_000 + rng.randrange(3)
+            node = rng.choice(peers)
+            hlc = Hlc(base, rng.randrange(3), node)
+            cs = {rng.choice(keys): Record(hlc, rng.randrange(100)
+                                           if rng.random() > 0.3 else None,
+                                           hlc)}
+            ops.append(("merge", (cs,)))
+        else:
+            ops.append(("clear", ()))
+    return ops
+
+
+@pytest.mark.parametrize("seed", range(5))
+def test_differential_oracle_vs_tpu(seed):
+    rng = random.Random(seed)
+    ops = _random_ops(rng, peers=["n1", "n2", "zz"])
+    oracle = MapCrdt("abc", wall_clock=FakeClock())
+    tpu = TpuMapCrdt("abc", wall_clock=FakeClock())
+    for op, args in ops:
+        import copy
+        getattr(oracle, op)(*copy.deepcopy(list(args)))
+        getattr(tpu, op)(*copy.deepcopy(list(args)))
+    # Byte-identical wire output — the parity contract.
+    assert oracle.to_json() == tpu.to_json()
+    assert oracle.canonical_time == tpu.canonical_time
+    assert oracle.map == tpu.map
+
+
+@pytest.mark.parametrize("seed", range(3))
+def test_differential_replica_convergence(seed):
+    """3 mixed-backend replicas converge through the wire format."""
+    rng = random.Random(100 + seed)
+    clock = FakeClock()
+    replicas = [MapCrdt("a", wall_clock=clock),
+                TpuMapCrdt("b", wall_clock=clock),
+                TpuMapCrdt("c", wall_clock=clock)]
+    keys = [f"k{i}" for i in range(8)]
+    for _ in range(40):
+        r = rng.choice(replicas)
+        if rng.random() < 0.7:
+            r.put(rng.choice(keys), rng.randrange(1000))
+        else:
+            r.delete(rng.choice(keys))
+    # anti-entropy rounds until fixpoint (pairwise full-state sync)
+    for _ in range(3):
+        for i in range(len(replicas)):
+            for j in range(len(replicas)):
+                if i != j:
+                    replicas[j].merge(replicas[i].record_map())
+    maps = [r.map for r in replicas]
+    assert maps[0] == maps[1] == maps[2]
+    jsons = [json.loads(r.to_json()) for r in replicas]
+    # record-level state (hlc+value) identical everywhere
+    assert jsons[0] == jsons[1] == jsons[2]
