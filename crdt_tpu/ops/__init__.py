@@ -30,9 +30,16 @@ from .packing import NodeTable, pack_logical_time, unpack_logical_time
 from .merge import (Store, Changeset, MergeResult, merge_step,
                     empty_store, grow_store, max_logical_time,
                     delta_mask)
+from .dense import (DenseStore, DenseChangeset, FaninResult,
+                    empty_dense_store, fanin_step, fanin_stream,
+                    dense_delta_mask, dense_max_logical_time,
+                    store_to_changeset)
 
 __all__ = [
     "NodeTable", "pack_logical_time", "unpack_logical_time",
     "Store", "Changeset", "MergeResult", "merge_step", "empty_store",
     "grow_store", "max_logical_time", "delta_mask",
+    "DenseStore", "DenseChangeset", "FaninResult", "empty_dense_store",
+    "fanin_step", "fanin_stream", "dense_delta_mask",
+    "dense_max_logical_time", "store_to_changeset",
 ]

@@ -1,0 +1,229 @@
+"""Dense slot-aligned store + N-replica fan-in lattice join.
+
+This is the TPU-native realization of the reference's replica merge
+protocol (C9) at scale: instead of N replicas converging by N-1
+sequential pairwise ``merge`` calls (crdt.dart:77-94, each O(n_remote)
+with hash lookups), a *dense* changeset batch ``[R, N]`` — R replicas ×
+N key slots — fans into the local store in one fused reduction:
+
+1. **Replica reduce**: per key slot, the winning remote record is the
+   lexicographic ``(lt, node)`` maximum over the R axis, with the
+   LOWEST replica index winning exact ties — exactly what sequential
+   pairwise merging produces (the first replica to merge a record wins;
+   later identical records lose the local-wins-on-tie compare,
+   crdt.dart:84).
+2. **LWW vs local** (crdt.dart:83-84): strict ``(lt, node)`` compare so
+   local wins exact ties.
+3. **Clock absorption + guards** (crdt.dart:82, hlc.dart:80-97): the
+   per-record ``Hlc.recv`` fold collapses to one max-reduction; the
+   duplicate-node / drift guard masks are computed against the running
+   canonical clock (exclusive cummax over the records in r-major
+   order — the order a single sequential merge of the concatenated
+   changesets would visit them), because recv's fast path skips the
+   checks whenever the canonical clock is already ahead (hlc.dart:85).
+4. **Re-stamp** (crdt.dart:86-87): winners keep the remote event hlc;
+   ``modified`` lanes get the final canonical time.
+
+Semantics note: on the *store lanes and canonical clock*,
+``fanin_step`` ≡ ONE ``Crdt.merge`` of the conflict-resolved union of
+the R changesets (ties to the lowest r) — differentially tested against
+the scalar oracle in exactly that formulation. The *guard masks* are
+stricter than a union merge: they visit EVERY record in r-major order
+(like sequential merging, where recv runs for winners and losers alike,
+crdt.dart:82), so a duplicate-node/drift record that would lose its
+per-key conflict still trips — the conservative choice for a safety
+check. Sequential pairwise merging additionally bumps the clock to wall
+time between rounds (crdt.dart:93), which can shield later rounds'
+records from the slow path; the fan-in evaluates all records against
+one pre-bump running clock.
+
+Values ride in an int64 ``val`` lane — either the scalar payload itself
+or an index into a host-side payload table (SURVEY.md §7 hard part 4:
+variable-length values never enter the reduction).
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from .merge import recv_guards
+
+_NEG = -(2 ** 62)
+_I32_NEG = -(2 ** 31)
+
+
+class DenseStore(NamedTuple):
+    """Key-slot-aligned columnar record store: slot i holds key i.
+
+    The dense layout drops the host-side key<->slot dict of
+    `ops.merge.Store` entirely — the natural fit for integer key spaces
+    and for key-space sharding across a device mesh (`crdt_tpu.parallel`).
+    """
+    lt: jax.Array        # int64[N] record hlc logicalTime (0 = never set)
+    node: jax.Array      # int32[N] record hlc node ordinal
+    val: jax.Array       # int64[N] payload (scalar or host-table index)
+    mod_lt: jax.Array    # int64[N] modified logicalTime (local-only lane)
+    mod_node: jax.Array  # int32[N] modified node ordinal
+    occupied: jax.Array  # bool[N]
+    tomb: jax.Array      # bool[N] value is None (record.dart:17)
+
+    @property
+    def n_slots(self) -> int:
+        return self.lt.shape[0]
+
+
+class DenseChangeset(NamedTuple):
+    """R replica changesets over the same N key slots, padded with
+    ``valid=False``. Lane [r, k] is replica r's record for key k."""
+    lt: jax.Array     # int64[R, N]
+    node: jax.Array   # int32[R, N]
+    val: jax.Array    # int64[R, N]
+    tomb: jax.Array   # bool[R, N]
+    valid: jax.Array  # bool[R, N]
+
+
+class FaninResult(NamedTuple):
+    new_canonical: jax.Array   # int64 scalar (pre final-send-bump)
+    win_count: jax.Array       # int32 number of adopted records
+    any_bad: jax.Array         # bool — some recv guard tripped
+    first_bad: jax.Array       # int32 flat r-major index of first offender
+    first_is_dup: jax.Array    # bool — duplicate-node (vs drift) there
+    canonical_at_fail: jax.Array  # int64 canonical BEFORE failing record
+
+
+def empty_dense_store(n_slots: int) -> DenseStore:
+    return DenseStore(
+        lt=jnp.zeros((n_slots,), jnp.int64),
+        node=jnp.zeros((n_slots,), jnp.int32),
+        val=jnp.zeros((n_slots,), jnp.int64),
+        mod_lt=jnp.zeros((n_slots,), jnp.int64),
+        mod_node=jnp.zeros((n_slots,), jnp.int32),
+        occupied=jnp.zeros((n_slots,), bool),
+        tomb=jnp.zeros((n_slots,), bool),
+    )
+
+
+def reduce_replicas(cs: DenseChangeset) -> Tuple[jax.Array, jax.Array,
+                                                 jax.Array, jax.Array,
+                                                 jax.Array]:
+    """Stable lexicographic (lt, node) max over the replica axis.
+
+    Returns per-key ``(best_lt, best_node, best_val, best_tomb,
+    any_valid)``; ties on (lt, node) go to the LOWEST replica index
+    (sequential-merge parity — see module docstring)."""
+    masked_lt = jnp.where(cs.valid, cs.lt, _NEG)
+    best_lt = jnp.max(masked_lt, axis=0)
+    node_masked = jnp.where(masked_lt == best_lt, cs.node, _I32_NEG)
+    best_node = jnp.max(node_masked, axis=0)
+    hit = (masked_lt == best_lt) & (cs.node == best_node)
+    ridx = jnp.argmax(hit, axis=0)  # argmax returns the FIRST hit
+    best_val = jnp.take_along_axis(cs.val, ridx[None, :], axis=0)[0]
+    best_tomb = jnp.take_along_axis(cs.tomb, ridx[None, :], axis=0)[0]
+    any_valid = jnp.any(cs.valid, axis=0)
+    return best_lt, best_node, best_val, best_tomb, any_valid
+
+
+@jax.jit
+def fanin_step(store: DenseStore, cs: DenseChangeset,
+               canonical_lt: jax.Array, local_node: jax.Array,
+               wall_millis: jax.Array
+               ) -> Tuple[DenseStore, FaninResult]:
+    """One fused R-replica fan-in lattice join. See module docstring."""
+    any_bad, first_bad, first_is_dup, canonical_at_fail = recv_guards(
+        cs.lt, cs.node, cs.valid, canonical_lt, local_node, wall_millis)
+
+    best_lt, best_node, best_val, best_tomb, any_valid = reduce_replicas(cs)
+
+    new_canonical = jnp.maximum(
+        canonical_lt, jnp.max(jnp.where(any_valid, best_lt, _NEG)))
+
+    # LWW vs local: strict compare keeps local on exact tie (crdt.dart:84).
+    remote_newer = ((best_lt > store.lt) |
+                    ((best_lt == store.lt) & (best_node > store.node)))
+    win = any_valid & (~store.occupied | remote_newer)
+
+    new_store = DenseStore(
+        lt=jnp.where(win, best_lt, store.lt),
+        node=jnp.where(win, best_node, store.node),
+        val=jnp.where(win, best_val, store.val),
+        mod_lt=jnp.where(win, new_canonical, store.mod_lt),
+        mod_node=jnp.where(win, local_node, store.mod_node),
+        occupied=store.occupied | win,
+        tomb=jnp.where(win, best_tomb, store.tomb),
+    )
+    return new_store, FaninResult(
+        new_canonical=new_canonical,
+        win_count=jnp.sum(win).astype(jnp.int32),
+        any_bad=any_bad,
+        first_bad=first_bad,
+        first_is_dup=first_is_dup,
+        canonical_at_fail=canonical_at_fail,
+    )
+
+
+@jax.jit
+def fanin_stream(store: DenseStore, chunks: DenseChangeset,
+                 canonical_lt: jax.Array, local_node: jax.Array,
+                 wall_millis: jax.Array
+                 ) -> Tuple[DenseStore, FaninResult]:
+    """Streaming fan-in over [C, Rc, N] chunked changesets via lax.scan.
+
+    Replica counts too large for one resident [R, N] batch stream
+    through in chunks; the store is the scan carry. Equivalent to C
+    sequential ``fanin_step`` merges (each chunk's winners are stamped
+    with that chunk's post-absorption canonical time — the same
+    ``modified`` semantics sequential pairwise merging produces,
+    crdt.dart:87)."""
+
+    chunk_size = chunks.lt.shape[1] * chunks.lt.shape[2]
+
+    def step(carry, chunk):
+        st, canon, offset, bad, fb, fd, caf, wins = carry
+        st2, res = fanin_step(st, chunk, canon, local_node, wall_millis)
+        # Keep the FIRST failure's diagnostics across chunks; first_bad is
+        # reported as a GLOBAL flat r-major index across the whole stream.
+        keep_old = bad
+        return (st2, res.new_canonical, offset + chunk_size,
+                bad | res.any_bad,
+                jnp.where(keep_old, fb, offset + res.first_bad),
+                jnp.where(keep_old, fd, res.first_is_dup),
+                jnp.where(keep_old, caf, res.canonical_at_fail),
+                wins + res.win_count), None
+
+    init = (store, canonical_lt, jnp.int32(0),
+            jnp.asarray(False), jnp.int32(0), jnp.asarray(False),
+            jnp.int64(0), jnp.int32(0))
+    (st, canon, _, bad, fb, fd, caf, wins), _ = jax.lax.scan(
+        step, init, chunks)
+    return st, FaninResult(new_canonical=canon, win_count=wins, any_bad=bad,
+                           first_bad=fb, first_is_dup=fd,
+                           canonical_at_fail=caf)
+
+
+@jax.jit
+def dense_delta_mask(store: DenseStore, since_lt: jax.Array) -> jax.Array:
+    """modifiedSince filter — INCLUSIVE bound on the modified lane
+    (map_crdt.dart:44-45)."""
+    return store.occupied & (store.mod_lt >= since_lt)
+
+
+@jax.jit
+def dense_max_logical_time(store: DenseStore) -> jax.Array:
+    """refreshCanonicalTime's reduction (crdt.dart:114-121)."""
+    return jnp.max(jnp.where(store.occupied, store.lt, 0))
+
+
+def store_to_changeset(store: DenseStore,
+                       since_lt: Optional[jax.Array] = None
+                       ) -> DenseChangeset:
+    """Export a store as a 1-replica changeset (the outbound half of the
+    anti-entropy round, crdt.dart:124-135): full state, or the delta of
+    records with ``modified >= since_lt``."""
+    valid = (store.occupied if since_lt is None
+             else dense_delta_mask(store, since_lt))
+    return DenseChangeset(lt=store.lt[None], node=store.node[None],
+                          val=store.val[None], tomb=store.tomb[None],
+                          valid=valid[None])

@@ -93,6 +93,33 @@ def grow_store(store: Store, capacity: int) -> Store:
                    for lane in store))
 
 
+def recv_guards(lt: jax.Array, node: jax.Array, valid: jax.Array,
+                canonical_lt: jax.Array, local_node: jax.Array,
+                wall_millis: jax.Array
+                ) -> tuple[jax.Array, jax.Array, jax.Array, jax.Array]:
+    """Vectorized ``Hlc.recv`` guard masks over a record batch (any shape;
+    visited flattened in row-major order).
+
+    A record reaches the slow path iff its lt exceeds the *running*
+    canonical clock — the exclusive cumulative max over earlier records,
+    because recv's fast path skips all checks whenever the canonical
+    clock is already ahead (hlc.dart:85). There it trips duplicate-node
+    if it carries the local ordinal (hlc.dart:88-90), else drift if >60s
+    ahead of the wall (hlc.dart:92-94). Returns ``(any_bad, first_bad,
+    first_is_dup, canonical_at_fail)`` with flat row-major indices."""
+    flat_lt = jnp.where(valid, lt, _NEG).reshape(-1)
+    incl = jax.lax.cummax(flat_lt)
+    excl = jnp.concatenate([jnp.full((1,), _NEG, jnp.int64), incl[:-1]])
+    running = jnp.maximum(canonical_lt, excl)
+
+    slow = valid.reshape(-1) & (flat_lt > running)
+    dup = slow & (node.reshape(-1) == local_node)
+    drift = slow & ~dup & ((flat_lt >> SHIFT) - wall_millis > MAX_DRIFT)
+    bad = dup | drift
+    first_bad = jnp.argmax(bad).astype(jnp.int32)
+    return jnp.any(bad), first_bad, dup[first_bad], running[first_bad]
+
+
 @jax.jit
 def merge_step(store: Store, cs: Changeset, canonical_lt: jax.Array,
                local_node: jax.Array, wall_millis: jax.Array
@@ -101,19 +128,8 @@ def merge_step(store: Store, cs: Changeset, canonical_lt: jax.Array,
     masked_lt = jnp.where(cs.valid, cs.lt, _NEG)
 
     # --- stage 1: clock absorption + recv guard masks ---
-    incl = jax.lax.cummax(masked_lt)
-    excl = jnp.concatenate([jnp.full((1,), _NEG, jnp.int64), incl[:-1]])
-    running_canonical = jnp.maximum(canonical_lt, excl)
-
-    slow_path = cs.valid & (cs.lt > running_canonical)  # hlc.dart:85
-    dup = slow_path & (cs.node == local_node)           # hlc.dart:88-90
-    drift = slow_path & ~dup & (
-        (cs.lt >> SHIFT) - wall_millis > MAX_DRIFT)     # hlc.dart:92-94
-    bad = dup | drift
-    any_bad = jnp.any(bad)
-    first_bad = jnp.argmax(bad).astype(jnp.int32)
-    first_is_dup = dup[first_bad]
-    canonical_at_fail = running_canonical[first_bad]
+    any_bad, first_bad, first_is_dup, canonical_at_fail = recv_guards(
+        cs.lt, cs.node, cs.valid, canonical_lt, local_node, wall_millis)
 
     new_canonical = jnp.maximum(canonical_lt, jnp.max(masked_lt))
 

@@ -1,0 +1,365 @@
+"""Dense N-replica fan-in: differential tests against the scalar oracle.
+
+`fanin_step` is specified as ONE `Crdt.merge` of the conflict-resolved
+union of the R changesets (ties on identical HLC to the lowest replica
+index) — see crdt_tpu/ops/dense.py docstring. These tests build that
+union in plain Python, run it through the `MapCrdt` oracle, and assert
+lane-for-lane identical results, plus the tie-break/guard/delta
+semantics pinned by SURVEY.md §2's parity checklist.
+"""
+
+import random
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from crdt_tpu import Hlc, MapCrdt, Record
+from crdt_tpu.hlc import MAX_DRIFT, SHIFT
+from crdt_tpu.ops.dense import (DenseChangeset, DenseStore,
+                                dense_delta_mask, dense_max_logical_time,
+                                empty_dense_store, fanin_step, fanin_stream,
+                                store_to_changeset)
+
+from conformance import FakeClock
+
+MILLIS = 1_000_000_000_000
+# Node ordinals: the local store is ordinal 0 in these tests unless noted.
+LOCAL = 0
+
+
+def lt_of(millis, counter=0):
+    return (millis << SHIFT) + counter
+
+
+def make_changeset(r, n, entries):
+    """entries: list of (r, k, lt, node, val, tomb)."""
+    lt = np.zeros((r, n), np.int64)
+    node = np.zeros((r, n), np.int32)
+    val = np.zeros((r, n), np.int64)
+    tomb = np.zeros((r, n), bool)
+    valid = np.zeros((r, n), bool)
+    for (ri, k, l, nd, v, tb) in entries:
+        lt[ri, k], node[ri, k], val[ri, k] = l, nd, v
+        tomb[ri, k], valid[ri, k] = tb, True
+    return DenseChangeset(*(jnp.asarray(a) for a in (lt, node, val, tomb,
+                                                     valid)))
+
+
+def run_step(store, cs, canonical_lt=0, local_node=LOCAL,
+             wall=MILLIS + 10_000):
+    return fanin_step(store, cs, jnp.int64(canonical_lt),
+                      jnp.int32(local_node), jnp.int64(wall))
+
+
+class TestReplicaReduceAndLww:
+    def test_new_keys_adopted(self):
+        store = empty_dense_store(4)
+        cs = make_changeset(2, 4, [
+            (0, 0, lt_of(MILLIS), 3, 7, False),
+            (1, 2, lt_of(MILLIS + 1), 4, 9, True),
+        ])
+        store, res = run_step(store, cs)
+        occ = np.asarray(store.occupied)
+        assert list(occ) == [True, False, True, False]
+        assert int(store.val[0]) == 7
+        assert bool(store.tomb[2])
+        assert int(res.win_count) == 2
+        assert int(res.new_canonical) == lt_of(MILLIS + 1)
+
+    def test_higher_lt_wins_across_replicas(self):
+        store = empty_dense_store(1)
+        cs = make_changeset(3, 1, [
+            (0, 0, lt_of(MILLIS), 1, 10, False),
+            (1, 0, lt_of(MILLIS + 5), 2, 20, False),
+            (2, 0, lt_of(MILLIS + 2), 3, 30, False),
+        ])
+        store, _ = run_step(store, cs)
+        assert int(store.val[0]) == 20
+        assert int(store.node[0]) == 2
+
+    def test_node_ordinal_breaks_lt_tie(self):
+        # Disambiguate using node id (map_crdt_test.dart:59-63).
+        store = empty_dense_store(1)
+        cs = make_changeset(2, 1, [
+            (0, 0, lt_of(MILLIS), 1, 10, False),
+            (1, 0, lt_of(MILLIS), 2, 20, False),
+        ])
+        store, _ = run_step(store, cs)
+        assert int(store.val[0]) == 20
+
+    def test_identical_hlc_first_replica_wins(self):
+        # Sequential-merge parity: first to merge wins; later identical
+        # records lose the local-wins-on-tie compare (crdt.dart:84).
+        store = empty_dense_store(1)
+        cs = make_changeset(3, 1, [
+            (0, 0, lt_of(MILLIS), 2, 111, False),
+            (1, 0, lt_of(MILLIS), 2, 222, False),
+            (2, 0, lt_of(MILLIS), 2, 333, False),
+        ])
+        store, _ = run_step(store, cs)
+        assert int(store.val[0]) == 111
+
+    def test_local_wins_exact_tie(self):
+        # Merge same (map_crdt_test.dart:65-70).
+        store = empty_dense_store(1)
+        cs0 = make_changeset(1, 1, [(0, 0, lt_of(MILLIS), 1, 10, False)])
+        store, _ = run_step(store, cs0)
+        cs1 = make_changeset(1, 1, [(0, 0, lt_of(MILLIS), 1, 99, False)])
+        store, res = run_step(store, cs1, canonical_lt=lt_of(MILLIS))
+        assert int(store.val[0]) == 10
+        assert int(res.win_count) == 0
+
+    def test_local_loses_to_newer(self):
+        store = empty_dense_store(1)
+        cs0 = make_changeset(1, 1, [(0, 0, lt_of(MILLIS), 1, 10, False)])
+        store, _ = run_step(store, cs0)
+        cs1 = make_changeset(1, 1, [(0, 0, lt_of(MILLIS, 1), 1, 99, False)])
+        store, _ = run_step(store, cs1, canonical_lt=lt_of(MILLIS))
+        assert int(store.val[0]) == 99
+
+    def test_tombstone_propagates(self):
+        # Merge deleted item (map_crdt_test.dart:91-96).
+        store = empty_dense_store(1)
+        cs0 = make_changeset(1, 1, [(0, 0, lt_of(MILLIS), 1, 10, False)])
+        store, _ = run_step(store, cs0)
+        cs1 = make_changeset(1, 1, [(0, 0, lt_of(MILLIS, 1), 1, 0, True)])
+        store, _ = run_step(store, cs1, canonical_lt=lt_of(MILLIS))
+        assert bool(store.tomb[0])
+        assert bool(store.occupied[0])  # never physically removed
+
+    def test_modified_stamped_with_final_canonical(self):
+        # Winner re-stamping (crdt.dart:86-87): event hlc kept, modified
+        # lane carries the post-absorption canonical + local ordinal.
+        store = empty_dense_store(2)
+        cs = make_changeset(1, 2, [
+            (0, 0, lt_of(MILLIS), 1, 10, False),
+            (0, 1, lt_of(MILLIS + 7), 2, 20, False),
+        ])
+        store, res = run_step(store, cs)
+        assert int(store.lt[0]) == lt_of(MILLIS)           # event hlc kept
+        assert int(store.mod_lt[0]) == int(res.new_canonical)
+        assert int(store.mod_lt[1]) == int(res.new_canonical)
+        assert int(store.mod_node[0]) == LOCAL
+
+
+class TestRecvGuards:
+    def test_duplicate_node_detected(self):
+        # A remote record ahead of the canonical clock carrying the
+        # local ordinal → DuplicateNode (hlc.dart:88-90).
+        store = empty_dense_store(1)
+        cs = make_changeset(1, 1, [(0, 0, lt_of(MILLIS), LOCAL, 1, False)])
+        _, res = run_step(store, cs, canonical_lt=0)
+        assert bool(res.any_bad) and bool(res.first_is_dup)
+
+    def test_duplicate_node_skipped_when_canonical_ahead(self):
+        # recv fast path SKIPS the duplicate check (hlc.dart:85).
+        store = empty_dense_store(1)
+        cs = make_changeset(1, 1, [(0, 0, lt_of(MILLIS), LOCAL, 1, False)])
+        _, res = run_step(store, cs, canonical_lt=lt_of(MILLIS))
+        assert not bool(res.any_bad)
+
+    def test_drift_detected(self):
+        store = empty_dense_store(1)
+        wall = MILLIS
+        cs = make_changeset(1, 1, [
+            (0, 0, lt_of(wall + MAX_DRIFT + 1), 1, 1, False)])
+        _, res = run_step(store, cs, wall=wall)
+        assert bool(res.any_bad) and not bool(res.first_is_dup)
+
+    def test_drift_at_limit_ok(self):
+        store = empty_dense_store(1)
+        wall = MILLIS
+        cs = make_changeset(1, 1, [
+            (0, 0, lt_of(wall + MAX_DRIFT), 1, 1, False)])
+        _, res = run_step(store, cs, wall=wall)
+        assert not bool(res.any_bad)
+
+    def test_running_canonical_shields_later_duplicates(self):
+        # Record #0 (other node) raises the running canonical above
+        # record #1 (local ordinal) → #1 takes the fast path, no dup.
+        store = empty_dense_store(2)
+        cs = make_changeset(1, 2, [
+            (0, 0, lt_of(MILLIS + 5), 1, 1, False),
+            (0, 1, lt_of(MILLIS), LOCAL, 2, False),
+        ])
+        _, res = run_step(store, cs)
+        assert not bool(res.any_bad)
+
+    def test_guards_fire_on_within_union_losers(self):
+        # Guards visit EVERY record (recv runs for winners and losers,
+        # crdt.dart:82): a duplicate-node record still trips even when a
+        # newer record from another replica wins its key slot.
+        store = empty_dense_store(1)
+        cs = make_changeset(2, 1, [
+            (0, 0, lt_of(MILLIS), LOCAL, 1, False),
+            (1, 0, lt_of(MILLIS + 5), 1, 2, False),
+        ])
+        _, res = run_step(store, cs)
+        assert bool(res.any_bad) and bool(res.first_is_dup)
+
+    def test_first_bad_reports_r_major_order(self):
+        store = empty_dense_store(2)
+        cs = make_changeset(2, 2, [
+            (0, 1, lt_of(MILLIS), LOCAL, 1, False),      # flat index 1
+            (1, 0, lt_of(MILLIS + 99), LOCAL, 1, False),  # flat index 2
+        ])
+        _, res = run_step(store, cs)
+        assert bool(res.any_bad)
+        assert int(res.first_bad) == 1
+
+
+class TestStreamAndDelta:
+    def test_stream_equals_sequential_steps(self):
+        rng = random.Random(7)
+        n, rc, c = 16, 4, 5
+        entries_by_chunk = [
+            [(ri, k, lt_of(MILLIS + rng.randrange(50), rng.randrange(3)),
+              rng.randrange(1, 6), rng.randrange(100), rng.random() < 0.3)
+             for ri in range(rc) for k in range(n) if rng.random() < 0.6]
+            for _ in range(c)]
+        chunk_list = [make_changeset(rc, n, e) for e in entries_by_chunk]
+
+        seq = empty_dense_store(n)
+        canon = jnp.int64(0)
+        for cs in chunk_list:
+            seq, res = fanin_step(seq, cs, canon, jnp.int32(LOCAL),
+                                  jnp.int64(MILLIS + 10_000))
+            canon = res.new_canonical
+
+        stacked = DenseChangeset(*(jnp.stack([getattr(cs, f) for cs in
+                                              chunk_list])
+                                   for f in DenseChangeset._fields))
+        streamed, sres = fanin_stream(empty_dense_store(n), stacked,
+                                      jnp.int64(0), jnp.int32(LOCAL),
+                                      jnp.int64(MILLIS + 10_000))
+        for lane in DenseStore._fields:
+            np.testing.assert_array_equal(np.asarray(getattr(seq, lane)),
+                                          np.asarray(getattr(streamed, lane)))
+        assert int(sres.new_canonical) == int(canon)
+
+    def test_stream_first_bad_is_global_index(self):
+        # first_bad carries the chunk offset: offender in chunk 1 at
+        # within-chunk flat index 1 → global index 1*Rc*N + 1.
+        rc, n = 1, 2
+        clean = make_changeset(rc, n, [(0, 0, lt_of(MILLIS), 1, 1, False)])
+        bad = make_changeset(rc, n, [
+            (0, 1, lt_of(MILLIS + 99), LOCAL, 1, False)])
+        stacked = DenseChangeset(*(jnp.stack([getattr(clean, f),
+                                              getattr(bad, f)])
+                                   for f in DenseChangeset._fields))
+        _, res = fanin_stream(empty_dense_store(n), stacked, jnp.int64(0),
+                              jnp.int32(LOCAL), jnp.int64(MILLIS + 10_000))
+        assert bool(res.any_bad)
+        assert int(res.first_bad) == rc * n + 1
+
+    def test_delta_mask_inclusive(self):
+        # Inclusive modifiedSince bound (map_crdt.dart:44-45).
+        store = empty_dense_store(2)
+        cs = make_changeset(1, 2, [
+            (0, 0, lt_of(MILLIS), 1, 1, False),
+            (0, 1, lt_of(MILLIS + 1), 1, 2, False),
+        ])
+        store, res = run_step(store, cs)
+        at = dense_delta_mask(store, res.new_canonical)
+        assert list(np.asarray(at)) == [True, True]  # == bound kept
+        above = dense_delta_mask(store, res.new_canonical + 1)
+        assert list(np.asarray(above)) == [False, False]
+
+    def test_max_logical_time(self):
+        store = empty_dense_store(3)
+        assert int(dense_max_logical_time(store)) == 0
+        cs = make_changeset(1, 3, [(0, 1, lt_of(MILLIS, 3), 1, 1, False)])
+        store, _ = run_step(store, cs)
+        assert int(dense_max_logical_time(store)) == lt_of(MILLIS, 3)
+
+    def test_store_to_changeset_roundtrip(self):
+        a = empty_dense_store(4)
+        cs = make_changeset(2, 4, [
+            (0, 0, lt_of(MILLIS), 1, 5, False),
+            (1, 3, lt_of(MILLIS + 2), 2, 6, True),
+        ])
+        a, res = run_step(a, cs)
+        b = empty_dense_store(4)
+        b, _ = run_step(b, store_to_changeset(a))
+        for lane in ("lt", "node", "val", "occupied", "tomb"):
+            np.testing.assert_array_equal(np.asarray(getattr(a, lane)),
+                                          np.asarray(getattr(b, lane)))
+
+    def test_store_to_changeset_delta_only(self):
+        a = empty_dense_store(2)
+        a, r1 = run_step(a, make_changeset(
+            1, 2, [(0, 0, lt_of(MILLIS), 1, 5, False)]))
+        a, r2 = run_step(a, make_changeset(
+            1, 2, [(0, 1, lt_of(MILLIS + 9), 2, 6, False)]),
+            canonical_lt=int(r1.new_canonical))
+        delta = store_to_changeset(a, since_lt=r2.new_canonical)
+        valid = np.asarray(delta.valid[0])
+        assert list(valid) == [False, True]
+
+
+class TestDifferentialVsOracle:
+    """fanin_step vs MapCrdt oracle on the conflict-resolved union."""
+
+    @pytest.mark.parametrize("seed", range(5))
+    def test_random_fanin_matches_oracle(self, seed):
+        rng = random.Random(seed)
+        n_keys, n_replicas = 24, 6
+        node_ids = [f"n{chr(ord('a') + i)}" for i in range(n_replicas)]
+        # Ordinals must be order-preserving; 'local' sorts before all.
+        local_id = "aaa-local"
+        table = sorted([local_id] + node_ids)
+        ordinal = {nid: i for i, nid in enumerate(table)}
+
+        entries = []
+        per_replica = [dict() for _ in range(n_replicas)]
+        for ri, nid in enumerate(node_ids):
+            for k in range(n_keys):
+                if rng.random() < 0.55:
+                    continue
+                millis = MILLIS + rng.randrange(20)
+                counter = rng.randrange(4)
+                tomb = rng.random() < 0.25
+                v = rng.randrange(1000)
+                entries.append((ri, k, lt_of(millis, counter), ordinal[nid],
+                                0 if tomb else v, tomb))
+                per_replica[ri][k] = Record(
+                    Hlc(millis, counter, nid), None if tomb else v,
+                    Hlc(millis, counter, nid))
+
+        cs = make_changeset(n_replicas, n_keys, entries)
+        store, res = run_step(empty_dense_store(n_keys), cs,
+                              local_node=ordinal[local_id])
+
+        # Oracle: ONE merge of the union, identical-HLC ties to lowest r.
+        union = {}
+        for ri in range(n_replicas):
+            for k, rec in per_replica[ri].items():
+                cur = union.get(k)
+                if cur is None or rec.hlc > cur.hlc:
+                    union[k] = rec
+        clock = FakeClock(start=MILLIS + 10_000)
+        oracle = MapCrdt(local_id, wall_clock=clock)
+        oracle.merge(union)
+
+        recs = oracle.record_map()
+        for k in range(n_keys):
+            if k not in recs:
+                assert not bool(store.occupied[k])
+                continue
+            rec = recs[k]
+            assert bool(store.occupied[k])
+            assert int(store.lt[k]) == rec.hlc.logical_time
+            assert int(store.node[k]) == ordinal[rec.hlc.node_id]
+            assert bool(store.tomb[k]) == rec.is_deleted
+            if not rec.is_deleted:
+                assert int(store.val[k]) == rec.value
+            assert int(store.mod_lt[k]) == rec.modified.logical_time
+        # Canonical parity: the oracle's final clock is new_canonical put
+        # through the trailing send bump (crdt.dart:93); clock.millis is
+        # the wall value that bump consumed.
+        expected = Hlc.send(
+            Hlc.from_logical_time(int(res.new_canonical), local_id),
+            millis=clock.millis)
+        assert oracle.canonical_time.logical_time == expected.logical_time
