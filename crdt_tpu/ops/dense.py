@@ -106,6 +106,40 @@ def empty_dense_store(n_slots: int) -> DenseStore:
     )
 
 
+def lex_fold(cs: DenseChangeset, lt: jax.Array, node: jax.Array,
+             val: jax.Array, tomb: jax.Array
+             ) -> Tuple[jax.Array, jax.Array, jax.Array, jax.Array,
+                        jax.Array]:
+    """Fold the replica rows into per-key running-best lanes via the
+    strict lexicographic (lt, node) compare.
+
+    Seeded with ``(lt, node, val, tomb)`` — the local store lanes (so
+    the LWW join and the replica reduce fuse into one pass; local keeps
+    exact ties because the compare is strict, crdt.dart:84) or ``_NEG``
+    sentinels (pure reduce). Ties between replica rows go to the LOWEST
+    index — sequential-merge parity (see module docstring). The row
+    loop is Python-unrolled over the static R dimension: each row is
+    one fused elementwise compare+select, no argmax/gather — the shape
+    XLA tiles well on TPU, where int64 lanes are emulated and gather is
+    expensive.
+
+    Returns ``(lt, node, val, tomb, from_row)`` where ``from_row``
+    marks keys whose running best came from a replica row."""
+    from_row = jnp.zeros(lt.shape, bool)
+    for r in range(cs.lt.shape[0]):
+        lt_r = jnp.where(cs.valid[r], cs.lt[r], _NEG)
+        # Mask node as well: at sentinel lt an invalid row must not win
+        # the node tie-break against the sentinel seed.
+        node_r = jnp.where(cs.valid[r], cs.node[r], _I32_NEG)
+        better = (lt_r > lt) | ((lt_r == lt) & (node_r > node))
+        lt = jnp.where(better, lt_r, lt)
+        node = jnp.where(better, cs.node[r], node)
+        val = jnp.where(better, cs.val[r], val)
+        tomb = jnp.where(better, cs.tomb[r], tomb)
+        from_row = from_row | better
+    return lt, node, val, tomb, from_row
+
+
 def reduce_replicas(cs: DenseChangeset) -> Tuple[jax.Array, jax.Array,
                                                  jax.Array, jax.Array,
                                                  jax.Array]:
@@ -113,17 +147,17 @@ def reduce_replicas(cs: DenseChangeset) -> Tuple[jax.Array, jax.Array,
 
     Returns per-key ``(best_lt, best_node, best_val, best_tomb,
     any_valid)``; ties on (lt, node) go to the LOWEST replica index
-    (sequential-merge parity — see module docstring)."""
-    masked_lt = jnp.where(cs.valid, cs.lt, _NEG)
-    best_lt = jnp.max(masked_lt, axis=0)
-    node_masked = jnp.where(masked_lt == best_lt, cs.node, _I32_NEG)
-    best_node = jnp.max(node_masked, axis=0)
-    hit = (masked_lt == best_lt) & (cs.node == best_node)
-    ridx = jnp.argmax(hit, axis=0)  # argmax returns the FIRST hit
-    best_val = jnp.take_along_axis(cs.val, ridx[None, :], axis=0)[0]
-    best_tomb = jnp.take_along_axis(cs.tomb, ridx[None, :], axis=0)[0]
-    any_valid = jnp.any(cs.valid, axis=0)
-    return best_lt, best_node, best_val, best_tomb, any_valid
+    (sequential-merge parity — see module docstring). Keys with no
+    valid record report ``best_lt == _NEG``/``best_node == _I32_NEG``."""
+    n = cs.lt.shape[1]
+    lt, node, val, tomb, any_valid = lex_fold(
+        cs,
+        jnp.full((n,), _NEG, cs.lt.dtype),
+        jnp.full((n,), _I32_NEG, cs.node.dtype),
+        jnp.zeros((n,), cs.val.dtype),
+        jnp.zeros((n,), bool),
+    )
+    return lt, node, val, tomb, any_valid
 
 
 @jax.jit
@@ -135,24 +169,26 @@ def fanin_step(store: DenseStore, cs: DenseChangeset,
     any_bad, first_bad, first_is_dup, canonical_at_fail = recv_guards(
         cs.lt, cs.node, cs.valid, canonical_lt, local_node, wall_millis)
 
-    best_lt, best_node, best_val, best_tomb, any_valid = reduce_replicas(cs)
-
     new_canonical = jnp.maximum(
-        canonical_lt, jnp.max(jnp.where(any_valid, best_lt, _NEG)))
+        canonical_lt, jnp.max(jnp.where(cs.valid, cs.lt, _NEG)))
 
-    # LWW vs local: strict compare keeps local on exact tie (crdt.dart:84).
-    remote_newer = ((best_lt > store.lt) |
-                    ((best_lt == store.lt) & (best_node > store.node)))
-    win = any_valid & (~store.occupied | remote_newer)
+    # Replica reduce + LWW join in ONE fused fold: seed the running best
+    # with the local store lanes (empty slots as _NEG sentinels so any
+    # valid remote beats them; occupied slots win exact ties because the
+    # fold's compare is strict, crdt.dart:84).
+    lt, node, val, tomb, win = lex_fold(
+        cs,
+        jnp.where(store.occupied, store.lt, _NEG),
+        store.node, store.val, store.tomb)
 
     new_store = DenseStore(
-        lt=jnp.where(win, best_lt, store.lt),
-        node=jnp.where(win, best_node, store.node),
-        val=jnp.where(win, best_val, store.val),
+        lt=jnp.where(win, lt, store.lt),
+        node=jnp.where(win, node, store.node),
+        val=val,
         mod_lt=jnp.where(win, new_canonical, store.mod_lt),
         mod_node=jnp.where(win, local_node, store.mod_node),
         occupied=store.occupied | win,
-        tomb=jnp.where(win, best_tomb, store.tomb),
+        tomb=tomb,
     )
     return new_store, FaninResult(
         new_canonical=new_canonical,

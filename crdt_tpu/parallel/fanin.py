@@ -1,0 +1,224 @@
+"""Sharded replica fan-in over a device mesh — C9/C10 on ICI/DCN.
+
+The reference's replication machinery is N replicas converging by
+pairwise JSON exchange over an application transport
+(crdt.dart:77-135, crdt_json.dart:8-37). The TPU-native equivalent
+maps both onto a 2-D ``jax.sharding.Mesh``:
+
+- **key axis** — the record store's key slots are sharded across
+  devices (the scale axis of this framework is keys × replicas,
+  SURVEY.md §5: the "context" being the record store). Each key shard
+  is replicated across the replica axis.
+- **replica axis** — incoming replica changesets are sharded across
+  devices; the per-key lattice join fans in over this axis with XLA
+  collectives riding ICI (cross-slice replica groups ride DCN when the
+  mesh spans slices — same code, the mesh shape decides).
+
+The cross-device reduction is a **lexicographic (lt, node) max**, which
+ICI reductions don't provide natively (SURVEY.md §5); it is composed
+from primitive collectives:
+
+1. ``pmax`` of the per-device best ``lt``;
+2. ``pmax`` of ``node`` masked to devices holding that ``lt`` —
+   node-ordinal tie-break (hlc.dart:158-161);
+3. ``pmin`` of the replica-axis rank masked to devices holding the
+   winning ``(lt, node)`` — stable lowest-rank tie on identical HLCs
+   (sequential-merge parity, see ops/dense.py);
+4. one-hot ``psum`` to broadcast the winner's payload/tombstone lanes.
+
+Guard semantics (documented difference from the single-device path):
+``Hlc.recv``'s fast-path shielding (hlc.dart:85) is evaluated per
+device block — the running canonical clock cummaxes over the records
+*this device* visits, seeded with the pre-merge canonical time. Records
+on one device do not shield records on another, so the sharded guards
+are strictly more sensitive than the r-major sequential visit (they can
+only flag a superset). Store lanes and the canonical clock are
+bit-identical to the single-device ``fanin_step`` either way; detailed
+first-offender diagnostics come from the single-device path.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import NamedTuple, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import P
+from jax.sharding import Mesh, NamedSharding
+
+from ..ops.dense import (DenseChangeset, DenseStore, reduce_replicas,
+                         _NEG, _I32_NEG)
+from ..ops.merge import recv_guards
+
+REPLICA_AXIS = "replica"
+KEY_AXIS = "key"
+
+# Plain int (not a jnp scalar): a module-level concrete array would
+# initialize the jax backend at import time, foreclosing the platform
+# selection entry points need to do first.
+_BIG_RANK = 2 ** 30
+
+
+class ShardedFaninResult(NamedTuple):
+    new_canonical: jax.Array  # int64 scalar (pre final-send-bump)
+    win_count: jax.Array      # int32 adopted records across all shards
+    any_bad: jax.Array        # bool — some recv guard tripped
+    any_dup: jax.Array        # bool — a duplicate-node guard tripped
+    any_drift: jax.Array      # bool — a drift guard tripped
+
+
+def make_fanin_mesh(n_replica_shards: int, n_key_shards: int,
+                    devices=None) -> Mesh:
+    """A (replica, key) mesh over the given/default devices."""
+    import numpy as np
+    devices = np.asarray(devices if devices is not None else jax.devices())
+    assert devices.size == n_replica_shards * n_key_shards, (
+        f"{devices.size} devices != {n_replica_shards}×{n_key_shards}")
+    return Mesh(devices.reshape(n_replica_shards, n_key_shards),
+                (REPLICA_AXIS, KEY_AXIS))
+
+
+def store_sharding(mesh: Mesh) -> NamedSharding:
+    """Store lanes: sharded over keys, replicated over the replica axis."""
+    return NamedSharding(mesh, P(KEY_AXIS))
+
+
+def changeset_sharding(mesh: Mesh) -> NamedSharding:
+    """Changeset lanes [R, N]: replicas × keys over the full mesh."""
+    return NamedSharding(mesh, P(REPLICA_AXIS, KEY_AXIS))
+
+
+def shard_store(store: DenseStore, mesh: Mesh) -> DenseStore:
+    s = store_sharding(mesh)
+    return DenseStore(*(jax.device_put(lane, s) for lane in store))
+
+
+def shard_changeset(cs: DenseChangeset, mesh: Mesh) -> DenseChangeset:
+    s = changeset_sharding(mesh)
+    return DenseChangeset(*(jax.device_put(lane, s) for lane in cs))
+
+
+def _fanin_block(store: DenseStore, cs: DenseChangeset,
+                 canonical_lt: jax.Array, local_node: jax.Array,
+                 wall_millis: jax.Array
+                 ) -> Tuple[DenseStore, ShardedFaninResult]:
+    """Per-device body under shard_map: local reduce, then the
+    lexicographic max fan-in over the replica axis."""
+    # --- per-device guards (see module docstring for semantics) ---
+    any_bad, first_bad, first_is_dup, _ = recv_guards(
+        cs.lt, cs.node, cs.valid, canonical_lt, local_node, wall_millis)
+    any_dup = any_bad & first_is_dup
+    any_drift = any_bad & ~first_is_dup
+    any_bad = jax.lax.pmax(any_bad.astype(jnp.int32),
+                           (REPLICA_AXIS, KEY_AXIS)) > 0
+    any_dup = jax.lax.pmax(any_dup.astype(jnp.int32),
+                           (REPLICA_AXIS, KEY_AXIS)) > 0
+    any_drift = jax.lax.pmax(any_drift.astype(jnp.int32),
+                             (REPLICA_AXIS, KEY_AXIS)) > 0
+
+    # --- local replica reduce on this device's [R_blk, N_blk] block ---
+    best_lt, best_node, best_val, best_tomb, any_valid = reduce_replicas(cs)
+    best_lt = jnp.where(any_valid, best_lt, _NEG)
+    best_node = jnp.where(any_valid, best_node, _I32_NEG)
+
+    # --- cross-device lexicographic (lt, node) max over the replica
+    # axis: pmax lt → masked pmax node → stable pmin rank → one-hot psum
+    # of the winner's payload lanes. All over ICI (DCN across slices). ---
+    m1 = jax.lax.pmax(best_lt, REPLICA_AXIS)
+    node_cand = jnp.where(best_lt == m1, best_node, _I32_NEG)
+    m2 = jax.lax.pmax(node_cand, REPLICA_AXIS)
+    has = (best_lt == m1) & (best_node == m2)
+    rank = jax.lax.axis_index(REPLICA_AXIS)
+    winner_rank = jax.lax.pmin(jnp.where(has, rank, _BIG_RANK),
+                               REPLICA_AXIS)
+    mine = has & (rank == winner_rank)
+    g_val = jax.lax.psum(jnp.where(mine, best_val, 0), REPLICA_AXIS)
+    g_tomb = jax.lax.psum(jnp.where(mine, best_tomb, False
+                                    ).astype(jnp.int32), REPLICA_AXIS) > 0
+    g_any = jax.lax.pmax(any_valid.astype(jnp.int32), REPLICA_AXIS) > 0
+
+    # --- canonical absorption: global max over every record seen ---
+    new_canonical = jnp.maximum(
+        canonical_lt,
+        jax.lax.pmax(jnp.max(jnp.where(g_any, m1, _NEG)),
+                     (REPLICA_AXIS, KEY_AXIS)))
+
+    # --- LWW vs the local key shard (strict: local wins exact ties,
+    # crdt.dart:84). Identical on every device of a key column, so the
+    # replicated store stays consistent without further collectives. ---
+    remote_newer = ((m1 > store.lt) |
+                    ((m1 == store.lt) & (m2 > store.node)))
+    win = g_any & (~store.occupied | remote_newer)
+
+    new_store = DenseStore(
+        lt=jnp.where(win, m1, store.lt),
+        node=jnp.where(win, m2, store.node),
+        val=jnp.where(win, g_val, store.val),
+        mod_lt=jnp.where(win, new_canonical, store.mod_lt),
+        mod_node=jnp.where(win, local_node, store.mod_node),
+        occupied=store.occupied | win,
+        tomb=jnp.where(win, g_tomb, store.tomb),
+    )
+    win_count = jax.lax.psum(jnp.sum(win).astype(jnp.int32), KEY_AXIS)
+    return new_store, ShardedFaninResult(
+        new_canonical=new_canonical, win_count=win_count,
+        any_bad=any_bad, any_dup=any_dup, any_drift=any_drift)
+
+
+def make_sharded_fanin(mesh: Mesh):
+    """Build the jitted sharded fan-in step for a mesh.
+
+    Returns ``step(store, cs, canonical_lt, local_node, wall_millis) ->
+    (new_store, ShardedFaninResult)`` with the store sharded by
+    ``store_sharding(mesh)`` and changesets by
+    ``changeset_sharding(mesh)``.
+    """
+    step = jax.shard_map(
+        _fanin_block,
+        mesh=mesh,
+        in_specs=(
+            DenseStore(*([P(KEY_AXIS)] * len(DenseStore._fields))),
+            DenseChangeset(*([P(REPLICA_AXIS, KEY_AXIS)]
+                             * len(DenseChangeset._fields))),
+            P(), P(), P(),
+        ),
+        out_specs=(
+            DenseStore(*([P(KEY_AXIS)] * len(DenseStore._fields))),
+            ShardedFaninResult(*([P()] * len(ShardedFaninResult._fields))),
+        ),
+        check_vma=False,
+    )
+    return jax.jit(step)
+
+
+def sharded_delta_mask(mesh: Mesh):
+    """modifiedSince filter over the sharded store — INCLUSIVE bound
+    (map_crdt.dart:44-45), computed shard-local (no collectives)."""
+
+    def _mask(store: DenseStore, since_lt: jax.Array) -> jax.Array:
+        return store.occupied & (store.mod_lt >= since_lt)
+
+    return jax.jit(jax.shard_map(
+        _mask, mesh=mesh,
+        in_specs=(DenseStore(*([P(KEY_AXIS)] * len(DenseStore._fields))),
+                  P()),
+        out_specs=P(KEY_AXIS),
+        check_vma=False,
+    ))
+
+
+def sharded_max_logical_time(mesh: Mesh):
+    """refreshCanonicalTime's reduction over the sharded store
+    (crdt.dart:114-121): shard-local max, then one pmax over the mesh."""
+
+    def _max(store: DenseStore) -> jax.Array:
+        local = jnp.max(jnp.where(store.occupied, store.lt, 0))
+        return jax.lax.pmax(local, (REPLICA_AXIS, KEY_AXIS))
+
+    return jax.jit(jax.shard_map(
+        _max, mesh=mesh,
+        in_specs=(DenseStore(*([P(KEY_AXIS)] * len(DenseStore._fields))),),
+        out_specs=P(),
+        check_vma=False,
+    ))
