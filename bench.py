@@ -25,6 +25,8 @@ import jax.numpy as jnp
 
 from crdt_tpu.hlc import SHIFT
 from crdt_tpu.ops.dense import DenseChangeset, empty_dense_store, fanin_step
+from crdt_tpu.ops.pallas_merge import (TILE, pallas_fanin_step,
+                                       split_changeset, split_store)
 
 TARGET = 100e6  # merges/s north star (BASELINE.json)
 _MILLIS = 1_700_000_000_000
@@ -46,8 +48,9 @@ def make_changeset(rc: int, n: int, seed: int) -> DenseChangeset:
 
 
 def build_stream_fn(n_chunks: int):
-    """fori_loop of fan-in steps; each chunk's clocks advance by 1ms so
-    every round has genuine winners (steady-state write path)."""
+    """fori_loop of XLA-fold fan-in steps; each chunk's clocks advance
+    by 1ms so every round has genuine winners (steady-state write
+    path)."""
 
     @jax.jit
     def run(store, cs, canonical, local_node, wall):
@@ -62,12 +65,40 @@ def build_stream_fn(n_chunks: int):
     return run
 
 
+def build_pallas_stream_fn(n_chunks: int):
+    """fori_loop of fused Pallas fan-in steps on split 32-bit lanes —
+    the TPU fast path (no int64 emulation; one VMEM pass per chunk).
+
+    The changeset is reused across chunks: unlike the XLA path the
+    kernel writes every store lane unconditionally (win only selects),
+    so per-chunk HBM traffic is identical whether or not rounds have
+    fresh winners."""
+
+    @jax.jit
+    def run(store, cs, canonical, local_node, wall):
+        sstore = split_store(store)
+        scs = split_changeset(cs)
+
+        def body(i, carry):
+            st, canon = carry
+            st2, res = pallas_fanin_step(st, scs, canon, local_node, wall)
+            return (st2, res.new_canonical)
+
+        return jax.lax.fori_loop(0, n_chunks, body, (sstore, canonical))
+
+    return run
+
+
 def bench(n_keys: int, n_replicas: int, chunk_replicas: int,
-          repeats: int = 3) -> dict:
+          repeats: int = 3, path: str = "auto") -> dict:
+    if path == "auto":
+        on_tpu = jax.devices()[0].platform == "tpu"
+        path = "pallas" if on_tpu and n_keys % TILE == 0 else "xla"
     n_chunks = n_replicas // chunk_replicas
     store = empty_dense_store(n_keys)
     cs = make_changeset(chunk_replicas, n_keys, seed=0)
-    run = build_stream_fn(n_chunks)
+    run = (build_pallas_stream_fn if path == "pallas"
+           else build_stream_fn)(n_chunks)
     args = (store, cs, jnp.int64(_MILLIS << SHIFT), jnp.int32(0),
             jnp.int64(_MILLIS + 10_000))
 
@@ -100,17 +131,19 @@ def main() -> None:
     ap.add_argument("--keys", type=int, default=None)
     ap.add_argument("--replicas", type=int, default=None)
     ap.add_argument("--chunk", type=int, default=None)
+    ap.add_argument("--path", choices=("auto", "xla", "pallas"),
+                    default="auto")
     args = ap.parse_args()
 
     if args.smoke:
-        n_keys, n_replicas, chunk = 4096, 16, 8
+        n_keys, n_replicas, chunk = 1 << 16, 16, 8
     else:
         n_keys, n_replicas, chunk = 1 << 20, 1024, 8
     n_keys = args.keys or n_keys
     n_replicas = args.replicas or n_replicas
     chunk = args.chunk or chunk
 
-    result = bench(n_keys, n_replicas, chunk)
+    result = bench(n_keys, n_replicas, chunk, path=args.path)
     print(json.dumps(result))
 
 

@@ -34,6 +34,9 @@ from .dense import (DenseStore, DenseChangeset, FaninResult,
                     empty_dense_store, fanin_step, fanin_stream,
                     dense_delta_mask, dense_max_logical_time,
                     store_to_changeset)
+from .pallas_merge import (SplitStore, SplitChangeset, PallasFaninResult,
+                           pallas_fanin_step, split_store, split_changeset,
+                           join_store, TILE)
 
 __all__ = [
     "NodeTable", "pack_logical_time", "unpack_logical_time",
@@ -42,4 +45,7 @@ __all__ = [
     "DenseStore", "DenseChangeset", "FaninResult", "empty_dense_store",
     "fanin_step", "fanin_stream", "dense_delta_mask",
     "dense_max_logical_time", "store_to_changeset",
+    "SplitStore", "SplitChangeset", "PallasFaninResult",
+    "pallas_fanin_step", "split_store", "split_changeset", "join_store",
+    "TILE",
 ]

@@ -1,0 +1,295 @@
+"""Pallas TPU kernel for the fan-in lattice join — the hot op, fused.
+
+Why a kernel when XLA already fuses the fold (`ops.dense.lex_fold`):
+
+1. **No int64 emulation.** TPUs have no native 64-bit integers; XLA
+   emulates every int64 compare/select as multi-op int32 sequences. Here
+   the 64-bit logicalTime is carried as SPLIT (hi int32, lo uint32)
+   lanes and the lexicographic LWW compare is
+   ``(hi, lo, node)`` — three native int32/uint32 VPU compares.
+2. **One VMEM pass.** Store lanes, changeset lanes, guard masks, and
+   the win mask are produced in a single tiled sweep: each (R, BLK)
+   changeset tile and its (1, BLK) store tile are resident in VMEM
+   once; XLA's fold reads/writes store lanes across several fusions.
+3. **Drift guard as a compare.** ``(lt >> 16) - wall > MAX_DRIFT`` is
+   algebraically ``lt > (wall + MAX_DRIFT) << 16``; the threshold is
+   split host-side so the in-kernel check is the same three-way lex
+   compare — no 64-bit shifts on device.
+
+Guard semantics match the sharded path (`crdt_tpu.parallel.fanin`):
+recv's fast-path shielding (hlc.dart:85) is evaluated per key column —
+the running clock cummaxes over the rows of this column only, seeded
+with the pre-merge canonical time. Strictly more sensitive than the
+r-major flat order of `ops.dense.fanin_step` (can only flag a
+superset); store lanes and canonical time are bit-identical. On a
+tripped guard, re-run the scalar oracle for first-offender diagnostics.
+
+Empty/invalid encoding: a store slot is empty iff its ``hi`` lane holds
+``NEG_HI`` (no occupied lane on device); an invalid changeset entry is
+pre-masked to sentinels at split time (no valid lane on device).
+Tombstones ride an int32 lane (record.dart:17 semantics).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import NamedTuple, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from ..hlc import MAX_DRIFT, SHIFT
+from .dense import DenseChangeset, DenseStore, _NEG, _I32_NEG
+
+# Sentinel hi word of _NEG = -(2**62): anything real compares greater.
+NEG_HI = jnp.int32(_NEG >> 32)
+NEG_LO = jnp.uint32(_NEG & 0xFFFFFFFF)
+
+
+class SplitStore(NamedTuple):
+    """DenseStore with 64-bit lanes split for native 32-bit compute.
+    Slot empty ⇔ ``hi == NEG_HI``."""
+    hi: jax.Array        # int32[N]  lt >> 32 (NEG_HI = empty)
+    lo: jax.Array        # uint32[N] lt & 0xFFFFFFFF
+    node: jax.Array      # int32[N]
+    val_hi: jax.Array    # int32[N]
+    val_lo: jax.Array    # uint32[N]
+    tomb: jax.Array      # int32[N] 0/1
+    mod_hi: jax.Array    # int32[N]
+    mod_lo: jax.Array    # uint32[N]
+    mod_node: jax.Array  # int32[N]
+
+
+class SplitChangeset(NamedTuple):
+    """[R, N] changeset lanes, invalid entries pre-masked to sentinels."""
+    hi: jax.Array      # int32[R, N] (NEG_HI = invalid)
+    lo: jax.Array      # uint32[R, N]
+    node: jax.Array    # int32[R, N] (_I32_NEG when invalid)
+    val_hi: jax.Array  # int32[R, N]
+    val_lo: jax.Array  # uint32[R, N]
+    tomb: jax.Array    # int32[R, N]
+
+
+def _split64(x: jax.Array) -> Tuple[jax.Array, jax.Array]:
+    return ((x >> 32).astype(jnp.int32),
+            (x & 0xFFFFFFFF).astype(jnp.uint32))
+
+
+def _join64(hi: jax.Array, lo: jax.Array) -> jax.Array:
+    return (hi.astype(jnp.int64) << 32) | lo.astype(jnp.int64)
+
+
+@jax.jit
+def split_store(store: DenseStore) -> SplitStore:
+    lt = jnp.where(store.occupied, store.lt, _NEG)
+    hi, lo = _split64(lt)
+    val_hi, val_lo = _split64(store.val)
+    mod_hi, mod_lo = _split64(store.mod_lt)
+    return SplitStore(hi=hi, lo=lo, node=store.node, val_hi=val_hi,
+                      val_lo=val_lo, tomb=store.tomb.astype(jnp.int32),
+                      mod_hi=mod_hi, mod_lo=mod_lo,
+                      mod_node=store.mod_node)
+
+
+@jax.jit
+def join_store(s: SplitStore) -> DenseStore:
+    occupied = s.hi != NEG_HI
+    return DenseStore(
+        lt=jnp.where(occupied, _join64(s.hi, s.lo), 0),
+        node=s.node, val=_join64(s.val_hi, s.val_lo),
+        mod_lt=_join64(s.mod_hi, s.mod_lo), mod_node=s.mod_node,
+        occupied=occupied, tomb=s.tomb.astype(bool))
+
+
+@jax.jit
+def split_changeset(cs: DenseChangeset) -> SplitChangeset:
+    lt = jnp.where(cs.valid, cs.lt, _NEG)
+    hi, lo = _split64(lt)
+    val_hi, val_lo = _split64(cs.val)
+    return SplitChangeset(
+        hi=hi, lo=lo,
+        node=jnp.where(cs.valid, cs.node, _I32_NEG),
+        val_hi=val_hi, val_lo=val_lo,
+        tomb=cs.tomb.astype(jnp.int32))
+
+
+def _lex_gt(a_hi, a_lo, a_node, b_hi, b_lo, b_node):
+    """(hi, lo, node) strict lexicographic greater-than — native 32-bit."""
+    return ((a_hi > b_hi) |
+            ((a_hi == b_hi) & ((a_lo > b_lo) |
+                               ((a_lo == b_lo) & (a_node > b_node)))))
+
+
+def _fanin_kernel(scalars_ref,
+                  cs_hi, cs_lo, cs_node, cs_vhi, cs_vlo, cs_tomb,
+                  st_hi, st_lo, st_node, st_vhi, st_vlo, st_tomb,
+                  st_mhi, st_mlo, st_mnode,
+                  o_hi, o_lo, o_node, o_vhi, o_vlo, o_tomb,
+                  o_mhi, o_mlo, o_mnode,
+                  win_ref, dup_ref, drift_ref):
+    """One tile: fused fold + guards over cs (R, SB, L) / store (SB, L)
+    blocks (SB×L = sublane×lane tiles, Mosaic-aligned). scalars_ref
+    (SMEM int32): [canon_hi, canon_lo, local_node, thresh_hi, thresh_lo,
+    newcanon_hi, newcanon_lo] (lo words bitcast from uint32)."""
+    i = pl.program_id(0)
+
+    canon_hi = scalars_ref[0]
+    canon_lo = scalars_ref[1].astype(jnp.uint32)
+    local_node = scalars_ref[2]
+    thresh_hi = scalars_ref[3]
+    thresh_lo = scalars_ref[4].astype(jnp.uint32)
+    newc_hi = scalars_ref[5]
+    newc_lo = scalars_ref[6].astype(jnp.uint32)
+
+    b_hi = st_hi[...]
+    b_lo = st_lo[...]
+    b_node = st_node[...]
+    b_vhi = st_vhi[...]
+    b_vlo = st_vlo[...]
+    b_tomb = st_tomb[...]
+    win = jnp.zeros(b_hi.shape, jnp.bool_)
+
+    # Column-local running clock for the recv fast path (hlc.dart:85).
+    run_hi = jnp.full(b_hi.shape, canon_hi, jnp.int32)
+    run_lo = jnp.full(b_hi.shape, canon_lo, jnp.uint32)
+    # Vector accumulators (int32): Mosaic only scalarizes 32-bit types,
+    # so bool-vector -> scalar reductions are deferred to one max at
+    # the end of the tile.
+    acc_dup = jnp.zeros(b_hi.shape, jnp.int32)
+    acc_drift = jnp.zeros(b_hi.shape, jnp.int32)
+
+    for r in range(cs_hi.shape[0]):  # static unroll over replica rows
+        hi = cs_hi[r]
+        lo = cs_lo[r]
+        node = cs_node[r]
+
+        # --- guards (valid rows only: invalid are NEG sentinels and
+        # can never exceed the running clock) ---
+        slow = _lex_gt(hi, lo, jnp.int32(0), run_hi, run_lo, jnp.int32(0))
+        dup = slow & (node == local_node)
+        drift = (slow & ~dup &
+                 _lex_gt(hi, lo, jnp.int32(0),
+                         thresh_hi, thresh_lo, jnp.int32(0)))
+        acc_dup = acc_dup | dup.astype(jnp.int32)
+        acc_drift = acc_drift | drift.astype(jnp.int32)
+        adv = (hi > run_hi) | ((hi == run_hi) & (lo > run_lo))
+        run_hi = jnp.where(adv, hi, run_hi)
+        run_lo = jnp.where(adv, lo, run_lo)
+
+        # --- fused replica reduce + LWW join (strict: earlier rows and
+        # the local store win exact ties, crdt.dart:84) ---
+        gt = _lex_gt(hi, lo, node, b_hi, b_lo, b_node)
+        b_hi = jnp.where(gt, hi, b_hi)
+        b_lo = jnp.where(gt, lo, b_lo)
+        b_node = jnp.where(gt, node, b_node)
+        b_vhi = jnp.where(gt, cs_vhi[r], b_vhi)
+        b_vlo = jnp.where(gt, cs_vlo[r], b_vlo)
+        b_tomb = jnp.where(gt, cs_tomb[r], b_tomb)
+        win = win | gt
+
+    o_hi[...] = b_hi
+    o_lo[...] = b_lo
+    o_node[...] = b_node
+    o_vhi[...] = b_vhi
+    o_vlo[...] = b_vlo
+    o_tomb[...] = b_tomb
+    # Winners: modified = new canonical under the local ordinal
+    # (crdt.dart:86-87).
+    o_mhi[...] = jnp.where(win, newc_hi, st_mhi[...])
+    o_mlo[...] = jnp.where(win, newc_lo, st_mlo[...])
+    o_mnode[...] = jnp.where(win, local_node, st_mnode[...])
+    win_ref[...] = win.astype(jnp.int32)
+
+    # Accumulate guard flags across sequential grid steps.
+    @pl.when(i == 0)
+    def _init():
+        dup_ref[0, 0] = jnp.int32(0)
+        drift_ref[0, 0] = jnp.int32(0)
+
+    dup_ref[0, 0] = dup_ref[0, 0] | jnp.max(acc_dup)
+    drift_ref[0, 0] = drift_ref[0, 0] | jnp.max(acc_drift)
+
+
+class PallasFaninResult(NamedTuple):
+    new_canonical: jax.Array  # int64 scalar (pre final-send-bump)
+    win: jax.Array            # bool[N]
+    any_dup: jax.Array        # bool
+    any_drift: jax.Array      # bool
+
+
+# Tile geometry: (sublane, lane) = (8, 512) int32 tiles, the Mosaic
+# alignment floor for 32-bit types (sublane % 8 == 0, lane % 128 == 0).
+_SB = 8
+_LANE = 512
+TILE = _SB * _LANE  # n_slots must be a multiple of this
+
+
+@partial(jax.jit, static_argnames=("interpret",))
+def pallas_fanin_step(store: SplitStore, cs: SplitChangeset,
+                      canonical_lt: jax.Array, local_node: jax.Array,
+                      wall_millis: jax.Array, *,
+                      interpret: bool = False
+                      ) -> Tuple[SplitStore, PallasFaninResult]:
+    """Fused fan-in on split lanes. Same store-lane/canonical results as
+    `ops.dense.fanin_step`; guard flags per the module docstring.
+    ``n_slots`` must be a multiple of ``TILE`` (= 4096)."""
+    r, n = cs.hi.shape
+    assert n % TILE == 0, (n, TILE)
+    rows = n // _LANE
+
+    # New canonical time first (the kernel stamps winners with it):
+    # cheap two-lane max over the pre-masked hi/lo (invalid = NEG).
+    m_hi = jnp.max(cs.hi)
+    m_lo = jnp.max(jnp.where(cs.hi == m_hi, cs.lo, 0))
+    new_canonical = jnp.maximum(canonical_lt, _join64(m_hi, m_lo))
+    newc_hi, newc_lo = _split64(new_canonical)
+
+    canon_hi, canon_lo = _split64(canonical_lt)
+    thresh_hi, thresh_lo = _split64((wall_millis + MAX_DRIFT) << SHIFT)
+    scalars = jnp.stack([
+        canon_hi, canon_lo.astype(jnp.int32), local_node,
+        thresh_hi, thresh_lo.astype(jnp.int32),
+        newc_hi, newc_lo.astype(jnp.int32)]).astype(jnp.int32)
+
+    # Index maps cast to int32: with jax_enable_x64 (required for the
+    # int64 host lanes) plain Python ints trace as i64, which Mosaic
+    # refuses to return from an index-map function.
+    _i32 = jnp.int32
+    cs_spec = pl.BlockSpec((r, _SB, _LANE),
+                           lambda i: (_i32(0), _i32(i), _i32(0)),
+                           memory_space=pltpu.VMEM)
+    st_spec = pl.BlockSpec((_SB, _LANE), lambda i: (_i32(i), _i32(0)),
+                           memory_space=pltpu.VMEM)
+    flag_spec = pl.BlockSpec((1, 1), lambda i: (_i32(0), _i32(0)),
+                             memory_space=pltpu.SMEM)
+
+    st2d = [lane.reshape(rows, _LANE) for lane in store]
+    cs3d = [lane.reshape(r, rows, _LANE) for lane in cs]
+
+    out_shapes = (
+        [jax.ShapeDtypeStruct((rows, _LANE), lane.dtype) for lane in st2d] +
+        [jax.ShapeDtypeStruct((rows, _LANE), jnp.int32),  # win
+         jax.ShapeDtypeStruct((1, 1), jnp.int32),         # any_dup
+         jax.ShapeDtypeStruct((1, 1), jnp.int32)])        # any_drift
+
+    outs = pl.pallas_call(
+        _fanin_kernel,
+        grid=(rows // _SB,),
+        in_specs=([pl.BlockSpec((7,), lambda i: (_i32(0),),
+                                memory_space=pltpu.SMEM)] +
+                  [cs_spec] * 6 + [st_spec] * 9),
+        out_specs=tuple([st_spec] * 9 + [st_spec, flag_spec, flag_spec]),
+        out_shape=tuple(out_shapes),
+        input_output_aliases={1 + 6 + j: j for j in range(9)},
+        interpret=interpret,
+    )(scalars, *cs3d, *st2d)
+
+    new_store = SplitStore(*(o.reshape(n) for o in outs[:9]))
+    return new_store, PallasFaninResult(
+        new_canonical=new_canonical,
+        win=outs[9].reshape(n).astype(bool),
+        any_dup=outs[10][0, 0] > 0,
+        any_drift=outs[11][0, 0] > 0,
+    )

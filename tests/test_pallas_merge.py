@@ -1,0 +1,170 @@
+"""Pallas fan-in kernel vs the XLA fold — bit-identical store lanes.
+
+Runs in interpreter mode on CPU (the kernel itself targets TPU; the
+driver's bench exercises the compiled path on hardware).
+"""
+
+import random
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from crdt_tpu.ops.dense import (DenseStore, empty_dense_store, fanin_step)
+from crdt_tpu.ops.pallas_merge import (join_store, pallas_fanin_step,
+                                       split_changeset, split_store)
+
+from test_dense import LOCAL, MILLIS, lt_of, make_changeset
+
+from crdt_tpu.ops.pallas_merge import TILE as BLOCK
+
+
+def run_both(store, cs, canonical_lt=0, local_node=LOCAL,
+             wall=MILLIS + 10_000):
+    ref_store, ref_res = fanin_step(store, cs, jnp.int64(canonical_lt),
+                                    jnp.int32(local_node), jnp.int64(wall))
+    pl_store, pl_res = pallas_fanin_step(
+        split_store(store), split_changeset(cs), jnp.int64(canonical_lt),
+        jnp.int32(local_node), jnp.int64(wall),
+        interpret=True)
+    return ref_store, ref_res, join_store(pl_store), pl_res
+
+
+def assert_stores_equal(a: DenseStore, b: DenseStore):
+    occ = np.asarray(a.occupied)
+    np.testing.assert_array_equal(occ, np.asarray(b.occupied))
+    for lane in ("lt", "node", "val", "mod_lt", "mod_node", "tomb"):
+        # Unoccupied slots: dense keeps zeros, split keeps sentinels —
+        # only occupied slots are observable (record_map filters).
+        np.testing.assert_array_equal(
+            np.asarray(getattr(a, lane))[occ],
+            np.asarray(getattr(b, lane))[occ], err_msg=lane)
+
+
+@pytest.mark.parametrize("seed", range(4))
+def test_random_matches_xla_fold(seed):
+    rng = random.Random(seed)
+    r, n = 5, 2 * BLOCK
+    entries = []
+    for ri in range(r):
+        for k in range(n):
+            if rng.random() < 0.5:
+                continue
+            entries.append((ri, k,
+                            lt_of(MILLIS + rng.randrange(40),
+                                  rng.randrange(3)),
+                            rng.randrange(1, 6), rng.randrange(1000),
+                            rng.random() < 0.3))
+    cs = make_changeset(r, n, entries)
+    ref_store, ref_res, pl_store, pl_res = run_both(empty_dense_store(n), cs)
+
+    assert_stores_equal(ref_store, pl_store)
+    assert int(pl_res.new_canonical) == int(ref_res.new_canonical)
+    # From an empty store every occupied slot is a winner.
+    np.testing.assert_array_equal(np.asarray(pl_res.win),
+                                  np.asarray(ref_store.occupied))
+    assert int(np.sum(np.asarray(pl_res.win))) == int(ref_res.win_count)
+    assert not bool(pl_res.any_dup) and not bool(pl_res.any_drift)
+
+
+def test_sequential_merges_accumulate():
+    # Two consecutive kernel steps on the same split store: LWW holds
+    # across steps (older second write loses; newer wins).
+    n = BLOCK
+    s = split_store(empty_dense_store(n))
+    cs1 = make_changeset(1, n, [(0, 0, lt_of(MILLIS + 5), 2, 10, False),
+                                (0, 1, lt_of(MILLIS + 5), 2, 11, False)])
+    s, r1 = pallas_fanin_step(s, split_changeset(cs1), jnp.int64(0),
+                              jnp.int32(LOCAL), jnp.int64(MILLIS + 10_000),
+                              interpret=True)
+    cs2 = make_changeset(1, n, [(0, 0, lt_of(MILLIS), 3, 99, False),
+                                (0, 2, lt_of(MILLIS + 9), 3, 12, False)])
+    s, r2 = pallas_fanin_step(s, split_changeset(cs2), r1.new_canonical,
+                              jnp.int32(LOCAL), jnp.int64(MILLIS + 10_000),
+                              interpret=True)
+    out = join_store(s)
+    assert int(out.val[0]) == 10      # older write lost
+    assert int(out.val[2]) == 12      # new key adopted
+    assert int(r2.new_canonical) == lt_of(MILLIS + 9)
+
+
+def test_local_wins_exact_tie():
+    n = BLOCK
+    cs1 = make_changeset(1, n, [(0, 0, lt_of(MILLIS), 2, 10, False)])
+    s = split_store(empty_dense_store(n))
+    s, r1 = pallas_fanin_step(s, split_changeset(cs1), jnp.int64(0),
+                              jnp.int32(LOCAL), jnp.int64(MILLIS + 10_000),
+                              interpret=True)
+    cs2 = make_changeset(1, n, [(0, 0, lt_of(MILLIS), 2, 99, False)])
+    s, _ = pallas_fanin_step(s, split_changeset(cs2), r1.new_canonical,
+                             jnp.int32(LOCAL), jnp.int64(MILLIS + 10_000),
+                             interpret=True)
+    assert int(join_store(s).val[0]) == 10
+
+
+def test_tombstone_and_node_tiebreak():
+    n = BLOCK
+    cs = make_changeset(3, n, [
+        (0, 0, lt_of(MILLIS), 1, 10, False),
+        (1, 0, lt_of(MILLIS), 2, 0, True),    # same lt, higher node: wins
+        (2, 1, lt_of(MILLIS), 2, 7, False),
+    ])
+    s, _ = pallas_fanin_step(split_store(empty_dense_store(n)),
+                             split_changeset(cs), jnp.int64(0),
+                             jnp.int32(LOCAL), jnp.int64(MILLIS + 10_000),
+                             interpret=True)
+    out = join_store(s)
+    assert bool(out.tomb[0]) and int(out.node[0]) == 2
+    assert int(out.val[1]) == 7
+
+
+def test_guards():
+    n = BLOCK
+    # Duplicate node ahead of canonical → any_dup.
+    cs = make_changeset(1, n, [(0, 0, lt_of(MILLIS), LOCAL, 1, False)])
+    _, res = pallas_fanin_step(split_store(empty_dense_store(n)),
+                               split_changeset(cs), jnp.int64(0),
+                               jnp.int32(LOCAL), jnp.int64(MILLIS + 10_000),
+                               interpret=True)
+    assert bool(res.any_dup) and not bool(res.any_drift)
+
+    # Same record with canonical already ahead → fast path, no guard.
+    _, res = pallas_fanin_step(split_store(empty_dense_store(n)),
+                               split_changeset(cs),
+                               jnp.int64(lt_of(MILLIS)), jnp.int32(LOCAL),
+                               jnp.int64(MILLIS + 10_000),
+                               interpret=True)
+    assert not bool(res.any_dup)
+
+    # >60s ahead of the wall → drift.
+    from crdt_tpu.hlc import MAX_DRIFT
+    wall = MILLIS
+    cs = make_changeset(1, n, [
+        (0, 0, lt_of(wall + MAX_DRIFT + 1), 1, 1, False)])
+    _, res = pallas_fanin_step(split_store(empty_dense_store(n)),
+                               split_changeset(cs), jnp.int64(0),
+                               jnp.int32(LOCAL), jnp.int64(wall),
+                               interpret=True)
+    assert bool(res.any_drift) and not bool(res.any_dup)
+
+    # Column-local shielding: an earlier row in the SAME column lifts
+    # the running clock past the local-ordinal record → no dup.
+    cs = make_changeset(2, n, [
+        (0, 0, lt_of(MILLIS + 5), 1, 1, False),
+        (1, 0, lt_of(MILLIS), LOCAL, 2, False),
+    ])
+    _, res = pallas_fanin_step(split_store(empty_dense_store(n)),
+                               split_changeset(cs), jnp.int64(0),
+                               jnp.int32(LOCAL), jnp.int64(MILLIS + 10_000),
+                               interpret=True)
+    assert not bool(res.any_dup)
+
+
+def test_split_roundtrip():
+    n = BLOCK
+    cs = make_changeset(2, n, [(0, 3, lt_of(MILLIS, 2), 4, 123, False),
+                               (1, 4, lt_of(MILLIS), 5, 0, True)])
+    store, _ = fanin_step(empty_dense_store(n), cs, jnp.int64(0),
+                          jnp.int32(LOCAL), jnp.int64(MILLIS + 10_000))
+    assert_stores_equal(store, join_store(split_store(store)))
