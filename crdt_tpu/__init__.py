@@ -28,6 +28,8 @@ from .crdt_json import CrdtJson, dart_str
 from .watch import ChangeEvent, ChangeStream
 from .models.map_crdt import MapCrdt
 from .models.tpu_map_crdt import TpuMapCrdt
+from .sync import sync, sync_json
+from .checkpoint import load_dense, load_json, save_dense, save_json
 
 __version__ = "0.1.0"
 
@@ -37,4 +39,6 @@ __all__ = [
     "Record", "KeyDecoder", "KeyEncoder", "NodeIdDecoder", "ValueDecoder",
     "ValueEncoder", "Crdt", "CrdtJson", "dart_str", "ChangeEvent",
     "ChangeStream", "MapCrdt", "TpuMapCrdt",
+    "sync", "sync_json",
+    "load_dense", "load_json", "save_dense", "save_json",
 ]

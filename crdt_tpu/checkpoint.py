@@ -1,0 +1,84 @@
+"""Checkpoint / resume (SURVEY.md §5).
+
+The reference's checkpoint mechanism IS its wire format: ``toJson`` is
+the snapshot, ``mergeJson`` the restore, and construction-time
+``refreshCanonicalTime`` the resume path (crdt.dart:31-33,100-135) —
+persistent backends subclass `Crdt` (README.md:39). That path is kept
+verbatim here (:func:`save_json` / :func:`load_json`), plus what the
+reference can't have: a **columnar native snapshot** of the packed
+device lanes (:func:`save_dense` / :func:`load_dense`) that round-trips
+a `DenseStore` through one ``npz`` file without per-record encoding.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Any, Callable, Optional, Type
+
+import numpy as np
+
+import jax.numpy as jnp
+
+from .crdt import Crdt
+from .ops.dense import DenseStore
+from .record import (KeyDecoder, KeyEncoder, ValueDecoder, ValueEncoder)
+
+
+def save_json(crdt: Crdt, path: str,
+              key_encoder: Optional[KeyEncoder] = None,
+              value_encoder: Optional[ValueEncoder] = None) -> None:
+    """Snapshot via the wire format — full state including tombstones
+    (crdt.dart:124-135). Any conformant backend can restore it."""
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
+        f.write(crdt.to_json(key_encoder=key_encoder,
+                             value_encoder=value_encoder))
+    os.replace(tmp, path)
+
+
+def load_json(cls: Type[Crdt], node_id: Any, path: str,
+              key_decoder: Optional[KeyDecoder] = None,
+              value_decoder: Optional[ValueDecoder] = None,
+              wall_clock: Optional[Callable[[], int]] = None,
+              **kwargs) -> Crdt:
+    """Restore a replica from its own snapshot.
+
+    This is the reference's resume-from-storage path — records are
+    seeded into the backend and the canonical clock is rebuilt from
+    their max logical time (`refreshCanonicalTime`, crdt.dart:31-33,
+    114-121). NOT a merge: merging records you authored back into a
+    fresh replica with the same node id trips the duplicate-node guard
+    by design (hlc.dart:88-90). To ingest ANOTHER replica's snapshot,
+    use ``crdt.merge_json`` directly."""
+    from . import crdt_json
+    from .hlc import Hlc
+
+    with open(path) as f:
+        records = crdt_json.decode(
+            f.read(), Hlc.zero(node_id),
+            key_decoder=key_decoder, value_decoder=value_decoder,
+            now_millis=wall_clock() if wall_clock else None)
+    return cls(node_id, seed=records, wall_clock=wall_clock, **kwargs)
+
+
+_DENSE_MAGIC = "crdt_tpu/dense-store@1"
+
+
+def save_dense(store: DenseStore, path: str) -> None:
+    """Columnar snapshot: one compressed npz of the seven lanes."""
+    tmp = path + ".tmp"
+    with open(tmp, "wb") as f:
+        np.savez_compressed(
+            f, magic=np.array(_DENSE_MAGIC),
+            **{lane: np.asarray(getattr(store, lane))
+               for lane in DenseStore._fields})
+    os.replace(tmp, path)
+
+
+def load_dense(path: str) -> DenseStore:
+    with np.load(path) as z:
+        if str(z["magic"]) != _DENSE_MAGIC:
+            raise ValueError(f"not a dense-store snapshot: {path}")
+        return DenseStore(**{lane: jnp.asarray(z[lane])
+                             for lane in DenseStore._fields})

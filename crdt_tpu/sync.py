@@ -1,0 +1,57 @@
+"""Anti-entropy sync rounds (C10) — the reference's replication protocol
+as a library utility.
+
+The reference keeps the sync round in its tests
+(`test/map_crdt_test.dart:273-279`): capture the local canonical time,
+full-push to the remote, then delta-pull everything the remote modified
+at-or-after that time (inclusive bound, map_crdt.dart:44-45). Three-node
+convergence through an intermediary relies on merged records being
+re-stamped with the relay's ``modified`` time (crdt.dart:87) — the
+relay's deltas then include records it learned from others.
+
+Two transports:
+
+- :func:`sync` — in-process record maps (replicas share a process, the
+  reference's own test topology).
+- :func:`sync_json` — the JSON wire format (crdt_json.dart), what
+  crosses a real replica boundary; transport remains the application's
+  job (example/crdt_example.dart:21-25).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from .crdt import Crdt
+from .record import (KeyDecoder, KeyEncoder, ValueDecoder, ValueEncoder)
+
+
+def sync(local: Crdt, remote: Crdt) -> None:
+    """One push/pull anti-entropy round between two in-process replicas.
+
+    After a round in each direction (or one round plus a later reverse
+    round) the two replicas converge; N replicas converge through any
+    connected gossip topology."""
+    time = local.canonical_time
+    remote.merge(local.record_map())
+    local.merge(remote.record_map(modified_since=time))
+
+
+def sync_json(local: Crdt, remote: Crdt,
+              key_encoder: Optional[KeyEncoder] = None,
+              value_encoder: Optional[ValueEncoder] = None,
+              key_decoder: Optional[KeyDecoder] = None,
+              value_decoder: Optional[ValueDecoder] = None) -> None:
+    """The same round over the JSON wire format — full-state push, then
+    delta pull keyed on the pre-push canonical time (crdt.dart:124-135).
+    """
+    time = local.canonical_time
+    remote.merge_json(local.to_json(key_encoder=key_encoder,
+                                    value_encoder=value_encoder),
+                      key_decoder=key_decoder,
+                      value_decoder=value_decoder)
+    local.merge_json(remote.to_json(modified_since=time,
+                                    key_encoder=key_encoder,
+                                    value_encoder=value_encoder),
+                     key_decoder=key_decoder,
+                     value_decoder=value_decoder)

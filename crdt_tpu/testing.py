@@ -1,0 +1,193 @@
+"""Backend-agnostic CRDT conformance kit (C14) — EXPORTED API.
+
+Port of the reference's exported parameterized suite
+`test/crdt_test.dart:7-132`: any storage backend (in-tree or
+out-of-tree, the README.md:39 plugin pattern) subclasses
+:class:`CrdtConformance`, provides ``make_crdt()``, and inherits the
+full behavioral test set under pytest — the same mechanism the
+reference uses to keep external backends like hive_crdt conformant
+(CHANGELOG.md:16). :class:`FakeClock` is the deterministic wall clock
+every test should inject (the reference's own millis-injection pattern,
+hlc_test.dart:185).
+"""
+
+
+from __future__ import annotations
+
+import itertools
+
+from crdt_tpu import Crdt
+
+
+class FakeClock:
+    """Deterministic, strictly advancing wall clock for tests.
+
+    The reference's tests order events with real sleeps
+    (map_crdt_test.dart:248); injecting millis is the deterministic
+    equivalent and is the reference's own pattern for clock tests
+    (hlc_test.dart:185).
+    """
+
+    def __init__(self, start: int = 1_700_000_000_000, step: int = 1):
+        self._millis = start
+        self._step = step
+
+    def __call__(self) -> int:
+        self._millis += self._step
+        return self._millis
+
+    def advance(self, millis: int) -> None:
+        self._millis += millis
+
+    @property
+    def millis(self) -> int:
+        return self._millis
+
+
+class CrdtConformance:
+    """Inherit and implement ``make_crdt`` to run the conformance suite."""
+
+    node_id = "abc"
+
+    def make_crdt(self) -> Crdt:
+        raise NotImplementedError
+
+    # --- Basic (crdt_test.dart:13-94) ---
+
+    def test_node_id(self):
+        assert self.make_crdt().node_id == self.node_id
+
+    def test_empty(self):
+        crdt = self.make_crdt()
+        assert crdt.is_empty
+        assert crdt.length == 0
+        assert crdt.map == {}
+        assert crdt.keys == []
+        assert crdt.values == []
+
+    def test_one_record(self):
+        crdt = self.make_crdt()
+        crdt.put("x", 1)
+        assert not crdt.is_empty
+        assert crdt.length == 1
+        assert crdt.map == {"x": 1}
+        assert crdt.keys == ["x"]
+        assert crdt.values == [1]
+
+    def test_empty_after_deleted_record(self):
+        crdt = self.make_crdt()
+        crdt.put("x", 1)
+        crdt.delete("x")
+        assert crdt.is_empty
+        assert crdt.length == 0
+        assert crdt.map == {}
+        assert crdt.keys == []
+        assert crdt.values == []
+
+    def test_put(self):
+        crdt = self.make_crdt()
+        crdt.put("x", 1)
+        assert crdt.get("x") == 1
+
+    def test_update_existing(self):
+        crdt = self.make_crdt()
+        crdt.put("x", 1)
+        crdt.put("x", 2)
+        assert crdt.get("x") == 2
+
+    def test_put_many(self):
+        crdt = self.make_crdt()
+        crdt.put_all({"x": 2, "y": 3})
+        assert crdt.get("x") == 2
+        assert crdt.get("y") == 3
+
+    def test_put_all_single_timestamp(self):
+        # One send per batch: all records share one HLC (crdt.dart:50-52).
+        crdt = self.make_crdt()
+        crdt.put_all({"x": 2, "y": 3})
+        assert crdt.get_record("x").hlc == crdt.get_record("y").hlc
+
+    def test_delete_value(self):
+        crdt = self.make_crdt()
+        crdt.put("x", 1)
+        crdt.put("y", 2)
+        crdt.delete("x")
+        assert crdt.is_deleted("x") is True
+        assert crdt.is_deleted("y") is False
+        assert crdt.get("x") is None
+        assert crdt.get("y") == 2
+
+    def test_is_deleted_missing_key(self):
+        assert self.make_crdt().is_deleted("nope") is None
+
+    def test_clear(self):
+        crdt = self.make_crdt()
+        crdt.put("x", 1)
+        crdt.put("y", 2)
+        crdt.clear()
+        assert crdt.is_deleted("x") is True
+        assert crdt.is_deleted("y") is True
+        assert crdt.get("x") is None
+        assert crdt.get("y") is None
+
+    def test_clear_purge(self):
+        crdt = self.make_crdt()
+        crdt.put("x", 1)
+        crdt.clear(purge=True)
+        assert crdt.record_map() == {}
+
+    def test_contains_key(self):
+        crdt = self.make_crdt()
+        crdt.put("x", 1)
+        assert crdt.contains_key("x")
+        assert not crdt.contains_key("y")
+
+    # --- Watch (crdt_test.dart:96-131) ---
+
+    def test_watch_all_changes(self):
+        crdt = self.make_crdt()
+        stream = crdt.watch().record()
+        crdt.put("x", 1)
+        crdt.put("y", 2)
+        got = {(e.key, e.value) for e in stream.events}
+        assert {("x", 1), ("y", 2)} <= got
+
+    def test_watch_key(self):
+        crdt = self.make_crdt()
+        stream = crdt.watch(key="y").record()
+        crdt.put("x", 1)
+        crdt.put("y", 2)
+        assert [(e.key, e.value) for e in stream.events] == [("y", 2)]
+
+    # --- Merge algebra: the CRDT laws (SURVEY.md §5 race-detection
+    # equivalent — commutativity/associativity/idempotence under
+    # permutation, map_crdt_test.dart:252-269 in spirit) ---
+
+    def _seeded_changesets(self):
+        from crdt_tpu import Hlc, Record
+        base = 1_700_000_000_000
+        mk = lambda ms, c, n, v: Record(Hlc(ms, c, n), v, Hlc(ms, c, n))
+        cs1 = {"x": mk(base + 5, 0, "nodeA", 1), "y": mk(base + 1, 0, "nodeA", 7)}
+        cs2 = {"x": mk(base + 5, 0, "nodeB", 2), "z": mk(base + 3, 1, "nodeB", None)}
+        cs3 = {"y": mk(base + 9, 2, "nodeC", 9), "z": mk(base + 3, 0, "nodeC", 4)}
+        return [cs1, cs2, cs3]
+
+    def test_merge_commutative_associative(self):
+        changesets = self._seeded_changesets()
+        results = []
+        for perm in itertools.permutations(range(3)):
+            crdt = self.make_crdt()
+            for i in perm:
+                crdt.merge(dict(self._seeded_changesets()[i]))
+            results.append({k: (r.hlc, r.value)
+                            for k, r in crdt.record_map().items()})
+        assert all(r == results[0] for r in results[1:])
+
+    def test_merge_idempotent(self):
+        cs = self._seeded_changesets()[0]
+        crdt = self.make_crdt()
+        crdt.merge(dict(cs))
+        snapshot = {k: (r.hlc, r.value) for k, r in crdt.record_map().items()}
+        crdt.merge(dict(self._seeded_changesets()[0]))
+        again = {k: (r.hlc, r.value) for k, r in crdt.record_map().items()}
+        assert snapshot == again
