@@ -32,18 +32,29 @@ TARGET = 100e6  # merges/s north star (BASELINE.json)
 _MILLIS = 1_700_000_000_000
 
 
-def make_changeset(rc: int, n: int, seed: int) -> DenseChangeset:
-    """Device-generated random changeset: mixed writers, 30% tombstones,
-    80% fill (the benchmark's realistic sparse-delta shape)."""
+def make_changeset(rc: int, n: int, seed: int, tomb_ratio: float = 0.3,
+                   millis_spread: int = 1000, counter_spread: int = 4,
+                   fill: float = 0.8) -> DenseChangeset:
+    """Device-generated random changeset. Defaults model the realistic
+    sparse-delta shape (mixed writers, 30% tombstones, 80% fill); the
+    knobs produce the BASELINE.json stress configs:
+
+    - ``tomb_ratio=0.5`` — tombstone-heavy merge (config 3).
+    - ``millis_spread=1, counter_spread=2`` — HLC tie-break stress: most
+      records collide on logicalTime and resolve via the node ordinal
+      (config 4, hlc.dart:158-161).
+    """
     k = jax.random.split(jax.random.key(seed), 5)
-    lt = ((_MILLIS + jax.random.randint(k[0], (rc, n), 0, 1000, jnp.int64))
-          << SHIFT) + jax.random.randint(k[1], (rc, n), 0, 4, jnp.int64)
+    lt = ((_MILLIS + jax.random.randint(k[0], (rc, n), 0, millis_spread,
+                                        jnp.int64))
+          << SHIFT) + jax.random.randint(k[1], (rc, n), 0, counter_spread,
+                                         jnp.int64)
     return DenseChangeset(
         lt=lt,
         node=jax.random.randint(k[2], (rc, n), 1, 9, jnp.int32),
         val=lt,  # payload content doesn't affect the join cost
-        tomb=jax.random.uniform(k[3], (rc, n)) < 0.3,
-        valid=jax.random.uniform(k[4], (rc, n)) < 0.8,
+        tomb=jax.random.uniform(k[3], (rc, n)) < tomb_ratio,
+        valid=jax.random.uniform(k[4], (rc, n)) < fill,
     )
 
 
@@ -89,14 +100,23 @@ def build_pallas_stream_fn(n_chunks: int):
     return run
 
 
+# BASELINE.json stress configs as changeset knobs (see make_changeset).
+CONFIGS = {
+    "fanin": dict(),
+    "tombstone": dict(tomb_ratio=0.5),
+    "tiebreak": dict(millis_spread=1, counter_spread=2),
+}
+
+
 def bench(n_keys: int, n_replicas: int, chunk_replicas: int,
-          repeats: int = 3, path: str = "auto") -> dict:
+          repeats: int = 3, path: str = "auto",
+          config: str = "fanin") -> dict:
     if path == "auto":
         on_tpu = jax.devices()[0].platform == "tpu"
         path = "pallas" if on_tpu and n_keys % TILE == 0 else "xla"
     n_chunks = n_replicas // chunk_replicas
     store = empty_dense_store(n_keys)
-    cs = make_changeset(chunk_replicas, n_keys, seed=0)
+    cs = make_changeset(chunk_replicas, n_keys, seed=0, **CONFIGS[config])
     run = (build_pallas_stream_fn if path == "pallas"
            else build_stream_fn)(n_chunks)
     args = (store, cs, jnp.int64(_MILLIS << SHIFT), jnp.int32(0),
@@ -115,13 +135,17 @@ def bench(n_keys: int, n_replicas: int, chunk_replicas: int,
         best = min(best, time.perf_counter() - t0)
 
     merges = n_keys * n_replicas
-    return {
-        "metric": (f"record_merges_per_sec_{n_keys // 1000}k_keys_"
-                   f"x{n_replicas}_replicas"),
-        "value": round(merges / best, 1),
-        "unit": "merges/s",
-        "vs_baseline": round(merges / best / TARGET, 3),
-    }
+    suffix = "" if config == "fanin" else f"_{config}"
+    return result_dict(
+        f"record_merges_per_sec_{n_keys // 1000}k_keys_"
+        f"x{n_replicas}_replicas{suffix}", merges, best)
+
+
+def result_dict(metric: str, merges: int, secs: float) -> dict:
+    """The one-line JSON contract shared by bench.py and the suite."""
+    return {"metric": metric, "value": round(merges / secs, 1),
+            "unit": "merges/s",
+            "vs_baseline": round(merges / secs / TARGET, 3)}
 
 
 def main() -> None:
@@ -133,6 +157,7 @@ def main() -> None:
     ap.add_argument("--chunk", type=int, default=None)
     ap.add_argument("--path", choices=("auto", "xla", "pallas"),
                     default="auto")
+    ap.add_argument("--config", choices=tuple(CONFIGS), default="fanin")
     args = ap.parse_args()
 
     if args.smoke:
@@ -143,7 +168,8 @@ def main() -> None:
     n_replicas = args.replicas or n_replicas
     chunk = args.chunk or chunk
 
-    result = bench(n_keys, n_replicas, chunk, path=args.path)
+    result = bench(n_keys, n_replicas, chunk, path=args.path,
+                   config=args.config)
     print(json.dumps(result))
 
 

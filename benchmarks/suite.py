@@ -1,0 +1,105 @@
+"""Full benchmark suite — the five BASELINE.json configs.
+
+Prints one JSON line per config (the driver's single-line contract is
+`bench.py` at the repo root; this suite is the detailed harness).
+
+Configs (BASELINE.json / BASELINE.md):
+1. 2-replica LWW merge, 1k keys, int values — the ported
+   example/crdt_example.dart shape, measured on the scalar oracle
+   (the stand-in for the reference's single-thread Dart merge loop,
+   crdt.dart:77-94) AND on the device path.
+2. N-replica fan-in, 1M keys × {8, 64, 1024} replicas.
+3. Tombstone-heavy merge (50% deletes, record.dart:17).
+4. HLC tie-break stress (colliding logicalTimes; node-ordinal
+   disambiguation, hlc.dart:158-161).
+5. String/JSON payloads: variable-length values live in a host-side
+   table; the device reduction carries table indices (SURVEY.md §7
+   hard part 4). Measures the full wire path: JSON decode → merge.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))  # repo root (bench.py helpers)
+
+from bench import _MILLIS, bench, result_dict
+from crdt_tpu import Hlc, MapCrdt, Record, TpuMapCrdt
+from crdt_tpu.testing import FakeClock
+
+
+def scalar_records(n_keys, node, value=None):
+    h = lambda i: Hlc(_MILLIS + i % 997, i % 3, node)
+    return {f"k{i}": Record(h(i), value(i) if value else i, h(i))
+            for i in range(n_keys)}
+
+
+def bench_example_oracle(n_keys=1000, repeats=5):
+    """Config 1 on the scalar oracle — the single-thread comparison
+    point (the reference publishes no numbers; this is its moral
+    equivalent in-process)."""
+    remote = scalar_records(n_keys, "remote")
+    best = float("inf")
+    for _ in range(repeats):
+        crdt = MapCrdt("local", wall_clock=FakeClock(start=_MILLIS + 10_000))
+        t0 = time.perf_counter()
+        crdt.merge(dict(remote))
+        best = min(best, time.perf_counter() - t0)
+    return result_dict(
+        f"oracle_2replica_{n_keys}key_int_merges_per_sec", n_keys, best)
+
+
+def bench_example_device(n_keys=1000, repeats=5):
+    """Config 1 on the device-columnar backend (host encode included —
+    this measures the drop-in TpuMapCrdt path, not the dense kernel)."""
+    remote = scalar_records(n_keys, "remote")
+    best = float("inf")
+    for _ in range(repeats):
+        crdt = TpuMapCrdt("local",
+                          wall_clock=FakeClock(start=_MILLIS + 10_000))
+        t0 = time.perf_counter()
+        crdt.merge(dict(remote))
+        crdt.get_record("k0")  # force device sync
+        best = min(best, time.perf_counter() - t0)
+    return result_dict(
+        f"tpu_backend_2replica_{n_keys}key_int_merges_per_sec", n_keys,
+        best)
+
+
+def bench_payload_wire(n_keys=10_000, repeats=3):
+    """Config 5: variable-length string/JSON payloads over the wire —
+    JSON decode + merge into the device-columnar backend (payloads stay
+    host-side; only indices/winners touch the device)."""
+    src = MapCrdt("remote", wall_clock=FakeClock(start=_MILLIS))
+    src.put_all({f"key-{i}": {"s": "x" * (8 + i % 57), "i": i}
+                 for i in range(n_keys)})
+    wire = src.to_json()
+    best = float("inf")
+    for _ in range(repeats):
+        dst = TpuMapCrdt("local", wall_clock=FakeClock(start=_MILLIS + 10))
+        t0 = time.perf_counter()
+        dst.merge_json(wire)
+        dst.get_record("key-0")
+        best = min(best, time.perf_counter() - t0)
+    return result_dict(
+        f"wire_json_{n_keys}key_varlen_payload_merges_per_sec", n_keys,
+        best)
+
+
+def main():
+    results = [bench_example_oracle(), bench_example_device()]
+    for replicas in (8, 64, 1024):
+        results.append(bench(1 << 20, replicas, 8))
+    results.append(bench(1 << 20, 1024, 8, config="tombstone"))
+    results.append(bench(1 << 20, 1024, 8, config="tiebreak"))
+    results.append(bench_payload_wire())
+    for r in results:
+        print(json.dumps(r))
+
+
+if __name__ == "__main__":
+    main()

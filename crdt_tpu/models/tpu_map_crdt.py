@@ -37,6 +37,7 @@ from ..ops.merge import (Changeset, Store, delta_mask, empty_store,
                          grow_store, max_logical_time, merge_step,
                          scatter_put)
 from ..ops.packing import NodeTable
+from ..utils.stats import MergeStats, merge_annotation
 
 K = TypeVar("K")
 V = TypeVar("V")
@@ -62,6 +63,7 @@ class TpuMapCrdt(Crdt[K, V]):
         self._slot_keys: List[K] = []       # slot -> key, insertion order
         self._payload: List[Any] = []       # slot -> value (None = tombstone)
         self._hub = ChangeHub()
+        self.stats = MergeStats()
         if seed:
             # Seed lands before the canonical clock is derived, so
             # refresh_canonical_time absorbs it (map_crdt.dart:16-18 +
@@ -148,6 +150,8 @@ class TpuMapCrdt(Crdt[K, V]):
     def put_records(self, record_map: Dict[K, Record[V]]) -> None:
         if not record_map:
             return
+        self.stats.puts += 1
+        self.stats.records_put += len(record_map)
         keys = list(record_map.keys())
         records = list(record_map.values())
         self._intern_nodes([r.hlc.node_id for r in records] +
@@ -224,16 +228,19 @@ class TpuMapCrdt(Crdt[K, V]):
 
         keys = list(remote_records.keys())
         records = list(remote_records.values())
+        self.stats.merges += 1
+        self.stats.records_seen += len(records)
         self._intern_nodes([r.hlc.node_id for r in records])
         n_slots_before = len(self._slot_keys)
         slots = self._ensure_slots(keys)
         cs = self._build_changeset(slots, records)
 
-        new_store, res = merge_step(
-            self._store, cs,
-            jnp.int64(self._canonical_time.logical_time),
-            jnp.int32(self._my_ordinal()),
-            jnp.int64(wall))
+        with merge_annotation():
+            new_store, res = merge_step(
+                self._store, cs,
+                jnp.int64(self._canonical_time.logical_time),
+                jnp.int32(self._my_ordinal()),
+                jnp.int64(wall))
 
         if bool(res.any_bad):
             # Dart leaves the canonical clock partially advanced and the
@@ -253,6 +260,7 @@ class TpuMapCrdt(Crdt[K, V]):
 
         self._store = new_store
         win = np.asarray(res.win)
+        self.stats.records_adopted += int(win[:len(keys)].sum())
         for i, key in enumerate(keys):
             if win[i]:
                 value = records[i].value

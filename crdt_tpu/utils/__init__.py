@@ -1,0 +1,5 @@
+"""Utilities: merge observability (stats counters, profiler spans)."""
+
+from .stats import MergeStats, merge_annotation
+
+__all__ = ["MergeStats", "merge_annotation"]

@@ -1,0 +1,42 @@
+"""Merge observability (SURVEY.md §5: tracing/metrics are absent in the
+reference — the TPU build adds lightweight counters and profiler
+annotations around the merge kernel).
+
+`MergeStats` counts merges and record flow on a backend;
+`merge_annotation` wraps the device dispatch in a
+`jax.profiler.TraceAnnotation` so kernel time shows up named in TPU
+profiles (`jax.profiler.trace` / tensorboard).
+"""
+
+from __future__ import annotations
+
+from contextlib import contextmanager
+from dataclasses import dataclass, field
+
+import jax.profiler
+
+
+@dataclass
+class MergeStats:
+    """Counters for one CRDT backend instance."""
+    merges: int = 0            # merge() calls
+    records_seen: int = 0      # remote records examined (winners+losers)
+    records_adopted: int = 0   # LWW winners written
+    puts: int = 0              # local write batches (put/put_all)
+    records_put: int = 0       # local records written
+
+    def as_dict(self) -> dict:
+        return {k: getattr(self, k) for k in
+                ("merges", "records_seen", "records_adopted", "puts",
+                 "records_put")}
+
+    def reset(self) -> None:
+        for k in self.as_dict():
+            setattr(self, k, 0)
+
+
+@contextmanager
+def merge_annotation(name: str = "crdt_tpu.merge"):
+    """Named span around a merge dispatch for TPU profile traces."""
+    with jax.profiler.TraceAnnotation(name):
+        yield

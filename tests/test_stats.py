@@ -1,0 +1,27 @@
+"""Merge observability counters on the device-columnar backend."""
+
+from crdt_tpu import Hlc, Record, TpuMapCrdt
+from crdt_tpu.testing import FakeClock
+
+MILLIS = 1_700_000_000_000
+
+
+def test_counters_track_merge_flow():
+    crdt = TpuMapCrdt("abc", wall_clock=FakeClock())
+    crdt.put("x", 1)
+    crdt.put_all({"y": 2, "z": 3})
+    assert crdt.stats.puts == 2
+    assert crdt.stats.records_put == 3
+
+    h_new = Hlc(MILLIS + 50, 0, "other")
+    h_old = Hlc(1, 0, "other")
+    crdt.merge({"x": Record(h_old, 99, h_old),     # loses
+                "w": Record(h_new, 4, h_new)})     # wins
+    assert crdt.stats.merges == 1
+    assert crdt.stats.records_seen == 2
+    assert crdt.stats.records_adopted == 1
+
+    crdt.stats.reset()
+    assert crdt.stats.as_dict() == {
+        "merges": 0, "records_seen": 0, "records_adopted": 0,
+        "puts": 0, "records_put": 0}
