@@ -1,0 +1,205 @@
+"""DenseCrdt — fully device-resident LWW map over a dense integer key
+space.
+
+`TpuMapCrdt` is the drop-in general backend (arbitrary keys/values,
+host dict for key↔slot); this model is the high-throughput shape: keys
+ARE slot indices ``[0, n_slots)`` and values are int64 scalars (or
+indices into an application-side table, SURVEY.md §7 hard part 4), so
+every operation is a batched array op with zero per-record host work —
+the shape the benchmark's billions-of-merges/sec numbers come from.
+
+Replication model (C9/C10 on arrays):
+
+- ``export_delta(since)`` → ``(DenseChangeset, node_ids)`` — the
+  outbound half of the anti-entropy round; ordinals in the changeset
+  index into the accompanying ``node_ids`` list so peers with different
+  interning histories stay compatible.
+- ``merge(changeset, node_ids)`` — remaps peer ordinals into the local
+  `NodeTable` (one small host gather), then runs the fused fan-in
+  lattice join. Recv guards raise the reference's exception types
+  (hlc.dart:164-189).
+- ``sync_dense(a, b)`` — the push/pull round (test/map_crdt_test.dart:
+  273-279 semantics, inclusive delta bound).
+
+The columnar store round-trips through `crdt_tpu.checkpoint.save_dense`.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from ..hlc import (ClockDriftException, DuplicateNodeException, Hlc,
+                   wall_clock_millis)
+from ..ops.dense import (DenseChangeset, DenseStore, dense_delta_mask,
+                         dense_max_logical_time, empty_dense_store,
+                         fanin_step, store_to_changeset)
+from ..ops.packing import NodeTable
+from ..utils.stats import MergeStats, merge_annotation
+
+
+class DenseCrdt:
+    """LWW-map CRDT over slots ``[0, n_slots)`` with int64 values."""
+
+    def __init__(self, node_id: Any, n_slots: int,
+                 wall_clock: Optional[Callable[[], int]] = None,
+                 store: Optional[DenseStore] = None,
+                 node_ids: Optional[Sequence[Any]] = None):
+        self._node_id = node_id
+        self._wall_clock = wall_clock or wall_clock_millis
+        self._table = NodeTable(list(node_ids or []) + [node_id])
+        self._store = store if store is not None else empty_dense_store(
+            n_slots)
+        assert self._store.n_slots == n_slots
+        self.stats = MergeStats()
+        self.refresh_canonical_time()
+
+    # --- clock (crdt.dart:8-33,114-121) ---
+
+    @property
+    def node_id(self) -> Any:
+        return self._node_id
+
+    @property
+    def n_slots(self) -> int:
+        return self._store.n_slots
+
+    @property
+    def canonical_time(self) -> Hlc:
+        return self._canonical_time
+
+    @property
+    def store(self) -> DenseStore:
+        return self._store
+
+    def refresh_canonical_time(self) -> None:
+        self._canonical_time = Hlc.from_logical_time(
+            int(dense_max_logical_time(self._store)), self._node_id)
+
+    # --- local ops: one send per batch (crdt.dart:39-54) ---
+
+    def put_batch(self, slots, values) -> None:
+        """Write values at slot indices; the whole batch shares ONE
+        freshly-sent HLC (putAll semantics, crdt.dart:46-54)."""
+        slots = jnp.asarray(slots, jnp.int32)
+        values = jnp.asarray(values, jnp.int64)
+        self._canonical_time = Hlc.send(self._canonical_time,
+                                        millis=self._wall_clock())
+        t = jnp.int64(self._canonical_time.logical_time)
+        me = jnp.int32(self._table.ordinal(self._node_id))
+        s = self._store
+        self._store = DenseStore(
+            lt=s.lt.at[slots].set(t),
+            node=s.node.at[slots].set(me),
+            val=s.val.at[slots].set(values),
+            mod_lt=s.mod_lt.at[slots].set(t),
+            mod_node=s.mod_node.at[slots].set(me),
+            occupied=s.occupied.at[slots].set(True),
+            tomb=s.tomb.at[slots].set(False),
+        )
+        self.stats.puts += 1
+        self.stats.records_put += int(slots.shape[0])
+
+    def delete_batch(self, slots) -> None:
+        """Tombstone slots (delete = put None, crdt.dart:58)."""
+        slots = jnp.asarray(slots, jnp.int32)
+        self._canonical_time = Hlc.send(self._canonical_time,
+                                        millis=self._wall_clock())
+        t = jnp.int64(self._canonical_time.logical_time)
+        me = jnp.int32(self._table.ordinal(self._node_id))
+        s = self._store
+        self._store = s._replace(
+            lt=s.lt.at[slots].set(t),
+            node=s.node.at[slots].set(me),
+            mod_lt=s.mod_lt.at[slots].set(t),
+            mod_node=s.mod_node.at[slots].set(me),
+            occupied=s.occupied.at[slots].set(True),
+            tomb=s.tomb.at[slots].set(True),
+        )
+        self.stats.puts += 1
+        self.stats.records_put += int(slots.shape[0])
+
+    # --- views (tombstones excluded, crdt.dart:16-29) ---
+
+    @property
+    def live_mask(self) -> jax.Array:
+        return self._store.occupied & ~self._store.tomb
+
+    @property
+    def values(self) -> jax.Array:
+        """int64[n_slots]; only positions with ``live_mask`` are live."""
+        return self._store.val
+
+    def get(self, slot: int) -> Optional[int]:
+        occ, tomb, val = (bool(self._store.occupied[slot]),
+                          bool(self._store.tomb[slot]),
+                          int(self._store.val[slot]))
+        return val if occ and not tomb else None
+
+    def __len__(self) -> int:
+        return int(jnp.sum(self.live_mask))
+
+    # --- replication (C9/C10) ---
+
+    def export_delta(self, since: Optional[Hlc] = None
+                     ) -> Tuple[DenseChangeset, List[Any]]:
+        """Outbound changeset: full state, or records with
+        ``modified >= since`` (inclusive, map_crdt.dart:44-45), plus the
+        node-id list its ordinals index into."""
+        since_lt = None if since is None else jnp.int64(since.logical_time)
+        cs = store_to_changeset(self._store, since_lt)
+        return cs, [self._table.id_of(i) for i in range(len(self._table))]
+
+    def merge(self, cs: DenseChangeset, node_ids: Sequence[Any]) -> None:
+        """Fan-in a peer changeset. ``cs.node`` ordinals index
+        ``node_ids``; they are remapped into this replica's table."""
+        self.stats.merges += 1
+        self.stats.records_seen += int(jnp.sum(cs.valid))
+
+        remap_store = self._table.intern(node_ids)
+        if remap_store is not None:
+            rd = jnp.asarray(remap_store)
+            self._store = self._store._replace(
+                node=rd[self._store.node],
+                mod_node=rd[self._store.mod_node])
+        peer_to_local = jnp.asarray(
+            [self._table.ordinal(n) for n in node_ids], jnp.int32)
+        cs = cs._replace(node=peer_to_local[cs.node])
+
+        wall = self._wall_clock()
+        with merge_annotation("crdt_tpu.dense_merge"):
+            new_store, res = fanin_step(
+                self._store, cs,
+                jnp.int64(self._canonical_time.logical_time),
+                jnp.int32(self._table.ordinal(self._node_id)),
+                jnp.int64(wall))
+
+        if bool(res.any_bad):
+            # Store untouched; canonical rolled to the pre-failure value
+            # (sequential-merge parity, crdt.dart:77-94 throw path).
+            self._canonical_time = Hlc.from_logical_time(
+                int(res.canonical_at_fail), self._node_id)
+            if bool(res.first_is_dup):
+                raise DuplicateNodeException(str(self._node_id))
+            bad_lt = int(cs.lt.reshape(-1)[int(res.first_bad)])
+            raise ClockDriftException(bad_lt >> 16, wall)
+
+        self._store = new_store
+        self.stats.records_adopted += int(res.win_count)
+        self._canonical_time = Hlc.send(
+            Hlc.from_logical_time(int(res.new_canonical), self._node_id),
+            millis=self._wall_clock())
+
+
+def sync_dense(local: DenseCrdt, remote: DenseCrdt) -> None:
+    """One anti-entropy round between two dense replicas
+    (test/map_crdt_test.dart:273-279 semantics)."""
+    time = local.canonical_time
+    cs, ids = local.export_delta()
+    remote.merge(cs, ids)
+    cs, ids = remote.export_delta(since=time)
+    local.merge(cs, ids)
