@@ -28,7 +28,7 @@ from .crdt_json import CrdtJson, dart_str
 from .watch import ChangeEvent, ChangeStream
 from .models.map_crdt import MapCrdt
 from .models.tpu_map_crdt import TpuMapCrdt
-from .models.dense_crdt import DenseCrdt, sync_dense
+from .models.dense_crdt import DenseCrdt, ShardedDenseCrdt, sync_dense
 from .sync import sync, sync_json
 from .checkpoint import load_dense, load_json, save_dense, save_json
 
@@ -39,7 +39,8 @@ __all__ = [
     "OverflowException", "MAX_COUNTER", "MAX_DRIFT", "wall_clock_millis",
     "Record", "KeyDecoder", "KeyEncoder", "NodeIdDecoder", "ValueDecoder",
     "ValueEncoder", "Crdt", "CrdtJson", "dart_str", "ChangeEvent",
-    "ChangeStream", "MapCrdt", "TpuMapCrdt", "DenseCrdt", "sync_dense",
+    "ChangeStream", "MapCrdt", "TpuMapCrdt", "DenseCrdt",
+    "ShardedDenseCrdt", "sync_dense",
     "sync", "sync_json",
     "load_dense", "load_json", "save_dense", "save_json",
 ]

@@ -154,12 +154,11 @@ class DenseCrdt:
         cs = store_to_changeset(self._store, since_lt)
         return cs, [self._table.id_of(i) for i in range(len(self._table))]
 
-    def merge(self, cs: DenseChangeset, node_ids: Sequence[Any]) -> None:
-        """Fan-in a peer changeset. ``cs.node`` ordinals index
-        ``node_ids``; they are remapped into this replica's table."""
-        self.stats.merges += 1
-        self.stats.records_seen += int(jnp.sum(cs.valid))
-
+    def _remap_peer(self, cs: DenseChangeset, node_ids: Sequence[Any]
+                    ) -> DenseChangeset:
+        """Intern peer ids and rewrite the changeset's ordinals into
+        this replica's table (re-encoding stored lanes when new ids
+        shift existing ordinals)."""
         remap_store = self._table.intern(node_ids)
         if remap_store is not None:
             rd = jnp.asarray(remap_store)
@@ -168,31 +167,116 @@ class DenseCrdt:
                 mod_node=rd[self._store.mod_node])
         peer_to_local = jnp.asarray(
             [self._table.ordinal(n) for n in node_ids], jnp.int32)
-        cs = cs._replace(node=peer_to_local[cs.node])
+        return cs._replace(node=peer_to_local[cs.node])
+
+    def _dispatch_fanin(self, cs: DenseChangeset, wall: int):
+        """Run the fan-in join; subclasses route to other executors.
+        Returns ``(new_store, res)`` with a FaninResult-compatible res."""
+        return fanin_step(
+            self._store, cs,
+            jnp.int64(self._canonical_time.logical_time),
+            jnp.int32(self._table.ordinal(self._node_id)),
+            jnp.int64(wall))
+
+    def _raise_guard(self, cs: DenseChangeset, res, wall: int) -> None:
+        # Store untouched; canonical rolled to the pre-failure value
+        # (sequential-merge parity, crdt.dart:77-94 throw path).
+        self._canonical_time = Hlc.from_logical_time(
+            int(res.canonical_at_fail), self._node_id)
+        if bool(res.first_is_dup):
+            raise DuplicateNodeException(str(self._node_id))
+        bad_lt = int(cs.lt.reshape(-1)[int(res.first_bad)])
+        raise ClockDriftException(bad_lt >> 16, wall)
+
+    def merge(self, cs: DenseChangeset, node_ids: Sequence[Any]) -> None:
+        """Fan-in a peer changeset. ``cs.node`` ordinals index
+        ``node_ids``; they are remapped into this replica's table."""
+        self.merge_many([(cs, node_ids)])
+
+    def merge_many(self, changesets: Sequence[
+            Tuple[DenseChangeset, Sequence[Any]]]) -> None:
+        """N-replica fan-in: concatenate peer changesets along the
+        replica axis (earlier entries win identical-HLC ties, the
+        sequential-merge order) and run ONE fused lattice join."""
+        self.stats.merges += 1
+        parts = [self._remap_peer(cs, ids) for cs, ids in changesets]
+        cs = DenseChangeset(*(jnp.concatenate([getattr(p, f) for p in parts])
+                              for f in DenseChangeset._fields))
+        self.stats.records_seen += int(jnp.sum(cs.valid))
 
         wall = self._wall_clock()
         with merge_annotation("crdt_tpu.dense_merge"):
-            new_store, res = fanin_step(
-                self._store, cs,
-                jnp.int64(self._canonical_time.logical_time),
-                jnp.int32(self._table.ordinal(self._node_id)),
-                jnp.int64(wall))
+            new_store, res = self._dispatch_fanin(cs, wall)
 
         if bool(res.any_bad):
-            # Store untouched; canonical rolled to the pre-failure value
-            # (sequential-merge parity, crdt.dart:77-94 throw path).
-            self._canonical_time = Hlc.from_logical_time(
-                int(res.canonical_at_fail), self._node_id)
-            if bool(res.first_is_dup):
-                raise DuplicateNodeException(str(self._node_id))
-            bad_lt = int(cs.lt.reshape(-1)[int(res.first_bad)])
-            raise ClockDriftException(bad_lt >> 16, wall)
+            self._raise_guard(cs, res, wall)
 
         self._store = new_store
         self.stats.records_adopted += int(res.win_count)
         self._canonical_time = Hlc.send(
             Hlc.from_logical_time(int(res.new_canonical), self._node_id),
             millis=self._wall_clock())
+
+
+class ShardedDenseCrdt(DenseCrdt):
+    """`DenseCrdt` with its key space sharded across a device mesh.
+
+    Store lanes carry a ``NamedSharding`` over the mesh's key axis
+    (replicated over the replica axis); ``merge``/``merge_many`` run
+    the `crdt_tpu.parallel` fan-in — replica-axis lexicographic-max
+    collectives over ICI, DCN across slices. Incoming changesets are
+    padded with invalid rows up to a multiple of the mesh's replica
+    dimension, then sharded ``(replica, key)``.
+
+    Guard-trip differences from the single-device model (documented in
+    `crdt_tpu.parallel.fanin`): flags carry no first-offender index, so
+    a tripped guard raises with the canonical clock left at its
+    pre-merge value; re-run the scalar oracle for diagnostics.
+    """
+
+    def __init__(self, node_id: Any, n_slots: int, mesh,
+                 wall_clock: Optional[Callable[[], int]] = None,
+                 store: Optional[DenseStore] = None,
+                 node_ids: Optional[Sequence[Any]] = None):
+        from ..parallel import make_sharded_fanin, shard_store
+        self._mesh = mesh
+        self._sharded_step = make_sharded_fanin(mesh)
+        self._shard = lambda s: shard_store(s, mesh)
+        super().__init__(node_id, n_slots, wall_clock=wall_clock,
+                         store=store, node_ids=node_ids)
+        self._store = self._shard(self._store)
+
+    def _dispatch_fanin(self, cs: DenseChangeset, wall: int):
+        from ..parallel import shard_changeset
+        r_shards = self._mesh.shape["replica"]
+        r = cs.lt.shape[0]
+        pad = (-r) % r_shards
+        if pad:
+            cs = DenseChangeset(*(
+                jnp.concatenate([lane, jnp.zeros((pad,) + lane.shape[1:],
+                                                 lane.dtype)])
+                for lane in cs))
+        cs = shard_changeset(cs, self._mesh)
+        return self._sharded_step(
+            self._store, cs,
+            jnp.int64(self._canonical_time.logical_time),
+            jnp.int32(self._table.ordinal(self._node_id)),
+            jnp.int64(wall))
+
+    def _raise_guard(self, cs: DenseChangeset, res, wall: int) -> None:
+        # No per-record diagnostics on the sharded path; the canonical
+        # clock stays at its pre-merge value and the store is untouched.
+        if bool(res.any_dup):
+            raise DuplicateNodeException(str(self._node_id))
+        raise ClockDriftException(wall + 60_001, wall)
+
+    def put_batch(self, slots, values) -> None:
+        super().put_batch(slots, values)
+        self._store = self._shard(self._store)
+
+    def delete_batch(self, slots) -> None:
+        super().delete_batch(slots)
+        self._store = self._shard(self._store)
 
 
 def sync_dense(local: DenseCrdt, remote: DenseCrdt) -> None:
