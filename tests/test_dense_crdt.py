@@ -133,6 +133,59 @@ class TestReplication:
         assert a.get(0) == 1 and z.get(0) == 1
 
 
+class TestDifferentialVsOracle:
+    """DenseCrdt vs MapCrdt under equivalent random op schedules: the
+    observable record state (event HLC + value + tombstone per key)
+    must match exactly."""
+
+    @pytest.mark.parametrize("seed", range(3))
+    def test_fanin_matches_sequential_oracle(self, seed):
+        import random
+        from crdt_tpu import MapCrdt, Record
+
+        rng = random.Random(seed)
+        n_writers = 5
+        dense_writers = []
+        oracle_writers = []
+        for i in range(n_writers):
+            clock_d = FakeClock(start=BASE + i * 3)
+            clock_o = FakeClock(start=BASE + i * 3)
+            d = DenseCrdt(f"w{i}", N, wall_clock=clock_d)
+            o = MapCrdt(f"w{i}", wall_clock=clock_o)
+            for _ in range(rng.randrange(1, 4)):
+                slots = sorted(rng.sample(range(N), rng.randrange(1, 9)))
+                if rng.random() < 0.25:
+                    d.delete_batch(slots)
+                    o.put_all({s: None for s in slots})
+                else:
+                    vals = [rng.randrange(1000) for _ in slots]
+                    d.put_batch(slots, vals)
+                    o.put_all(dict(zip(slots, vals)))
+            dense_writers.append(d)
+            oracle_writers.append(o)
+
+        hub = DenseCrdt("hub", N, wall_clock=FakeClock(start=BASE + 99))
+        hub.merge_many([w.export_delta() for w in dense_writers])
+
+        oracle = MapCrdt("hub", wall_clock=FakeClock(start=BASE + 99))
+        for o in oracle_writers:
+            oracle.merge(o.record_map())
+
+        recs = oracle.record_map()
+        for slot in range(N):
+            if slot not in recs:
+                assert not bool(hub.store.occupied[slot])
+                continue
+            r = recs[slot]
+            assert bool(hub.store.occupied[slot])
+            assert int(hub.store.lt[slot]) == r.hlc.logical_time
+            assert (hub._table.id_of(int(hub.store.node[slot]))
+                    == r.hlc.node_id)
+            assert bool(hub.store.tomb[slot]) == r.is_deleted
+            if not r.is_deleted:
+                assert int(hub.store.val[slot]) == r.value
+
+
 class TestResume:
     def test_checkpoint_roundtrip(self, tmp_path):
         a = make()
