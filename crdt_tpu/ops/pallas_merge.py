@@ -44,8 +44,11 @@ from ..hlc import MAX_DRIFT, SHIFT
 from .dense import DenseChangeset, DenseStore, _NEG, _I32_NEG
 
 # Sentinel hi word of _NEG = -(2**62): anything real compares greater.
-NEG_HI = jnp.int32(_NEG >> 32)
-NEG_LO = jnp.uint32(_NEG & 0xFFFFFFFF)
+# Plain ints (not jnp scalars): module-level concrete arrays would
+# initialize the jax backend at import time, foreclosing the platform
+# selection the driver entry points must do first.
+NEG_HI = _NEG >> 32
+NEG_LO = _NEG & 0xFFFFFFFF
 
 
 class SplitStore(NamedTuple):

@@ -1,0 +1,46 @@
+"""Driver entry-point gate: entry() compiles, dryrun_multichip passes.
+
+The round-1 gate failure (MULTICHIP_r01.json ok=false) was an in-process
+platform switch racing an already-initialized backend; these tests pin
+both the in-process path (env preconfigured, as under pytest) and the
+subprocess re-exec fallback (env NOT preconfigured, as under the driver).
+"""
+
+import os
+import subprocess
+import sys
+
+import jax
+
+import __graft_entry__ as graft
+
+
+def test_entry_compiles_and_runs():
+    fn, args = graft.entry()
+    out = jax.jit(fn)(*args)
+    jax.block_until_ready(out)
+    store, res = out
+    assert int(res.win_count) > 0
+    assert not bool(res.any_bad)
+
+
+def test_dryrun_multichip_in_process():
+    # conftest already set the 8-device CPU platform, so this exercises
+    # the in-process fast path.
+    graft.dryrun_multichip(8)
+
+
+def test_dryrun_multichip_subprocess_reexec():
+    # Simulate the driver: a process whose backend is already live and
+    # whose XLA_FLAGS lack the virtual-device count. dryrun_multichip
+    # must re-exec itself in a correctly-configured child and succeed.
+    env = {k: v for k, v in os.environ.items() if k != "XLA_FLAGS"}
+    env.pop("JAX_PLATFORMS", None)
+    code = ("import jax; jax.devices(); "
+            "import __graft_entry__ as g; g.dryrun_multichip(4); "
+            "print('SUBPROC_GATE_OK')")
+    here = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    proc = subprocess.run([sys.executable, "-c", code], cwd=here, env=env,
+                          capture_output=True, text=True, timeout=570)
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    assert "SUBPROC_GATE_OK" in proc.stdout
