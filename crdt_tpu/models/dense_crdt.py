@@ -82,10 +82,20 @@ class DenseCrdt:
 
     # --- local ops: one send per batch (crdt.dart:39-54) ---
 
+    def _check_slots(self, slots: np.ndarray) -> None:
+        # JAX scatter drops out-of-bounds indices silently; fail loudly
+        # instead of losing writes.
+        if slots.size and (slots.min() < 0 or slots.max() >= self.n_slots):
+            raise IndexError(
+                f"slot indices must be within [0, {self.n_slots}); got "
+                f"range [{slots.min()}, {slots.max()}]")
+
     def put_batch(self, slots, values) -> None:
         """Write values at slot indices; the whole batch shares ONE
         freshly-sent HLC (putAll semantics, crdt.dart:46-54)."""
-        slots = jnp.asarray(slots, jnp.int32)
+        slots = np.asarray(slots, np.int32)
+        self._check_slots(slots)
+        slots = jnp.asarray(slots)
         values = jnp.asarray(values, jnp.int64)
         self._canonical_time = Hlc.send(self._canonical_time,
                                         millis=self._wall_clock())
@@ -106,7 +116,9 @@ class DenseCrdt:
 
     def delete_batch(self, slots) -> None:
         """Tombstone slots (delete = put None, crdt.dart:58)."""
-        slots = jnp.asarray(slots, jnp.int32)
+        slots = np.asarray(slots, np.int32)
+        self._check_slots(slots)
+        slots = jnp.asarray(slots)
         self._canonical_time = Hlc.send(self._canonical_time,
                                         millis=self._wall_clock())
         t = jnp.int64(self._canonical_time.logical_time)
@@ -154,17 +166,22 @@ class DenseCrdt:
         cs = store_to_changeset(self._store, since_lt)
         return cs, [self._table.id_of(i) for i in range(len(self._table))]
 
-    def _remap_peer(self, cs: DenseChangeset, node_ids: Sequence[Any]
-                    ) -> DenseChangeset:
-        """Intern peer ids and rewrite the changeset's ordinals into
-        this replica's table (re-encoding stored lanes when new ids
-        shift existing ordinals)."""
-        remap_store = self._table.intern(node_ids)
+    def _intern_ids(self, node_ids: Sequence[Any]) -> None:
+        """Intern ids into the table, re-encoding stored lanes when new
+        ids shift existing ordinals."""
+        remap_store = self._table.intern(list(node_ids))
         if remap_store is not None:
             rd = jnp.asarray(remap_store)
             self._store = self._store._replace(
                 node=rd[self._store.node],
                 mod_node=rd[self._store.mod_node])
+
+    def _encode_peer(self, cs: DenseChangeset, node_ids: Sequence[Any]
+                     ) -> DenseChangeset:
+        """Rewrite a changeset's ordinals into this replica's table.
+        Every id in ``node_ids`` must already be interned — encoding
+        against a table that can still shift corrupts earlier-encoded
+        changesets (the round-1 stale-ordinal bug)."""
         peer_to_local = jnp.asarray(
             [self._table.ordinal(n) for n in node_ids], jnp.int32)
         return cs._replace(node=peer_to_local[cs.node])
@@ -199,10 +216,26 @@ class DenseCrdt:
         replica axis (earlier entries win identical-HLC ties, the
         sequential-merge order) and run ONE fused lattice join."""
         self.stats.merges += 1
-        parts = [self._remap_peer(cs, ids) for cs, ids in changesets]
+        if not changesets:
+            # Merging nothing still ends with the final send bump
+            # (crdt.dart:93 runs unconditionally).
+            self._canonical_time = Hlc.send(self._canonical_time,
+                                            millis=self._wall_clock())
+            return
+        # Intern the UNION of every peer's ids first — one table
+        # mutation, one store re-encode — then encode each changeset
+        # against the now-final table. Interleaving interning with
+        # encoding left earlier-encoded changesets holding stale
+        # ordinals whenever a later peer's ids re-sorted the table.
+        union: set = set()
+        for _, ids in changesets:
+            union.update(ids)
+        self._intern_ids(union)
+        parts = [self._encode_peer(cs, ids) for cs, ids in changesets]
         cs = DenseChangeset(*(jnp.concatenate([getattr(p, f) for p in parts])
                               for f in DenseChangeset._fields))
-        self.stats.records_seen += int(jnp.sum(cs.valid))
+        # Lazy device scalar: no device->host sync on the hot path.
+        self.stats.add_seen_lazy(jnp.sum(cs.valid))
 
         wall = self._wall_clock()
         with merge_annotation("crdt_tpu.dense_merge"):

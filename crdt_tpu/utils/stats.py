@@ -11,19 +11,45 @@ profiles (`jax.profiler.trace` / tensorboard).
 from __future__ import annotations
 
 from contextlib import contextmanager
-from dataclasses import dataclass, field
+from dataclasses import dataclass
+from typing import Any
 
 import jax.profiler
 
 
 @dataclass
 class MergeStats:
-    """Counters for one CRDT backend instance."""
+    """Counters for one CRDT backend instance.
+
+    ``records_seen`` may be fed unfetched device scalars via
+    :meth:`add_seen_lazy` so the merge hot path never blocks on a
+    device→host transfer; reading the property drains them.
+    """
     merges: int = 0            # merge() calls
-    records_seen: int = 0      # remote records examined (winners+losers)
     records_adopted: int = 0   # LWW winners written
     puts: int = 0              # local write batches (put/put_all)
     records_put: int = 0       # local records written
+    _seen: int = 0
+    _seen_pending: Any = None  # lazy running sum (device scalar)
+
+    @property
+    def records_seen(self) -> int:
+        """Remote records examined, winners+losers (crdt.dart:80-85)."""
+        if self._seen_pending is not None:
+            self._seen += int(self._seen_pending)
+            self._seen_pending = None
+        return self._seen
+
+    @records_seen.setter
+    def records_seen(self, value: int) -> None:
+        self._seen_pending = None
+        self._seen = value
+
+    def add_seen_lazy(self, count: Any) -> None:
+        """Accumulate a host int or an unfetched device scalar without
+        forcing a sync; kept as one running device sum (O(1) memory)."""
+        self._seen_pending = (count if self._seen_pending is None
+                              else self._seen_pending + count)
 
     def as_dict(self) -> dict:
         return {k: getattr(self, k) for k in

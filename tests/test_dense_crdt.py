@@ -133,6 +133,59 @@ class TestReplication:
         assert a.get(0) == 1 and z.get(0) == 1
 
 
+class TestMergeManyOrdinals:
+    """Round-1 regression: merge_many interleaved peer interning with
+    changeset encoding, so a later peer whose ids re-sorted the
+    NodeTable left earlier-encoded changesets holding stale ordinals
+    (spurious DuplicateNodeException, or silent writer mis-attribution
+    and inverted tie-breaks). Ids must be interned as a union first."""
+
+    def test_interleaved_interning_attribution(self):
+        hub = DenseCrdt("m", N, wall_clock=FakeClock(start=BASE + 99))
+        z = DenseCrdt("z", N, wall_clock=FakeClock(start=BASE))
+        a = DenseCrdt("a", N, wall_clock=FakeClock(start=BASE + 3))
+        z.put_batch([0], [10])
+        a.put_batch([1], [20])
+        # 'z' encodes first; interning 'a' then shifts 'z''s ordinal —
+        # with the bug 'z''s rows carried hub's own ordinal ('m') and
+        # raised DuplicateNodeException.
+        hub.merge_many([z.export_delta(), a.export_delta()])
+        assert hub.get(0) == 10 and hub.get(1) == 20
+        assert hub._table.id_of(int(hub.store.node[0])) == "z"
+        assert hub._table.id_of(int(hub.store.node[1])) == "a"
+
+    def test_tiebreak_under_adversarial_intern_order(self):
+        # Identical logical times on one slot: 'z' > 'a' must win the
+        # node tie-break (hlc.dart:158-161) regardless of which peer's
+        # changeset is encoded first.
+        for order in (0, 1):
+            hub = DenseCrdt("m", N, wall_clock=FakeClock(start=BASE + 99))
+            z = DenseCrdt("z", N, wall_clock=FakeClock(start=BASE))
+            a = DenseCrdt("a", N, wall_clock=FakeClock(start=BASE))
+            z.put_batch([0], [10])
+            a.put_batch([0], [20])
+            deltas = [z.export_delta(), a.export_delta()]
+            hub.merge_many(deltas if order == 0 else deltas[::-1])
+            assert hub.get(0) == 10
+            assert hub._table.id_of(int(hub.store.node[0])) == "z"
+
+    def test_empty_merge_many_is_send_bump(self):
+        # crdt.dart:93's final send bump runs even for an empty merge.
+        c = make()
+        t0 = c.canonical_time.logical_time
+        c.merge_many([])
+        assert c.canonical_time.logical_time > t0
+        assert c.stats.merges == 1
+
+    def test_slot_bounds_validated(self):
+        c = make()
+        with pytest.raises(IndexError):
+            c.put_batch([N], [1])
+        with pytest.raises(IndexError):
+            c.delete_batch([-1])
+        assert len(c) == 0
+
+
 class TestDifferentialVsOracle:
     """DenseCrdt vs MapCrdt under equivalent random op schedules: the
     observable record state (event HLC + value + tombstone per key)
