@@ -111,22 +111,45 @@ CONFIGS = {
 def bench(n_keys: int, n_replicas: int, chunk_replicas: int,
           repeats: int = 3, path: str = "auto",
           config: str = "fanin") -> dict:
-    if path == "auto":
-        on_tpu = jax.devices()[0].platform == "tpu"
-        path = "pallas" if on_tpu and n_keys % TILE == 0 else "xla"
+    platform = jax.devices()[0].platform
+    # The kernel path is the default on ANY accelerator platform (the
+    # driver's chip reports a plugin platform name, not "tpu"); when
+    # auto-selected it falls back to the XLA fold if the kernel fails
+    # to compile/run there.
+    auto = path == "auto"
+    if auto:
+        path = ("pallas" if platform != "cpu" and n_keys % TILE == 0
+                else "xla")
     n_chunks = n_replicas // chunk_replicas
     store = empty_dense_store(n_keys)
     cs = make_changeset(chunk_replicas, n_keys, seed=0, **CONFIGS[config])
-    run = (build_pallas_stream_fn if path == "pallas"
-           else build_stream_fn)(n_chunks)
+    # Honest accounting: only valid lanes are record-merges (fill < 1
+    # pads the changeset with invalid entries that cost no join work).
+    merges = int(jnp.sum(cs.valid)) * n_chunks
     args = (store, cs, jnp.int64(_MILLIS << SHIFT), jnp.int32(0),
             jnp.int64(_MILLIS + 10_000))
 
-    # Force completion with a scalar readback: under remote-proxied
-    # backends block_until_ready can return at enqueue time, which would
-    # fake multi-T/s numbers.
-    _, canon = run(*args)
-    int(jax.device_get(canon))  # compile + warm
+    def compile_and_warm(p: str):
+        run = (build_pallas_stream_fn if p == "pallas"
+               else build_stream_fn)(n_chunks)
+        # Force completion with a scalar readback: under remote-proxied
+        # backends block_until_ready can return at enqueue time, which
+        # would fake multi-T/s numbers.
+        _, canon = run(*args)
+        int(jax.device_get(canon))
+        return run
+
+    if path == "pallas" and auto:
+        try:
+            run = compile_and_warm("pallas")
+        except Exception as e:  # Mosaic/compile failure on this platform
+            print(f"pallas path failed ({type(e).__name__}: {e}); "
+                  "falling back to xla", file=sys.stderr)
+            path = "xla"
+            run = compile_and_warm("xla")
+    else:
+        run = compile_and_warm(path)
+
     best = float("inf")
     for _ in range(repeats):
         t0 = time.perf_counter()
@@ -134,18 +157,26 @@ def bench(n_keys: int, n_replicas: int, chunk_replicas: int,
         int(jax.device_get(canon))
         best = min(best, time.perf_counter() - t0)
 
-    merges = n_keys * n_replicas
     suffix = "" if config == "fanin" else f"_{config}"
     return result_dict(
         f"record_merges_per_sec_{n_keys // 1000}k_keys_"
-        f"x{n_replicas}_replicas{suffix}", merges, best)
+        f"x{n_replicas}_replicas{suffix}", merges, best,
+        path=path, platform=platform)
 
 
-def result_dict(metric: str, merges: int, secs: float) -> dict:
-    """The one-line JSON contract shared by bench.py and the suite."""
-    return {"metric": metric, "value": round(merges / secs, 1),
-            "unit": "merges/s",
-            "vs_baseline": round(merges / secs / TARGET, 3)}
+def result_dict(metric: str, merges: int, secs: float,
+                path: str = None, platform: str = None) -> dict:
+    """The one-line JSON contract shared by bench.py and the suite.
+    ``path``/``platform`` record which executor produced the number so
+    it stays verifiable after the fact."""
+    out = {"metric": metric, "value": round(merges / secs, 1),
+           "unit": "merges/s",
+           "vs_baseline": round(merges / secs / TARGET, 3)}
+    if path is not None:
+        out["path"] = path
+    if platform is not None:
+        out["platform"] = platform
+    return out
 
 
 def main() -> None:

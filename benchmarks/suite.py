@@ -50,7 +50,8 @@ def bench_example_oracle(n_keys=1000, repeats=5):
         crdt.merge(dict(remote))
         best = min(best, time.perf_counter() - t0)
     return result_dict(
-        f"oracle_2replica_{n_keys}key_int_merges_per_sec", n_keys, best)
+        f"oracle_2replica_{n_keys}key_int_merges_per_sec", n_keys, best,
+        path="oracle-scalar-host")
 
 
 def bench_example_device(n_keys=1000, repeats=5):
@@ -65,9 +66,10 @@ def bench_example_device(n_keys=1000, repeats=5):
         crdt.merge(dict(remote))
         crdt.get_record("k0")  # force device sync
         best = min(best, time.perf_counter() - t0)
+    import jax
     return result_dict(
         f"tpu_backend_2replica_{n_keys}key_int_merges_per_sec", n_keys,
-        best)
+        best, path="tpu_map_crdt", platform=jax.devices()[0].platform)
 
 
 def bench_payload_wire(n_keys=10_000, repeats=3):
@@ -87,18 +89,34 @@ def bench_payload_wire(n_keys=10_000, repeats=3):
         best = min(best, time.perf_counter() - t0)
     return result_dict(
         f"wire_json_{n_keys}key_varlen_payload_merges_per_sec", n_keys,
-        best)
+        best, path="wire-json-host")
 
 
 def main():
-    results = [bench_example_oracle(), bench_example_device()]
-    for replicas in (8, 64, 1024):
-        results.append(bench(1 << 20, replicas, 8))
-    results.append(bench(1 << 20, 1024, 8, config="tombstone"))
-    results.append(bench(1 << 20, 1024, 8, config="tiebreak"))
-    results.append(bench_payload_wire())
-    for r in results:
-        print(json.dumps(r))
+    # Each config prints as it completes (a late failure must not lose
+    # earlier results); forced-executor rows tag the metric name so the
+    # xla/pallas pair never collides for consumers keyed on metric.
+    def emit(make_result, tag=None):
+        try:
+            r = make_result()
+        except Exception as e:
+            print(f"suite config failed ({type(e).__name__}: {e})",
+                  file=sys.stderr)
+            return
+        if tag:
+            r["metric"] += f"_{tag}"
+        print(json.dumps(r), flush=True)
+
+    emit(bench_example_oracle)
+    emit(bench_example_device)
+    for replicas in (8, 64):
+        emit(lambda: bench(1 << 20, replicas, 8))
+    # Headline config on BOTH executors, side by side.
+    emit(lambda: bench(1 << 20, 1024, 8, path="xla"), tag="xla")
+    emit(lambda: bench(1 << 20, 1024, 8, path="pallas"), tag="pallas")
+    emit(lambda: bench(1 << 20, 1024, 8, config="tombstone"))
+    emit(lambda: bench(1 << 20, 1024, 8, config="tiebreak"))
+    emit(bench_payload_wire)
 
 
 if __name__ == "__main__":

@@ -12,9 +12,11 @@ Why a kernel when XLA already fuses the fold (`ops.dense.lex_fold`):
    changeset tile and its (1, BLK) store tile are resident in VMEM
    once; XLA's fold reads/writes store lanes across several fusions.
 3. **Drift guard as a compare.** ``(lt >> 16) - wall > MAX_DRIFT`` is
-   algebraically ``lt > (wall + MAX_DRIFT) << 16``; the threshold is
-   split host-side so the in-kernel check is the same three-way lex
-   compare — no 64-bit shifts on device.
+   algebraically ``lt > ((wall + MAX_DRIFT) << 16) | 0xFFFF`` (the
+   ``|0xFFFF`` makes the strict compare millis-level: counter bits at
+   exactly wall+MAX_DRIFT millis must not trip); the threshold is split
+   host-side so the in-kernel check is the same three-way lex compare —
+   no 64-bit shifts on device.
 
 Guard semantics match the sharded path (`crdt_tpu.parallel.fanin`):
 recv's fast-path shielding (hlc.dart:85) is evaluated per key column —
@@ -40,7 +42,7 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
-from ..hlc import MAX_DRIFT, SHIFT
+from ..hlc import MAX_COUNTER, MAX_DRIFT, SHIFT
 from .dense import DenseChangeset, DenseStore, _NEG, _I32_NEG
 
 # Sentinel hi word of _NEG = -(2**62): anything real compares greater.
@@ -250,7 +252,12 @@ def pallas_fanin_step(store: SplitStore, cs: SplitChangeset,
     newc_hi, newc_lo = _split64(new_canonical)
 
     canon_hi, canon_lo = _split64(canonical_lt)
-    thresh_hi, thresh_lo = _split64((wall_millis + MAX_DRIFT) << SHIFT)
+    # Drift iff millis - wall > MAX_DRIFT (hlc.dart:92-94), i.e.
+    # lt > ((wall+MAX_DRIFT) << SHIFT) | MAX_COUNTER — the |MAX_COUNTER
+    # keeps counter>0 records at exactly wall+MAX_DRIFT millis from
+    # tripping the strict lex compare (millis-level check, not lt-level).
+    thresh_hi, thresh_lo = _split64(
+        ((wall_millis + MAX_DRIFT) << SHIFT) | MAX_COUNTER)
     scalars = jnp.stack([
         canon_hi, canon_lo.astype(jnp.int32), local_node,
         thresh_hi, thresh_lo.astype(jnp.int32),

@@ -161,6 +161,30 @@ def test_guards():
     assert not bool(res.any_dup)
 
 
+def test_drift_boundary_counter_bits():
+    # Round-1 off-by-one: a record at EXACTLY wall+MAX_DRIFT millis with
+    # counter > 0 must NOT drift (the reference check is millis-level,
+    # hlc.dart:92-94); one millisecond later must.
+    from crdt_tpu.hlc import MAX_DRIFT
+    n = BLOCK
+    wall = MILLIS
+    at_limit = make_changeset(1, n, [
+        (0, 0, lt_of(wall + MAX_DRIFT, 3), 1, 1, False)])
+    _, res = pallas_fanin_step(split_store(empty_dense_store(n)),
+                               split_changeset(at_limit), jnp.int64(0),
+                               jnp.int32(LOCAL), jnp.int64(wall),
+                               interpret=True)
+    assert not bool(res.any_drift)
+
+    past_limit = make_changeset(1, n, [
+        (0, 0, lt_of(wall + MAX_DRIFT + 1, 0), 1, 1, False)])
+    _, res = pallas_fanin_step(split_store(empty_dense_store(n)),
+                               split_changeset(past_limit), jnp.int64(0),
+                               jnp.int32(LOCAL), jnp.int64(wall),
+                               interpret=True)
+    assert bool(res.any_drift)
+
+
 def test_split_roundtrip():
     n = BLOCK
     cs = make_changeset(2, n, [(0, 3, lt_of(MILLIS, 2), 4, 123, False),
