@@ -26,20 +26,25 @@ The columnar store round-trips through `crdt_tpu.checkpoint.save_dense`.
 
 from __future__ import annotations
 
-from typing import Any, Callable, List, Optional, Sequence, Tuple
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
 import jax
 import jax.numpy as jnp
 
+from .. import crdt_json
 from ..hlc import (ClockDriftException, DuplicateNodeException, Hlc,
                    wall_clock_millis)
-from ..ops.dense import (DenseChangeset, DenseStore, dense_delta_mask,
-                         dense_max_logical_time, empty_dense_store,
-                         fanin_step, store_to_changeset)
+from ..ops.dense import (DenseChangeset, DenseStore, FaninResult,
+                         dense_delta_mask, dense_max_logical_time,
+                         empty_dense_store, fanin_step, store_to_changeset)
+from ..ops.merge import recv_guards
 from ..ops.packing import NodeTable
+from ..record import (KeyDecoder, KeyEncoder, Record, ValueDecoder,
+                      ValueEncoder)
 from ..utils.stats import MergeStats, merge_annotation
+from ..watch import ChangeHub, ChangeStream
 
 
 class DenseCrdt:
@@ -56,6 +61,7 @@ class DenseCrdt:
             n_slots)
         assert self._store.n_slots == n_slots
         self.stats = MergeStats()
+        self._hub = ChangeHub()
         self.refresh_canonical_time()
 
     # --- clock (crdt.dart:8-33,114-121) ---
@@ -113,6 +119,7 @@ class DenseCrdt:
         )
         self.stats.puts += 1
         self.stats.records_put += int(slots.shape[0])
+        self._emit_put(slots, values)
 
     def delete_batch(self, slots) -> None:
         """Tombstone slots (delete = put None, crdt.dart:58)."""
@@ -134,6 +141,7 @@ class DenseCrdt:
         )
         self.stats.puts += 1
         self.stats.records_put += int(slots.shape[0])
+        self._emit_delete(slots)
 
     # --- views (tombstones excluded, crdt.dart:16-29) ---
 
@@ -154,6 +162,127 @@ class DenseCrdt:
 
     def __len__(self) -> int:
         return int(jnp.sum(self.live_mask))
+
+    # --- watch/reactivity (C13, crdt.dart:162-164) ---
+
+    def watch(self, slot: Optional[int] = None) -> ChangeStream:
+        """Per-slot or whole-store change stream. Events are
+        ``(slot, value)`` with value ``None`` for deletes, emitted
+        host-side after device writes land (reactivity never lives in
+        the kernel — SURVEY.md §7 hard part 6)."""
+        return self._hub.stream(slot)
+
+    def _emit_put(self, slots, values) -> None:
+        if not self._hub.active:
+            return  # no subscribers: bulk path stays device-only
+        for s, v in zip(np.asarray(slots), np.asarray(values)):
+            self._hub.add(int(s), int(v))
+
+    def _emit_delete(self, slots) -> None:
+        if not self._hub.active:
+            return
+        for s in np.asarray(slots):
+            self._hub.add(int(s), None)
+
+    def _emit_merge_wins(self, store: DenseStore, win) -> None:
+        """Winner change events from the fan-in's win mask — batched,
+        post-dispatch (the device work is already queued)."""
+        if not self._hub.active:
+            return
+        win = np.asarray(win)
+        tomb = np.asarray(store.tomb)
+        val = np.asarray(store.val)
+        for s in np.nonzero(win)[0]:
+            self._hub.add(int(s), None if tomb[s] else int(val[s]))
+
+    # --- wire interop (C10/C11): every replica speaks the JSON wire
+    # format (crdt_json.dart:8-37; example/crdt_example.dart:12-16), so
+    # a dense replica can sync with MapCrdt/TpuMapCrdt or external
+    # JSON peers, not just other dense stores. ---
+
+    def record_map(self, modified_since: Optional[Hlc] = None
+                   ) -> Dict[int, Record]:
+        """Slot→Record export (recordMap semantics, crdt.dart:140-169,
+        inclusive ``modified_since`` bound) — the bridge between the
+        columnar lanes and the record-dict/JSON world. One device→host
+        transfer; per-record work is host-side decode of winners only."""
+        if modified_since is None:
+            mask = self._store.occupied
+        else:
+            mask = dense_delta_mask(
+                self._store, jnp.int64(modified_since.logical_time))
+        mask, lt, node, val, mod_lt, mod_node, tomb = (
+            np.asarray(x) for x in
+            (mask, self._store.lt, self._store.node, self._store.val,
+             self._store.mod_lt, self._store.mod_node, self._store.tomb))
+        out: Dict[int, Record] = {}
+        for slot in np.nonzero(mask)[0]:
+            h = Hlc.from_logical_time(
+                int(lt[slot]), self._table.id_of(int(node[slot])))
+            m = Hlc.from_logical_time(
+                int(mod_lt[slot]), self._table.id_of(int(mod_node[slot])))
+            out[int(slot)] = Record(
+                h, None if tomb[slot] else int(val[slot]), m)
+        return out
+
+    def to_json(self, modified_since: Optional[Hlc] = None,
+                key_encoder: Optional[KeyEncoder] = None,
+                value_encoder: Optional[ValueEncoder] = None) -> str:
+        """Wire JSON export (crdt.dart:124-135): slots stringify as int
+        keys, matching the reference's int-key golden format."""
+        return crdt_json.encode(self.record_map(modified_since),
+                                key_encoder=key_encoder,
+                                value_encoder=value_encoder)
+
+    def merge_records(self, record_map: Dict[int, Record]) -> None:
+        """Fan-in a record dict (from a MapCrdt/TpuMapCrdt peer or a
+        JSON decode). Values must be ints (or None tombstones) — the
+        dense model's payload lane is int64."""
+        if not record_map:
+            self.merge_many([])
+            return
+        slots = np.fromiter(record_map.keys(), np.int64,
+                            count=len(record_map))
+        self._check_slots(slots)
+        ids = sorted({r.hlc.node_id for r in record_map.values()})
+        id_to_ord = {nid: i for i, nid in enumerate(ids)}
+        n = self.n_slots
+        lt = np.zeros((n,), np.int64)
+        node = np.zeros((n,), np.int32)
+        val = np.zeros((n,), np.int64)
+        tomb = np.zeros((n,), bool)
+        valid = np.zeros((n,), bool)
+        for slot, rec in record_map.items():
+            if rec.value is not None and not isinstance(
+                    rec.value, (int, np.integer)):
+                # A truncated float/str would share the peer's hlc and
+                # silently diverge forever (ties resolve local-wins on
+                # both sides) — fail loudly instead.
+                raise TypeError(
+                    f"DenseCrdt values must be ints; slot {slot} got "
+                    f"{type(rec.value).__name__}")
+            lt[slot] = rec.hlc.logical_time
+            node[slot] = id_to_ord[rec.hlc.node_id]
+            val[slot] = 0 if rec.value is None else int(rec.value)
+            tomb[slot] = rec.is_deleted
+            valid[slot] = True
+        cs = DenseChangeset(
+            lt=jnp.asarray(lt)[None], node=jnp.asarray(node)[None],
+            val=jnp.asarray(val)[None], tomb=jnp.asarray(tomb)[None],
+            valid=jnp.asarray(valid)[None])
+        self.merge(cs, ids)
+
+    def merge_json(self, json_str: str,
+                   key_decoder: Optional[KeyDecoder] = None,
+                   value_decoder: Optional[ValueDecoder] = None) -> None:
+        """Wire JSON ingest (crdt.dart:100-109). Keys decode to int
+        slots by default."""
+        records = crdt_json.decode(
+            json_str, self._canonical_time,
+            key_decoder=key_decoder or int,
+            value_decoder=value_decoder,
+            now_millis=self._wall_clock())
+        self.merge_records(records)
 
     # --- replication (C9/C10) ---
 
@@ -194,6 +323,14 @@ class DenseCrdt:
             jnp.int64(self._canonical_time.logical_time),
             jnp.int32(self._table.ordinal(self._node_id)),
             jnp.int64(wall))
+
+    def _exact_guards(self, cs: DenseChangeset, res, wall: int):
+        """Exact r-major sequential guard diagnostics (the visit order
+        of crdt.dart:80-94). The single-device fan-in guards are already
+        exact; executors with coarser flags (sharded) override this to
+        recompute on the failure path — returning None clears a false
+        positive and lets the merge proceed."""
+        return res
 
     def _raise_guard(self, cs: DenseChangeset, res, wall: int) -> None:
         # Store untouched; canonical rolled to the pre-failure value
@@ -242,10 +379,16 @@ class DenseCrdt:
             new_store, res = self._dispatch_fanin(cs, wall)
 
         if bool(res.any_bad):
-            self._raise_guard(cs, res, wall)
+            exact = self._exact_guards(cs, res, wall)
+            if exact is not None:
+                self._raise_guard(cs, exact, wall)
+            # else: a coarser executor's guard flagged a record the
+            # exact sequential order shields — proceed (store lanes
+            # are bit-identical either way).
 
         self._store = new_store
         self.stats.records_adopted += int(res.win_count)
+        self._emit_merge_wins(new_store, res.win)
         self._canonical_time = Hlc.send(
             Hlc.from_logical_time(int(res.new_canonical), self._node_id),
             millis=self._wall_clock())
@@ -261,10 +404,12 @@ class ShardedDenseCrdt(DenseCrdt):
     padded with invalid rows up to a multiple of the mesh's replica
     dimension, then sharded ``(replica, key)``.
 
-    Guard-trip differences from the single-device model (documented in
-    `crdt_tpu.parallel.fanin`): flags carry no first-offender index, so
-    a tripped guard raises with the canonical clock left at its
-    pre-merge value; re-run the scalar oracle for diagnostics.
+    Guard semantics: the collective flags are per-device (coarser than
+    the sequential visit order); when one trips, the guards are
+    recomputed exactly on the unsharded changeset (`_exact_guards`), so
+    raised exceptions carry the same first-offender payload as the
+    single-device model and per-device false positives never reject a
+    merge the sequential order accepts.
     """
 
     def __init__(self, node_id: Any, n_slots: int, mesh,
@@ -296,12 +441,26 @@ class ShardedDenseCrdt(DenseCrdt):
             jnp.int32(self._table.ordinal(self._node_id)),
             jnp.int64(wall))
 
-    def _raise_guard(self, cs: DenseChangeset, res, wall: int) -> None:
-        # No per-record diagnostics on the sharded path; the canonical
-        # clock stays at its pre-merge value and the store is untouched.
-        if bool(res.any_dup):
-            raise DuplicateNodeException(str(self._node_id))
-        raise ClockDriftException(wall + 60_001, wall)
+    def _exact_guards(self, cs: DenseChangeset, res, wall: int):
+        """The sharded collectives' per-device shielding flags a
+        SUPERSET of the sequential r-major guard trips (a record on one
+        device is never shielded by an earlier record on another —
+        `crdt_tpu.parallel.fanin` docstring). Recompute the guards
+        exactly on the unsharded changeset — failure path only — so
+        raised exceptions carry the single-device path's first-offender
+        payload, and false positives are cleared (None → merge
+        proceeds, matching the single-device executor)."""
+        any_bad, first_bad, first_is_dup, canonical_at_fail = recv_guards(
+            cs.lt, cs.node, cs.valid,
+            jnp.int64(self._canonical_time.logical_time),
+            jnp.int32(self._table.ordinal(self._node_id)),
+            jnp.int64(wall))
+        if not bool(any_bad):
+            return None
+        return FaninResult(
+            new_canonical=res.new_canonical, win_count=res.win_count,
+            win=res.win, any_bad=any_bad, first_bad=first_bad,
+            first_is_dup=first_is_dup, canonical_at_fail=canonical_at_fail)
 
     def put_batch(self, slots, values) -> None:
         super().put_batch(slots, values)

@@ -88,6 +88,7 @@ class DenseChangeset(NamedTuple):
 class FaninResult(NamedTuple):
     new_canonical: jax.Array   # int64 scalar (pre final-send-bump)
     win_count: jax.Array       # int32 number of adopted records
+    win: jax.Array             # bool[N] per-slot adopted mask (watch/C13)
     any_bad: jax.Array         # bool — some recv guard tripped
     first_bad: jax.Array       # int32 flat r-major index of first offender
     first_is_dup: jax.Array    # bool — duplicate-node (vs drift) there
@@ -193,6 +194,7 @@ def fanin_step(store: DenseStore, cs: DenseChangeset,
     return new_store, FaninResult(
         new_canonical=new_canonical,
         win_count=jnp.sum(win).astype(jnp.int32),
+        win=win,
         any_bad=any_bad,
         first_bad=first_bad,
         first_is_dup=first_is_dup,
@@ -217,7 +219,7 @@ def fanin_stream(store: DenseStore, chunks: DenseChangeset,
     chunk_size = chunks.lt.shape[1] * chunks.lt.shape[2]
 
     def step(carry, chunk):
-        st, canon, offset, bad, fb, fd, caf, wins = carry
+        st, canon, offset, bad, fb, fd, caf, wins, winm = carry
         st2, res = fanin_step(st, chunk, canon, local_node, wall_millis)
         # Keep the FIRST failure's diagnostics across chunks; first_bad is
         # reported as a GLOBAL flat r-major index across the whole stream.
@@ -227,15 +229,16 @@ def fanin_stream(store: DenseStore, chunks: DenseChangeset,
                 jnp.where(keep_old, fb, offset + res.first_bad),
                 jnp.where(keep_old, fd, res.first_is_dup),
                 jnp.where(keep_old, caf, res.canonical_at_fail),
-                wins + res.win_count), None
+                wins + res.win_count, winm | res.win), None
 
     init = (store, canonical_lt, jnp.int32(0),
             jnp.asarray(False), jnp.int32(0), jnp.asarray(False),
-            jnp.int64(0), jnp.int32(0))
-    (st, canon, _, bad, fb, fd, caf, wins), _ = jax.lax.scan(
+            jnp.int64(0), jnp.int32(0),
+            jnp.zeros((store.n_slots,), bool))
+    (st, canon, _, bad, fb, fd, caf, wins, winm), _ = jax.lax.scan(
         step, init, chunks)
-    return st, FaninResult(new_canonical=canon, win_count=wins, any_bad=bad,
-                           first_bad=fb, first_is_dup=fd,
+    return st, FaninResult(new_canonical=canon, win_count=wins, win=winm,
+                           any_bad=bad, first_bad=fb, first_is_dup=fd,
                            canonical_at_fail=caf)
 
 

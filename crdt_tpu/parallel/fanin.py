@@ -63,6 +63,7 @@ _BIG_RANK = 2 ** 30
 class ShardedFaninResult(NamedTuple):
     new_canonical: jax.Array  # int64 scalar (pre final-send-bump)
     win_count: jax.Array      # int32 adopted records across all shards
+    win: jax.Array            # bool[N] per-slot adopted mask (key-sharded)
     any_bad: jax.Array        # bool — some recv guard tripped
     any_dup: jax.Array        # bool — a duplicate-node guard tripped
     any_drift: jax.Array      # bool — a drift guard tripped
@@ -106,16 +107,17 @@ def _fanin_block(store: DenseStore, cs: DenseChangeset,
     """Per-device body under shard_map: local reduce, then the
     lexicographic max fan-in over the replica axis."""
     # --- per-device guards (see module docstring for semantics) ---
+    # The three flags ride ONE two-lane pmax (lane 0 dup, lane 1
+    # drift); exception payloads come from the model's exact host-side
+    # recompute on the failure path, not from here.
     any_bad, first_bad, first_is_dup, _ = recv_guards(
         cs.lt, cs.node, cs.valid, canonical_lt, local_node, wall_millis)
-    any_dup = any_bad & first_is_dup
-    any_drift = any_bad & ~first_is_dup
-    any_bad = jax.lax.pmax(any_bad.astype(jnp.int32),
-                           (REPLICA_AXIS, KEY_AXIS)) > 0
-    any_dup = jax.lax.pmax(any_dup.astype(jnp.int32),
-                           (REPLICA_AXIS, KEY_AXIS)) > 0
-    any_drift = jax.lax.pmax(any_drift.astype(jnp.int32),
-                             (REPLICA_AXIS, KEY_AXIS)) > 0
+    flags = jnp.stack([(any_bad & first_is_dup).astype(jnp.int32),
+                       (any_bad & ~first_is_dup).astype(jnp.int32)])
+    flags = jax.lax.pmax(flags, (REPLICA_AXIS, KEY_AXIS))
+    any_dup = flags[0] > 0
+    any_drift = flags[1] > 0
+    any_bad = any_dup | any_drift
 
     # --- local replica reduce on this device's [R_blk, N_blk] block ---
     best_lt, best_node, best_val, best_tomb, any_valid = reduce_replicas(cs)
@@ -162,7 +164,7 @@ def _fanin_block(store: DenseStore, cs: DenseChangeset,
     )
     win_count = jax.lax.psum(jnp.sum(win).astype(jnp.int32), KEY_AXIS)
     return new_store, ShardedFaninResult(
-        new_canonical=new_canonical, win_count=win_count,
+        new_canonical=new_canonical, win_count=win_count, win=win,
         any_bad=any_bad, any_dup=any_dup, any_drift=any_drift)
 
 
@@ -185,7 +187,9 @@ def make_sharded_fanin(mesh: Mesh):
         ),
         out_specs=(
             DenseStore(*([P(KEY_AXIS)] * len(DenseStore._fields))),
-            ShardedFaninResult(*([P()] * len(ShardedFaninResult._fields))),
+            ShardedFaninResult(
+                new_canonical=P(), win_count=P(), win=P(KEY_AXIS),
+                any_bad=P(), any_dup=P(), any_drift=P()),
         ),
         check_vma=False,
     )

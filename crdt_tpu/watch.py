@@ -84,6 +84,14 @@ class ChangeHub:
     def __init__(self) -> None:
         self._streams: List[ChangeStream] = []
 
+    @property
+    def active(self) -> bool:
+        """True when some stream actually wants events (a live callback
+        or an active recording) — lets bulk backends skip per-record
+        host emission entirely when nobody is listening, including
+        after every subscriber unsubscribed."""
+        return any(s._recording or s._callbacks for s in self._streams)
+
     def add(self, key: Any, value: Any) -> None:
         event = ChangeEvent(key, value)
         for stream in list(self._streams):

@@ -239,6 +239,160 @@ class TestDifferentialVsOracle:
                 assert int(hub.store.val[slot]) == r.value
 
 
+class TestWireInterop:
+    """Every replica speaks the JSON wire format (crdt_json.dart:8-37);
+    a dense replica must round-trip the reference-golden int-key JSON
+    and converge with record-dict backends."""
+
+    GOLDEN_MILLIS = 1000000000000
+    GOLDEN_ISO = "2001-09-09T01:46:40.000Z"
+
+    def test_golden_json_export(self):
+        # Reference int-key golden (map_crdt_test.dart:135-139 shape):
+        # {"1":{"hlc":"<iso>-0000-<node>","value":1}}
+        c = DenseCrdt("abc", N,
+                      wall_clock=FakeClock(start=self.GOLDEN_MILLIS - 1))
+        c.put_batch([1], [1])   # stamped at exactly GOLDEN_MILLIS
+        assert c.to_json() == (
+            '{"1":{"hlc":"%s-0000-abc","value":1}}' % self.GOLDEN_ISO)
+
+    def test_golden_json_ingest(self):
+        c = DenseCrdt("xyz", N,
+                      wall_clock=FakeClock(start=self.GOLDEN_MILLIS + 5))
+        c.merge_json(
+            '{"1":{"hlc":"%s-0000-abc","value":1}}' % self.GOLDEN_ISO)
+        assert c.get(1) == 1
+        assert c._table.id_of(int(c.store.node[1])) == "abc"
+        assert int(c.store.lt[1]) == self.GOLDEN_MILLIS << 16
+
+    def test_json_roundtrip_with_tombstone(self):
+        a = make("na")
+        a.put_batch([0, 3], [7, 8])
+        a.delete_batch([3])
+        b = make("nb", BASE + 50)
+        b.merge_json(a.to_json())
+        assert b.get(0) == 7 and b.get(3) is None
+        assert bool(b.store.tomb[3]) and bool(b.store.occupied[3])
+
+    def test_mixed_backend_convergence(self):
+        # DenseCrdt ↔ MapCrdt ↔ TpuMapCrdt over the JSON wire: all three
+        # converge to the same live map (int keys).
+        from crdt_tpu import MapCrdt, TpuMapCrdt
+        d = DenseCrdt("dd", N, wall_clock=FakeClock(start=BASE))
+        m = MapCrdt("mm", wall_clock=FakeClock(start=BASE + 3))
+        t = TpuMapCrdt("tt", wall_clock=FakeClock(start=BASE + 7))
+        d.put_batch([0, 1], [10, 11])
+        m.put(2, 22)
+        t.put(3, 33)
+        t.delete(3)
+
+        m.merge_json(d.to_json(), key_decoder=int)
+        t.merge_json(m.to_json(), key_decoder=int)
+        d.merge_json(t.to_json())
+        m.merge_json(d.to_json(), key_decoder=int)
+        t.merge_json(d.to_json(), key_decoder=int)
+
+        expect = {0: 10, 1: 11, 2: 22}
+        assert {k: v for k, v in d.record_map().items()
+                if not v.is_deleted} == {
+            k: r for k, r in m.record_map().items() if not r.is_deleted}
+        assert m.map == expect and t.map == expect
+        assert {s: d.get(s) for s in expect} == expect
+        assert d.get(3) is None and bool(d.store.tomb[3])
+
+    def test_record_map_matches_oracle_after_merge(self):
+        # Full record-level parity (hlc + value + modified semantics)
+        # between the dense record_map export and a MapCrdt peer that
+        # merged the same wire payload.
+        from crdt_tpu import MapCrdt
+        src = DenseCrdt("src", N, wall_clock=FakeClock(start=BASE))
+        src.put_batch([4, 9], [44, 99])
+        wire = src.to_json()
+        m = MapCrdt("mm", wall_clock=FakeClock(start=BASE + 9))
+        m.merge_json(wire, key_decoder=int)
+        d = DenseCrdt("mm", N, wall_clock=FakeClock(start=BASE + 9))
+        d.merge_json(wire)
+        dm, mm = d.record_map(), m.record_map()
+        assert set(dm) == set(mm)
+        for k in dm:
+            assert dm[k].hlc == mm[k].hlc
+            assert dm[k].value == mm[k].value
+
+    def test_non_int_value_rejected_loudly(self):
+        # Truncating would silently diverge under the peer's hlc.
+        from crdt_tpu import MapCrdt
+        m = MapCrdt("mm", wall_clock=FakeClock(start=BASE))
+        m.put(1, "not-an-int")
+        d = make("dd")
+        with pytest.raises(TypeError):
+            d.merge_json(m.to_json())
+        assert len(d) == 0
+
+    def test_delta_export_since_over_json(self):
+        a = make("na")
+        a.put_batch([0], [1])
+        t = a.canonical_time
+        a.put_batch([1], [2])
+        payload = a.to_json(modified_since=a.canonical_time)
+        assert '"1"' in payload and '"0"' not in payload
+        full = a.to_json(modified_since=t)   # inclusive bound
+        assert '"0"' in full and '"1"' in full
+
+
+class TestWatch:
+    """C13 on the dense model: per-slot/whole-store change streams,
+    emitted host-side from the fan-in win mask (crdt.dart:162-164)."""
+
+    def test_put_delete_events(self):
+        c = make()
+        stream = c.watch().record()
+        c.put_batch([1, 2], [10, 20])
+        c.delete_batch([1])
+        assert stream.events == [(1, 10), (2, 20), (1, None)]
+
+    def test_per_slot_filter(self):
+        c = make()
+        s = c.watch(slot=2).record()
+        c.put_batch([1, 2], [10, 20])
+        c.put_batch([2], [21])
+        assert s.events == [(2, 20), (2, 21)]
+
+    def test_merge_emits_winners_only(self):
+        a, b = make("na"), make("nb", BASE + 5)
+        a.put_batch([0], [1])
+        b.put_batch([0], [2])          # later wall clock: wins on a
+        b.put_batch([1], [3])
+        s = a.watch().record()
+        a.merge(*b.export_delta())
+        assert s.events == [(0, 2), (1, 3)]
+        # Merging already-known state back emits nothing (no winners).
+        s2 = b.watch().record()
+        b.merge(*a.export_delta())
+        assert s2.events == []
+
+    def test_merge_tombstone_event_is_none(self):
+        a, b = make("na"), make("nb", BASE + 5)
+        a.put_batch([4], [9])
+        sync_dense(a, b)
+        b.delete_batch([4])
+        s = a.watch(slot=4).record()
+        a.merge(*b.export_delta())
+        assert s.events == [(4, None)]
+
+    def test_unsubscribe(self):
+        c = make()
+        seen = []
+        stream = c.watch()
+        off = stream.listen(seen.append)
+        c.put_batch([0], [1])
+        off()
+        c.put_batch([1], [2])
+        assert seen == [(0, 1)]
+        # With every subscriber gone the hub reads inactive again, so
+        # bulk paths skip host emission entirely.
+        assert not c._hub.active
+
+
 class TestResume:
     def test_checkpoint_roundtrip(self, tmp_path):
         a = make()
