@@ -62,23 +62,55 @@ def load_json(cls: Type[Crdt], node_id: Any, path: str,
     return cls(node_id, seed=records, wall_clock=wall_clock, **kwargs)
 
 
-_DENSE_MAGIC = "crdt_tpu/dense-store@1"
+_DENSE_MAGIC_V1 = "crdt_tpu/dense-store@1"
+_DENSE_MAGIC = "crdt_tpu/dense-store@2"
 
 
-def save_dense(store: DenseStore, path: str) -> None:
-    """Columnar snapshot: one compressed npz of the seven lanes."""
+def save_dense(store: DenseStore, path: str,
+               node_ids: Optional[list] = None) -> None:
+    """Columnar snapshot: one compressed npz of the seven lanes, plus
+    the node-id interning table when given — the ``node``/``mod_node``
+    ordinal lanes are meaningless without it, so model-level snapshots
+    (`DenseCrdt.save`) always include it."""
     tmp = path + ".tmp"
+    extra = ({} if node_ids is None
+             else {"node_ids": np.array(json.dumps(list(node_ids)))})
     with open(tmp, "wb") as f:
         np.savez_compressed(
-            f, magic=np.array(_DENSE_MAGIC),
+            f, magic=np.array(_DENSE_MAGIC), **extra,
             **{lane: np.asarray(getattr(store, lane))
                for lane in DenseStore._fields})
     os.replace(tmp, path)
 
 
-def load_dense(path: str) -> DenseStore:
+def _validated_npz(z, path: str):
+    if str(z["magic"]) not in (_DENSE_MAGIC, _DENSE_MAGIC_V1):
+        raise ValueError(f"not a dense-store snapshot: {path}")
+    return z
+
+
+def load_dense_with_node_ids(path: str):
+    """One-open load of ``(DenseStore, node_ids-or-None)``. ``None``
+    marks a lane-only (v1 / store-level) snapshot whose ordinal lanes
+    only a caller holding the original table can interpret."""
     with np.load(path) as z:
-        if str(z["magic"]) != _DENSE_MAGIC:
-            raise ValueError(f"not a dense-store snapshot: {path}")
-        return DenseStore(**{lane: jnp.asarray(z[lane])
-                             for lane in DenseStore._fields})
+        _validated_npz(z, path)
+        store = DenseStore(**{lane: jnp.asarray(z[lane])
+                              for lane in DenseStore._fields})
+        ids = (json.loads(str(z["node_ids"]))
+               if "node_ids" in z else None)
+    return store, ids
+
+
+def load_dense(path: str) -> DenseStore:
+    return load_dense_with_node_ids(path)[0]
+
+
+def load_dense_node_ids(path: str) -> Optional[list]:
+    """The node-id table a snapshot's ordinal lanes index into, or None
+    for lane-only (v1 / store-level) snapshots."""
+    with np.load(path) as z:
+        _validated_npz(z, path)
+        if "node_ids" not in z:
+            return None
+        return json.loads(str(z["node_ids"]))

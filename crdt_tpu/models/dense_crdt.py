@@ -56,10 +56,16 @@ class DenseCrdt:
                  node_ids: Optional[Sequence[Any]] = None):
         self._node_id = node_id
         self._wall_clock = wall_clock or wall_clock_millis
-        self._table = NodeTable(list(node_ids or []) + [node_id])
+        # A seeded store's ordinal lanes index sorted(node_ids); build
+        # that exact table FIRST, then intern our own id — re-encoding
+        # the lanes if the new id sorts into the middle (a resume under
+        # a fresh node id must not shift attribution).
+        self._table = NodeTable(node_ids or [])
         self._store = store if store is not None else empty_dense_store(
             n_slots)
         assert self._store.n_slots == n_slots
+        if node_id not in self._table:
+            self._intern_ids([node_id])
         self.stats = MergeStats()
         self._hub = ChangeHub()
         self.refresh_canonical_time()
@@ -237,10 +243,24 @@ class DenseCrdt:
     def merge_records(self, record_map: Dict[int, Record]) -> None:
         """Fan-in a record dict (from a MapCrdt/TpuMapCrdt peer or a
         JSON decode). Values must be ints (or None tombstones) — the
-        dense model's payload lane is int64."""
+        dense model's payload lane is int64.
+
+        Clock absorption and recv guards run host-side here, in the
+        payload's own iteration order — the reference's visit order
+        (crdt.dart:80-85) — so guard trips, their payloads, and the
+        partially-advanced canonical on failure match ``MapCrdt.merge``
+        exactly. A slot-ordered device-side check could disagree on
+        which records the fast path shields (hlc.dart:85). After
+        absorption the canonical clock is ≥ every remote lt, so the
+        device guards stay structurally quiet and the join itself is
+        order-independent."""
         if not record_map:
             self.merge_many([])
             return
+        wall = self._wall_clock()
+        for rec in record_map.values():
+            self._canonical_time = Hlc.recv(self._canonical_time, rec.hlc,
+                                            millis=wall)
         slots = np.fromiter(record_map.keys(), np.int64,
                             count=len(record_map))
         self._check_slots(slots)
@@ -283,6 +303,36 @@ class DenseCrdt:
             value_decoder=value_decoder,
             now_millis=self._wall_clock())
         self.merge_records(records)
+
+    # --- checkpoint/resume (SURVEY.md §5) ---
+
+    def save(self, path: str) -> None:
+        """Columnar snapshot INCLUDING the node-id table the ordinal
+        lanes index into (`crdt_tpu.checkpoint.save_dense`)."""
+        from ..checkpoint import save_dense
+        save_dense(self._store, path,
+                   node_ids=[self._table.id_of(i)
+                             for i in range(len(self._table))])
+
+    @classmethod
+    def load(cls, node_id: Any, path: str,
+             wall_clock: Optional[Callable[[], int]] = None,
+             **kwargs) -> "DenseCrdt":
+        """Resume from a snapshot; the canonical clock rebuilds from the
+        lanes (refreshCanonicalTime semantics, crdt.dart:31-33) and
+        writer attribution survives via the persisted node table."""
+        from ..checkpoint import load_dense_with_node_ids
+        store, ids = load_dense_with_node_ids(path)
+        if ids is None:
+            # A lane-only snapshot's ordinals are uninterpretable here;
+            # constructing a replica anyway would silently re-attribute
+            # (or crash on) every foreign record.
+            raise ValueError(
+                f"{path} has no node-id table (store-level snapshot); "
+                "use DenseCrdt.save for resumable snapshots, or pass "
+                "store=load_dense(path) with the original node_ids")
+        return cls(node_id, store.n_slots, wall_clock=wall_clock,
+                   store=store, node_ids=ids, **kwargs)
 
     # --- replication (C9/C10) ---
 

@@ -47,13 +47,20 @@ class ChangeStream:
 
     def listen(self, callback: Callable[[ChangeEvent], None]
                ) -> Callable[[], None]:
-        """Subscribe; returns an idempotent unsubscribe function."""
+        """Subscribe; returns an idempotent unsubscribe function. The
+        last unsubscribe detaches the stream from its hub (so transient
+        watch/listen/unsubscribe cycles don't accumulate dead streams);
+        a later listen() re-attaches."""
+        if self not in self._hub._streams:
+            self._hub._streams.append(self)
         token = [callback]
         self._callbacks.append(token)
 
         def unsubscribe() -> None:
             if token in self._callbacks:
                 self._callbacks.remove(token)
+                if not self._callbacks and not self._recording:
+                    self.cancel()
 
         return unsubscribe
 

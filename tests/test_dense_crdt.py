@@ -328,6 +328,39 @@ class TestWireInterop:
             d.merge_json(m.to_json())
         assert len(d) == 0
 
+    def test_wire_guard_order_matches_oracle(self):
+        # Guards must follow the PAYLOAD's iteration order (the
+        # reference visit order, crdt.dart:80-85), not slot order: a
+        # high-lt foreign record earlier in the payload shields a
+        # later own-id record via the recv fast path (hlc.dart:85).
+        from crdt_tpu import Hlc, MapCrdt, Record
+        h_high = Hlc(BASE + 20, 0, "xx")
+        h_own = Hlc(BASE + 10, 0, "dd")
+        recs_ok = {5: Record(h_high, 50, h_high),
+                   0: Record(h_own, 9, h_own)}
+        d = DenseCrdt("dd", N, wall_clock=FakeClock(start=BASE + 30))
+        d.merge_records(dict(recs_ok))          # shielded: no raise
+        m = MapCrdt("dd", wall_clock=FakeClock(start=BASE + 30))
+        m.merge(dict(recs_ok))
+        assert d.get(5) == m.get(5) == 50 and d.get(0) == m.get(0) == 9
+
+        # Reversed payload order: the own-id record is visited first,
+        # unshielded — BOTH backends raise the same duplicate payload.
+        recs_bad = {0: Record(h_own, 9, h_own),
+                    5: Record(h_high, 50, h_high)}
+        d2 = DenseCrdt("dd", N, wall_clock=FakeClock(start=BASE + 30))
+        m2 = MapCrdt("dd", wall_clock=FakeClock(start=BASE + 30))
+        errs = []
+        for merge in (lambda: d2.merge_records(dict(recs_bad)),
+                      lambda: m2.merge(dict(recs_bad))):
+            with pytest.raises(DuplicateNodeException) as ei:
+                merge()
+            errs.append(ei.value)
+        assert str(errs[0]) == str(errs[1])
+        assert (d2.canonical_time.logical_time
+                == m2.canonical_time.logical_time)
+        assert len(d2) == 0                      # store untouched
+
     def test_delta_export_since_over_json(self):
         a = make("na")
         a.put_batch([0], [1])
@@ -392,6 +425,20 @@ class TestWatch:
         # bulk paths skip host emission entirely.
         assert not c._hub.active
 
+    def test_watch_cycles_do_not_accumulate_streams(self):
+        c = make()
+        for _ in range(5):
+            off = c.watch().listen(lambda e: None)
+            off()
+        assert c._hub._streams == []
+        # re-listening on a detached stream re-attaches it
+        s = c.watch()
+        s.listen(lambda e: None)()
+        got = []
+        s.listen(got.append)
+        c.put_batch([0], [1])
+        assert got == [(0, 1)]
+
 
 class TestResume:
     def test_checkpoint_roundtrip(self, tmp_path):
@@ -406,6 +453,55 @@ class TestResume:
         # Resume rebuilt the clock from the lanes (crdt.dart:114-121).
         assert (back.canonical_time.logical_time
                 == a.canonical_time.logical_time)
+
+    def test_snapshot_preserves_foreign_attribution(self, tmp_path):
+        # Ordinal lanes index the node table; a snapshot without it
+        # cannot attribute foreign records after resume.
+        a, b = make("na"), make("nb", BASE + 5)
+        b.put_batch([2], [22])
+        a.merge(*b.export_delta())
+        p = str(tmp_path / "a.npz")
+        a.save(p)
+        back = DenseCrdt.load("na", p,
+                              wall_clock=FakeClock(start=BASE + 999))
+        assert back.record_map()[2].hlc.node_id == "nb"
+        assert back.to_json() == a.to_json()
+        # Resume rebuilds the clock from the stored lanes — the volatile
+        # post-merge send bump is deliberately NOT persisted
+        # (refreshCanonicalTime, crdt.dart:114-121).
+        from crdt_tpu.ops.dense import dense_max_logical_time
+        assert (back.canonical_time.logical_time
+                == int(dense_max_logical_time(a.store)))
+
+    def test_resume_under_mid_sorting_new_id(self, tmp_path):
+        # Resuming under a node id that sorts INTO the stored table
+        # must re-encode the ordinal lanes, not shift attribution.
+        z = make("nz")
+        b = make("nb", BASE + 3)
+        b.put_batch([0], [1])
+        z.put_batch([1], [2])
+        z.merge(*b.export_delta())
+        p = str(tmp_path / "z.npz")
+        z.save(p)
+        taken = DenseCrdt.load("nc", p,   # 'nc' sorts between nb and nz
+                               wall_clock=FakeClock(start=BASE + 999))
+        assert taken.record_map()[0].hlc.node_id == "nb"
+        assert taken.record_map()[1].hlc.node_id == "nz"
+
+    def test_lane_only_snapshot_still_loads(self, tmp_path):
+        from crdt_tpu.checkpoint import load_dense_node_ids
+        a = make()
+        a.put_batch([0], [5])
+        p = str(tmp_path / "lanes.npz")
+        save_dense(a.store, p)   # store-level: no table
+        assert load_dense_node_ids(p) is None
+        back = DenseCrdt("na", N, store=load_dense(p),
+                         wall_clock=FakeClock(start=BASE + 999))
+        assert back.get(0) == 5
+        # ...but the model-level loader refuses it: without the table
+        # the ordinal lanes would be silently re-attributed.
+        with pytest.raises(ValueError):
+            DenseCrdt.load("na", p)
 
     def test_stats(self):
         a, b = make("na"), make("nb", BASE + 5)
