@@ -36,9 +36,10 @@ import jax.numpy as jnp
 from .. import crdt_json
 from ..hlc import (ClockDriftException, DuplicateNodeException, Hlc,
                    wall_clock_millis)
-from ..ops.dense import (DenseChangeset, DenseStore, FaninResult,
+from ..ops.dense import (DenseChangeset, DenseStore, FaninResult, _NEG,
                          dense_delta_mask, dense_max_logical_time,
-                         empty_dense_store, fanin_step, store_to_changeset)
+                         empty_dense_store, fanin_step, fanin_stream,
+                         store_to_changeset)
 from ..ops.merge import recv_guards
 from ..ops.packing import NodeTable
 from ..record import (KeyDecoder, KeyEncoder, Record, ValueDecoder,
@@ -365,14 +366,37 @@ class DenseCrdt:
             [self._table.ordinal(n) for n in node_ids], jnp.int32)
         return cs._replace(node=peer_to_local[cs.node])
 
+    # Above this many replica rows the fold is executed as a lax.scan
+    # over fixed-size chunks instead of a Python-unrolled [R, N] batch:
+    # compile time stays flat in the peer count and one compiled step
+    # serves every stream length. Results are bit-identical (the stream
+    # is stamped with the union-final canonical).
+    STREAM_THRESHOLD_ROWS = 16
+    STREAM_CHUNK_ROWS = 8
+
     def _dispatch_fanin(self, cs: DenseChangeset, wall: int):
         """Run the fan-in join; subclasses route to other executors.
         Returns ``(new_store, res)`` with a FaninResult-compatible res."""
-        return fanin_step(
-            self._store, cs,
-            jnp.int64(self._canonical_time.logical_time),
-            jnp.int32(self._table.ordinal(self._node_id)),
-            jnp.int64(wall))
+        canonical = jnp.int64(self._canonical_time.logical_time)
+        local = jnp.int32(self._table.ordinal(self._node_id))
+        r = cs.lt.shape[0]
+        if r <= self.STREAM_THRESHOLD_ROWS:
+            return fanin_step(self._store, cs, canonical, local,
+                              jnp.int64(wall))
+        rc = self.STREAM_CHUNK_ROWS
+        pad = (-r) % rc
+        if pad:
+            cs = DenseChangeset(*(
+                jnp.concatenate([lane,
+                                 jnp.zeros((pad,) + lane.shape[1:],
+                                           lane.dtype)])
+                for lane in cs))
+        chunks = DenseChangeset(*(
+            lane.reshape(-1, rc, lane.shape[1]) for lane in cs))
+        stamp = jnp.maximum(canonical,
+                            jnp.max(jnp.where(cs.valid, cs.lt, _NEG)))
+        return fanin_stream(self._store, chunks, canonical, local,
+                            jnp.int64(wall), stamp)
 
     def _exact_guards(self, cs: DenseChangeset, res, wall: int):
         """Exact r-major sequential guard diagnostics (the visit order

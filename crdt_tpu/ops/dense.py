@@ -90,7 +90,8 @@ class FaninResult(NamedTuple):
     win_count: jax.Array       # int32 number of adopted records
     win: jax.Array             # bool[N] per-slot adopted mask (watch/C13)
     any_bad: jax.Array         # bool — some recv guard tripped
-    first_bad: jax.Array       # int32 flat r-major index of first offender
+    first_bad: jax.Array       # flat r-major index of first offender
+    #                            (int32 one-shot; int64 from streams)
     first_is_dup: jax.Array    # bool — duplicate-node (vs drift) there
     canonical_at_fail: jax.Array  # int64 canonical BEFORE failing record
 
@@ -164,14 +165,22 @@ def reduce_replicas(cs: DenseChangeset) -> Tuple[jax.Array, jax.Array,
 @jax.jit
 def fanin_step(store: DenseStore, cs: DenseChangeset,
                canonical_lt: jax.Array, local_node: jax.Array,
-               wall_millis: jax.Array
+               wall_millis: jax.Array,
+               stamp_lt: Optional[jax.Array] = None
                ) -> Tuple[DenseStore, FaninResult]:
-    """One fused R-replica fan-in lattice join. See module docstring."""
+    """One fused R-replica fan-in lattice join. See module docstring.
+
+    ``stamp_lt`` overrides the ``modified`` stamp for winners (default:
+    this step's post-absorption canonical). Streaming executors pass the
+    whole stream's final canonical so chunked execution stays
+    bit-identical to the one-shot join (crdt.dart:86-87 stamps winners
+    with the canonical AFTER all records were absorbed)."""
     any_bad, first_bad, first_is_dup, canonical_at_fail = recv_guards(
         cs.lt, cs.node, cs.valid, canonical_lt, local_node, wall_millis)
 
     new_canonical = jnp.maximum(
         canonical_lt, jnp.max(jnp.where(cs.valid, cs.lt, _NEG)))
+    stamp = new_canonical if stamp_lt is None else stamp_lt
 
     # Replica reduce + LWW join in ONE fused fold: seed the running best
     # with the local store lanes (empty slots as _NEG sentinels so any
@@ -186,7 +195,7 @@ def fanin_step(store: DenseStore, cs: DenseChangeset,
         lt=jnp.where(win, lt, store.lt),
         node=jnp.where(win, node, store.node),
         val=val,
-        mod_lt=jnp.where(win, new_canonical, store.mod_lt),
+        mod_lt=jnp.where(win, stamp, store.mod_lt),
         mod_node=jnp.where(win, local_node, store.mod_node),
         occupied=store.occupied | win,
         tomb=tomb,
@@ -205,41 +214,55 @@ def fanin_step(store: DenseStore, cs: DenseChangeset,
 @jax.jit
 def fanin_stream(store: DenseStore, chunks: DenseChangeset,
                  canonical_lt: jax.Array, local_node: jax.Array,
-                 wall_millis: jax.Array
+                 wall_millis: jax.Array,
+                 stamp_lt: Optional[jax.Array] = None
                  ) -> Tuple[DenseStore, FaninResult]:
     """Streaming fan-in over [C, Rc, N] chunked changesets via lax.scan.
 
     Replica counts too large for one resident [R, N] batch stream
-    through in chunks; the store is the scan carry. Equivalent to C
-    sequential ``fanin_step`` merges (each chunk's winners are stamped
-    with that chunk's post-absorption canonical time — the same
-    ``modified`` semantics sequential pairwise merging produces,
-    crdt.dart:87)."""
+    through in chunks; the store is the scan carry. With the default
+    ``stamp_lt=None`` this is equivalent to C sequential ``fanin_step``
+    merges (each chunk's winners stamped with that chunk's
+    post-absorption canonical — the ``modified`` semantics sequential
+    pairwise merging produces, crdt.dart:87). Passing the stream-final
+    canonical as ``stamp_lt`` instead makes the result bit-identical to
+    ONE fused join of all C×Rc rows (union semantics — what
+    ``DenseCrdt.merge_many`` promises regardless of executor)."""
 
     chunk_size = chunks.lt.shape[1] * chunks.lt.shape[2]
 
     def step(carry, chunk):
         st, canon, offset, bad, fb, fd, caf, wins, winm = carry
-        st2, res = fanin_step(st, chunk, canon, local_node, wall_millis)
-        # Keep the FIRST failure's diagnostics across chunks; first_bad is
-        # reported as a GLOBAL flat r-major index across the whole stream.
+        st2, res = fanin_step(st, chunk, canon, local_node, wall_millis,
+                              stamp_lt)
+        # Keep the FIRST failure's diagnostics across chunks; first_bad
+        # is reported as a GLOBAL flat r-major index across the whole
+        # stream — int64: C*Rc*N exceeds int32 at exactly the scales
+        # this streaming path exists for.
         keep_old = bad
         return (st2, res.new_canonical, offset + chunk_size,
                 bad | res.any_bad,
-                jnp.where(keep_old, fb, offset + res.first_bad),
+                jnp.where(keep_old, fb,
+                          offset + res.first_bad.astype(jnp.int64)),
                 jnp.where(keep_old, fd, res.first_is_dup),
                 jnp.where(keep_old, caf, res.canonical_at_fail),
                 wins + res.win_count, winm | res.win), None
 
-    init = (store, canonical_lt, jnp.int32(0),
-            jnp.asarray(False), jnp.int32(0), jnp.asarray(False),
+    init = (store, canonical_lt, jnp.int64(0),
+            jnp.asarray(False), jnp.int64(0), jnp.asarray(False),
             jnp.int64(0), jnp.int32(0),
             jnp.zeros((store.n_slots,), bool))
     (st, canon, _, bad, fb, fd, caf, wins, winm), _ = jax.lax.scan(
         step, init, chunks)
-    return st, FaninResult(new_canonical=canon, win_count=wins, win=winm,
-                           any_bad=bad, first_bad=fb, first_is_dup=fd,
-                           canonical_at_fail=caf)
+    # Adopted-record accounting follows the stamping semantics: the
+    # sequential mode (stamp_lt=None) counts a slot once per chunk that
+    # re-won it, like C sequential merges would; the union mode counts
+    # winning SLOTS from the final mask, like the one-shot join.
+    win_count = (wins if stamp_lt is None
+                 else jnp.sum(winm).astype(jnp.int32))
+    return st, FaninResult(new_canonical=canon, win_count=win_count,
+                           win=winm, any_bad=bad, first_bad=fb,
+                           first_is_dup=fd, canonical_at_fail=caf)
 
 
 @jax.jit

@@ -224,10 +224,12 @@ class PallasFaninResult(NamedTuple):
     any_drift: jax.Array      # bool
 
 
-# Tile geometry: (sublane, lane) = (8, 512) int32 tiles, the Mosaic
-# alignment floor for 32-bit types (sublane % 8 == 0, lane % 128 == 0).
+# Tile geometry: (sublane, lane) int32 tiles (Mosaic floor: sublane %
+# 8 == 0, lane % 128 == 0). (8, 1024) measured fastest on v5e — 4.65B
+# merges/s vs 4.34B at (8, 512), 3.85B at (8, 2048), 3.80B at (32, 512);
+# (32, 1024) exceeds VMEM and falls back to the XLA fold.
 _SB = 8
-_LANE = 512
+_LANE = 1024
 TILE = _SB * _LANE  # n_slots must be a multiple of this
 
 
@@ -239,7 +241,7 @@ def pallas_fanin_step(store: SplitStore, cs: SplitChangeset,
                       ) -> Tuple[SplitStore, PallasFaninResult]:
     """Fused fan-in on split lanes. Same store-lane/canonical results as
     `ops.dense.fanin_step`; guard flags per the module docstring.
-    ``n_slots`` must be a multiple of ``TILE`` (= 4096)."""
+    ``n_slots`` must be a multiple of ``TILE`` (= ``_SB * _LANE``)."""
     r, n = cs.hi.shape
     assert n % TILE == 0, (n, TILE)
     rows = n // _LANE

@@ -10,7 +10,7 @@ hard part 6).
 
 from __future__ import annotations
 
-from typing import Any, Callable, Iterator, List, NamedTuple, Optional
+from typing import Any, Callable, List, NamedTuple, Optional
 
 
 class ChangeEvent(NamedTuple):

@@ -186,6 +186,95 @@ class TestMergeManyOrdinals:
         assert len(c) == 0
 
 
+class TestStreamedFanin:
+    """merge_many above STREAM_THRESHOLD_ROWS runs as a lax.scan stream;
+    results must be bit-identical to the one-shot fused join."""
+
+    def _writers(self, n_writers, seed=0):
+        import random
+        rng = random.Random(seed)
+        ws = []
+        for i in range(n_writers):
+            w = DenseCrdt(f"w{i:02d}", N,
+                          wall_clock=FakeClock(start=BASE + rng.randrange(30)))
+            slots = sorted(rng.sample(range(N), rng.randrange(1, 8)))
+            if rng.random() < 0.3:
+                w.delete_batch(slots)
+            else:
+                w.put_batch(slots, [rng.randrange(100) for _ in slots])
+            ws.append(w)
+        return ws
+
+    @pytest.mark.parametrize("n_writers", [17, 24, 40])
+    def test_stream_matches_one_shot(self, n_writers):
+        deltas = [w.export_delta() for w in self._writers(n_writers)]
+        streamed = DenseCrdt("hub", N, wall_clock=FakeClock(start=BASE + 99))
+        assert n_writers > streamed.STREAM_THRESHOLD_ROWS
+        one_shot = DenseCrdt("hub", N, wall_clock=FakeClock(start=BASE + 99))
+        one_shot.STREAM_THRESHOLD_ROWS = 10 ** 9   # force unrolled fold
+        streamed.merge_many(list(deltas))
+        one_shot.merge_many(list(deltas))
+        for lane in DenseCrdt("x", N).store._fields:
+            np.testing.assert_array_equal(
+                np.asarray(getattr(streamed.store, lane)),
+                np.asarray(getattr(one_shot.store, lane)), err_msg=lane)
+        assert (streamed.canonical_time.logical_time
+                == one_shot.canonical_time.logical_time)
+        assert streamed.stats.records_adopted == one_shot.stats.records_adopted
+
+    def test_stream_guard_diagnostics_match(self):
+        # A duplicate-id record deep in the stream (row > threshold)
+        # must raise the same payload as the unrolled path.
+        ws = self._writers(20, seed=3)
+        dup = DenseCrdt("hub", N, wall_clock=FakeClock(start=BASE + 500))
+        dup.put_batch([7], [1])
+        deltas = [w.export_delta() for w in ws] + [dup.export_delta()]
+        errs = []
+        for thresh in (16, 10 ** 9):
+            hub = DenseCrdt("hub", N, wall_clock=FakeClock(start=BASE + 99))
+            hub.STREAM_THRESHOLD_ROWS = thresh
+            with pytest.raises(DuplicateNodeException) as ei:
+                hub.merge_many(list(deltas))
+            errs.append((str(ei.value), hub.canonical_time.logical_time))
+        assert errs[0] == errs[1]
+
+
+class TestMergeAlgebra:
+    """The CRDT laws on the dense fan-in (SURVEY.md §5: the moral
+    equivalent of race detection — convergence is algebraic)."""
+
+    def _delta(self, node, slots, vals, start):
+        w = DenseCrdt(node, N, wall_clock=FakeClock(start=start))
+        w.put_batch(slots, vals)
+        return w.export_delta()
+
+    def test_idempotent(self):
+        d = self._delta("w1", [0, 3], [1, 2], BASE)
+        hub = DenseCrdt("hub", N, wall_clock=FakeClock(start=BASE + 9))
+        hub.merge(*d)
+        snap = hub.to_json()
+        hub.merge(*d)          # merging the same delta again: no-op
+        assert hub.to_json() == snap
+
+    def test_commutative(self):
+        d1 = self._delta("w1", [0, 3], [1, 2], BASE)
+        d2 = self._delta("w2", [0, 5], [7, 8], BASE)   # conflicting slot 0
+        a = DenseCrdt("hub", N, wall_clock=FakeClock(start=BASE + 9))
+        b = DenseCrdt("hub", N, wall_clock=FakeClock(start=BASE + 9))
+        a.merge(*d1), a.merge(*d2)
+        b.merge(*d2), b.merge(*d1)
+        assert a.to_json() == b.to_json()
+
+    def test_associative_grouping(self):
+        ds = [self._delta(f"w{i}", [i, 9], [i, 10 + i], BASE + i)
+              for i in range(3)]
+        a = DenseCrdt("hub", N, wall_clock=FakeClock(start=BASE + 9))
+        b = DenseCrdt("hub", N, wall_clock=FakeClock(start=BASE + 9))
+        a.merge_many([ds[0], ds[1]]), a.merge_many([ds[2]])
+        b.merge_many([ds[0]]), b.merge_many([ds[1], ds[2]])
+        assert a.to_json() == b.to_json()
+
+
 class TestDifferentialVsOracle:
     """DenseCrdt vs MapCrdt under equivalent random op schedules: the
     observable record state (event HLC + value + tombstone per key)
