@@ -39,7 +39,7 @@ from ..hlc import (ClockDriftException, DuplicateNodeException, Hlc,
 from ..ops.dense import (DenseChangeset, DenseStore, FaninResult, _NEG,
                          dense_delta_mask, dense_max_logical_time,
                          empty_dense_store, fanin_step, fanin_stream,
-                         store_to_changeset)
+                         pad_replica_rows, store_to_changeset)
 from ..ops.merge import recv_guards
 from ..ops.packing import NodeTable
 from ..record import (KeyDecoder, KeyEncoder, Record, ValueDecoder,
@@ -196,9 +196,7 @@ class DenseCrdt:
         post-dispatch (the device work is already queued)."""
         if not self._hub.active:
             return
-        win = np.asarray(win)
-        tomb = np.asarray(store.tomb)
-        val = np.asarray(store.val)
+        win, tomb, val = jax.device_get((win, store.tomb, store.val))
         for s in np.nonzero(win)[0]:
             self._hub.add(int(s), None if tomb[s] else int(val[s]))
 
@@ -218,8 +216,9 @@ class DenseCrdt:
         else:
             mask = dense_delta_mask(
                 self._store, jnp.int64(modified_since.logical_time))
-        mask, lt, node, val, mod_lt, mod_node, tomb = (
-            np.asarray(x) for x in
+        # One batched fetch (async prefetch per leaf) instead of seven
+        # sequential device->host round trips.
+        mask, lt, node, val, mod_lt, mod_node, tomb = jax.device_get(
             (mask, self._store.lt, self._store.node, self._store.val,
              self._store.mod_lt, self._store.mod_node, self._store.tomb))
         out: Dict[int, Record] = {}
@@ -384,13 +383,7 @@ class DenseCrdt:
             return fanin_step(self._store, cs, canonical, local,
                               jnp.int64(wall))
         rc = self.STREAM_CHUNK_ROWS
-        pad = (-r) % rc
-        if pad:
-            cs = DenseChangeset(*(
-                jnp.concatenate([lane,
-                                 jnp.zeros((pad,) + lane.shape[1:],
-                                           lane.dtype)])
-                for lane in cs))
+        cs = pad_replica_rows(cs, rc)
         chunks = DenseChangeset(*(
             lane.reshape(-1, rc, lane.shape[1]) for lane in cs))
         stamp = jnp.maximum(canonical,
@@ -452,7 +445,14 @@ class DenseCrdt:
         with merge_annotation("crdt_tpu.dense_merge"):
             new_store, res = self._dispatch_fanin(cs, wall)
 
-        if bool(res.any_bad):
+        # The small result scalars come back in ONE batched fetch: on
+        # remote-proxied backends each separate readback is a full
+        # round trip. The [N] win mask stays on device unless a watch
+        # subscriber needs it.
+        any_bad, win_count, new_canonical = jax.device_get(
+            (res.any_bad, res.win_count, res.new_canonical))
+
+        if bool(any_bad):
             exact = self._exact_guards(cs, res, wall)
             if exact is not None:
                 self._raise_guard(cs, exact, wall)
@@ -461,10 +461,10 @@ class DenseCrdt:
             # are bit-identical either way).
 
         self._store = new_store
-        self.stats.records_adopted += int(res.win_count)
+        self.stats.records_adopted += int(win_count)
         self._emit_merge_wins(new_store, res.win)
         self._canonical_time = Hlc.send(
-            Hlc.from_logical_time(int(res.new_canonical), self._node_id),
+            Hlc.from_logical_time(int(new_canonical), self._node_id),
             millis=self._wall_clock())
 
 
@@ -500,14 +500,7 @@ class ShardedDenseCrdt(DenseCrdt):
 
     def _dispatch_fanin(self, cs: DenseChangeset, wall: int):
         from ..parallel import shard_changeset
-        r_shards = self._mesh.shape["replica"]
-        r = cs.lt.shape[0]
-        pad = (-r) % r_shards
-        if pad:
-            cs = DenseChangeset(*(
-                jnp.concatenate([lane, jnp.zeros((pad,) + lane.shape[1:],
-                                                 lane.dtype)])
-                for lane in cs))
+        cs = pad_replica_rows(cs, self._mesh.shape["replica"])
         cs = shard_changeset(cs, self._mesh)
         return self._sharded_step(
             self._store, cs,

@@ -278,6 +278,19 @@ def dense_max_logical_time(store: DenseStore) -> jax.Array:
     return jnp.max(jnp.where(store.occupied, store.lt, 0))
 
 
+def pad_replica_rows(cs: DenseChangeset, multiple: int) -> DenseChangeset:
+    """Pad the replica axis with ``valid=False`` rows (all-zero lanes)
+    up to a multiple — shared by the streamed and sharded executors so
+    padding semantics can't diverge."""
+    pad = (-cs.lt.shape[0]) % multiple
+    if not pad:
+        return cs
+    return DenseChangeset(*(
+        jnp.concatenate([lane, jnp.zeros((pad,) + lane.shape[1:],
+                                         lane.dtype)])
+        for lane in cs))
+
+
 def store_to_changeset(store: DenseStore,
                        since_lt: Optional[jax.Array] = None
                        ) -> DenseChangeset:

@@ -1,0 +1,52 @@
+"""Mixed-backend replication + checkpoint/resume walkthrough.
+
+Three replicas on three different backends — the scalar oracle
+(`MapCrdt`), the device-columnar drop-in (`TpuMapCrdt`), and the dense
+array model (`DenseCrdt`) — converge over the reference JSON wire
+format (crdt_json.dart:8-37 semantics), then the dense replica
+snapshots and resumes with its writer attribution intact.
+
+Run: ``python examples/mixed_backend_example.py``
+"""
+
+import os
+import tempfile
+
+from crdt_tpu import DenseCrdt, MapCrdt, TpuMapCrdt
+
+N_SLOTS = 64
+
+dense = DenseCrdt("node-dense", N_SLOTS)
+oracle = MapCrdt("node-map")
+device = TpuMapCrdt("node-tpu")
+
+# Independent writes on each replica (int keys: dense slots).
+dense.put_batch([0, 1], [100, 101])
+oracle.put(2, 200)
+device.put(3, 300)
+device.delete(3)                      # tombstone propagates
+
+# One gossip round over the JSON wire.
+oracle.merge_json(dense.to_json(), key_decoder=int)
+device.merge_json(oracle.to_json(), key_decoder=int)
+dense.merge_json(device.to_json())
+oracle.merge_json(dense.to_json(), key_decoder=int)
+
+assert oracle.map == device.map == {0: 100, 1: 101, 2: 200}
+assert [dense.get(s) for s in (0, 1, 2, 3)] == [100, 101, 200, None]
+print("converged:", oracle.map)
+
+# Watch a slot on the dense replica.
+events = []
+dense.watch().listen(events.append)
+dense.put_batch([9], [900])
+print("watch event:", events[-1])
+
+# Snapshot the dense replica (lanes + node table) and resume.
+path = os.path.join(tempfile.mkdtemp(), "dense.npz")
+dense.save(path)
+resumed = DenseCrdt.load("node-dense", path)
+assert resumed.to_json() == dense.to_json()
+assert resumed.record_map()[2].hlc.node_id == "node-map"  # attribution
+print("resumed replica matches; record 2 written by",
+      resumed.record_map()[2].hlc.node_id)
