@@ -161,11 +161,47 @@ class DenseCrdt:
         """int64[n_slots]; only positions with ``live_mask`` are live."""
         return self._store.val
 
+    def _check_slot(self, slot: int) -> None:
+        # JAX clamps out-of-range reads to the edge instead of raising,
+        # which would answer confidently for the wrong slot.
+        if not 0 <= slot < self.n_slots:
+            raise IndexError(
+                f"slot {slot} out of range [0, {self.n_slots})")
+
     def get(self, slot: int) -> Optional[int]:
+        self._check_slot(slot)
         occ, tomb, val = (bool(self._store.occupied[slot]),
                           bool(self._store.tomb[slot]),
                           int(self._store.val[slot]))
         return val if occ and not tomb else None
+
+    def contains_slot(self, slot: int) -> bool:
+        """True if the slot holds a record, live OR tombstoned
+        (containsKey semantics, crdt.dart:141)."""
+        self._check_slot(slot)
+        return bool(self._store.occupied[slot])
+
+    def is_deleted(self, slot: int) -> Optional[bool]:
+        """None for never-written slots, else the tombstone flag
+        (crdt.dart:61-64)."""
+        self._check_slot(slot)
+        if not bool(self._store.occupied[slot]):
+            return None
+        return bool(self._store.tomb[slot])
+
+    def clear(self, purge: bool = False) -> None:
+        """Tombstone every LIVE slot with one batch HLC, or physically
+        purge (crdt.dart:67-73: clear = putAll(None for live keys))."""
+        if purge:
+            return self.purge()
+        slots = np.nonzero(np.asarray(self.live_mask))[0]
+        if slots.size:            # empty putAll never touches the clock
+            self.delete_batch(slots)
+
+    def purge(self) -> None:
+        """Physically drop all records (crdt.dart:168-169). The
+        canonical clock and node table are untouched."""
+        self._store = empty_dense_store(self.n_slots)
 
     def __len__(self) -> int:
         return int(jnp.sum(self.live_mask))
@@ -409,9 +445,19 @@ class DenseCrdt:
         bad_lt = int(cs.lt.reshape(-1)[int(res.first_bad)])
         raise ClockDriftException(bad_lt >> 16, wall)
 
-    def merge(self, cs: DenseChangeset, node_ids: Sequence[Any]) -> None:
+    def merge(self, cs, node_ids: Optional[Sequence[Any]] = None) -> None:
         """Fan-in a peer changeset. ``cs.node`` ordinals index
-        ``node_ids``; they are remapped into this replica's table."""
+        ``node_ids``; they are remapped into this replica's table.
+
+        Also accepts a record dict (slot → Record) for duck-type
+        compatibility with the `Crdt.merge` surface — `crdt_tpu.sync`
+        rounds then work across dense and record-dict backends alike."""
+        if isinstance(cs, dict):
+            return self.merge_records(cs)
+        if node_ids is None:
+            raise ValueError(
+                "merge(changeset) requires node_ids — the changeset's "
+                "ordinals are meaningless without the table they index")
         self.merge_many([(cs, node_ids)])
 
     def merge_many(self, changesets: Sequence[
@@ -535,6 +581,10 @@ class ShardedDenseCrdt(DenseCrdt):
 
     def delete_batch(self, slots) -> None:
         super().delete_batch(slots)
+        self._store = self._shard(self._store)
+
+    def purge(self) -> None:
+        super().purge()
         self._store = self._shard(self._store)
 
 

@@ -133,6 +133,76 @@ class TestReplication:
         assert a.get(0) == 1 and z.get(0) == 1
 
 
+class TestCrdtSurfaceParity:
+    """The remaining reference surface on the dense model:
+    containsKey/isDeleted/clear/purge (crdt.dart:61-73,141,168) and
+    Crdt-duck-typed sync rounds."""
+
+    def test_contains_and_is_deleted(self):
+        c = make()
+        c.put_batch([1], [10])
+        c.delete_batch([2])
+        assert c.contains_slot(1) and c.contains_slot(2)
+        assert not c.contains_slot(3)
+        assert c.is_deleted(1) is False
+        assert c.is_deleted(2) is True
+        assert c.is_deleted(3) is None
+        # OOB reads must raise, not clamp to the edge slot.
+        for bad in (N, -1, N + 100):
+            for probe in (c.get, c.contains_slot, c.is_deleted):
+                with pytest.raises(IndexError):
+                    probe(bad)
+
+    def test_merge_changeset_requires_node_ids(self):
+        a, b = make("na"), make("nb", BASE + 5)
+        a.put_batch([0], [1])
+        cs, _ = a.export_delta()
+        with pytest.raises(ValueError):
+            b.merge(cs)
+
+    def test_clear_tombstones_live_slots(self):
+        a, b = make("na"), make("nb", BASE + 5)
+        a.put_batch([0, 1], [1, 2])
+        a.clear()
+        assert len(a) == 0
+        assert a.contains_slot(0) and a.is_deleted(0)
+        # one batch HLC for the whole clear (putAll semantics)
+        assert int(a.store.lt[0]) == int(a.store.lt[1])
+        sync_dense(a, b)              # deletes propagate
+        assert b.is_deleted(0) and b.is_deleted(1)
+        # clearing an already-clear store never touches the clock
+        t = a.canonical_time.logical_time
+        a.clear()
+        assert a.canonical_time.logical_time == t
+
+    def test_purge_drops_records_keeps_clock(self):
+        c = make()
+        c.put_batch([0], [1])
+        t = c.canonical_time.logical_time
+        c.clear(purge=True)
+        assert not c.contains_slot(0) and len(c) == 0
+        assert c.canonical_time.logical_time == t
+
+    def test_sync_rounds_with_record_backends(self):
+        from crdt_tpu import MapCrdt
+        from crdt_tpu.sync import sync, sync_json
+        d = make("dd")
+        m = MapCrdt("mm", wall_clock=FakeClock(start=BASE + 5))
+        d.put_batch([0], [10])
+        m.put(1, 11)
+        sync(d, m)                    # record-map transport, duck-typed
+        assert m.map == {0: 10, 1: 11}
+        assert d.get(0) == 10 and d.get(1) == 11
+
+        d2 = make("d2")
+        m2 = MapCrdt("m2", wall_clock=FakeClock(start=BASE + 5))
+        d2.put_batch([2], [22])
+        m2.put(3, 33)
+        sync_json(d2, m2, key_decoder=int)
+        assert m2.map == {2: 22, 3: 33}
+        assert d2.get(2) == 22 and d2.get(3) == 33
+
+
 class TestMergeManyOrdinals:
     """Round-1 regression: merge_many interleaved peer interning with
     changeset encoding, so a later peer whose ids re-sorted the

@@ -151,6 +151,21 @@ def test_fuzz_sharded_vs_plain(mesh_shape, seed):
     assert_occupied_lanes_equal(sharded, plain)
 
 
+def test_clear_and_purge_stay_sharded():
+    mesh = make_fanin_mesh(2, 4)
+    c = ShardedDenseCrdt("nc", N, mesh, wall_clock=FakeClock(start=BASE))
+    c.put_batch([0, 5], [1, 2])
+    c.clear()
+    assert len(c) == 0 and c.is_deleted(0) and c.is_deleted(5)
+    c.purge()
+    assert not c.contains_slot(0)
+    # the store must still carry the key-sharded layout after purge
+    w = DenseCrdt("w", N, wall_clock=FakeClock(start=BASE + 9))
+    w.put_batch([3], [30])
+    c.merge(*w.export_delta())       # sharded step requires sharded store
+    assert c.get(3) == 30
+
+
 def test_watch_on_sharded_merge():
     # The win mask comes back key-sharded from the collectives; events
     # must still surface per slot, identically to the plain model.
