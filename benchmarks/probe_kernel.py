@@ -1,0 +1,195 @@
+"""Kernel-variant probe: where does the fan-in kernel's time go?
+
+Runs the headline shape through three kernel variants to split the
+compute vs HBM budget:
+
+- ``full``    — the production kernel (guards + join).
+- ``nojoin``  — guards removed, join only (upper bound on guard cost).
+- ``copy``    — no compute: stream cs + store through VMEM, write
+  store back (the pure memory-bandwidth ceiling for this layout).
+
+The variant kernels deliberately carry their own copies of the
+pallas_call scaffolding: they exist to measure layout effects, so they
+must be free to drift from the production geometry without touching it.
+
+Usage: python benchmarks/probe_kernel.py [--keys N] [--replicas N]
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))  # repo root (bench.py helpers)
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from bench import make_changeset, _MILLIS
+from crdt_tpu.hlc import SHIFT
+from crdt_tpu.ops.dense import empty_dense_store
+from crdt_tpu.ops.pallas_merge import (_SB, _LANE, _lex_gt, _split64,
+                                       pallas_fanin_step, split_changeset,
+                                       split_store)
+
+
+def _join_only_kernel(scalars_ref,
+                      cs_hi, cs_lo, cs_node, cs_vhi, cs_vlo, cs_tomb,
+                      st_hi, st_lo, st_node, st_vhi, st_vlo, st_tomb,
+                      st_mhi, st_mlo, st_mnode,
+                      o_hi, o_lo, o_node, o_vhi, o_vlo, o_tomb,
+                      o_mhi, o_mlo, o_mnode, win_ref):
+    local_node = scalars_ref[2]
+    newc_hi = scalars_ref[5]
+    newc_lo = scalars_ref[6].astype(jnp.uint32)
+    b_hi = st_hi[...]
+    b_lo = st_lo[...]
+    b_node = st_node[...]
+    b_vhi = st_vhi[...]
+    b_vlo = st_vlo[...]
+    b_tomb = st_tomb[...]
+    win = jnp.zeros(b_hi.shape, jnp.bool_)
+    for r in range(cs_hi.shape[0]):
+        hi = cs_hi[r]
+        lo = cs_lo[r]
+        node = cs_node[r]
+        gt = _lex_gt(hi, lo, node, b_hi, b_lo, b_node)
+        b_hi = jnp.where(gt, hi, b_hi)
+        b_lo = jnp.where(gt, lo, b_lo)
+        b_node = jnp.where(gt, node, b_node)
+        b_vhi = jnp.where(gt, cs_vhi[r], b_vhi)
+        b_vlo = jnp.where(gt, cs_vlo[r], b_vlo)
+        b_tomb = jnp.where(gt, cs_tomb[r], b_tomb)
+        win = win | gt
+    o_hi[...] = b_hi
+    o_lo[...] = b_lo
+    o_node[...] = b_node
+    o_vhi[...] = b_vhi
+    o_vlo[...] = b_vlo
+    o_tomb[...] = b_tomb
+    o_mhi[...] = jnp.where(win, newc_hi, st_mhi[...])
+    o_mlo[...] = jnp.where(win, newc_lo, st_mlo[...])
+    o_mnode[...] = jnp.where(win, local_node, st_mnode[...])
+    win_ref[...] = win.astype(jnp.int32)
+
+
+def _copy_kernel(scalars_ref,
+                 cs_hi, cs_lo, cs_node, cs_vhi, cs_vlo, cs_tomb,
+                 st_hi, st_lo, st_node, st_vhi, st_vlo, st_tomb,
+                 st_mhi, st_mlo, st_mnode,
+                 o_hi, o_lo, o_node, o_vhi, o_vlo, o_tomb,
+                 o_mhi, o_mlo, o_mnode, win_ref):
+    r_last = cs_hi.shape[0] - 1
+    # Touch every cs row so nothing is DCE'd, with one add per lane.
+    a_hi = cs_hi[0]
+    a_lo = cs_lo[0]
+    for r in range(1, r_last + 1):
+        a_hi = a_hi + cs_hi[r]
+        a_lo = a_lo + cs_lo[r]
+    o_hi[...] = st_hi[...] + a_hi
+    o_lo[...] = st_lo[...] + a_lo
+    o_node[...] = st_node[...] + cs_node[r_last]
+    o_vhi[...] = st_vhi[...] + cs_vhi[r_last]
+    o_vlo[...] = st_vlo[...] + cs_vlo[r_last]
+    o_tomb[...] = st_tomb[...] + cs_tomb[r_last]
+    o_mhi[...] = st_mhi[...]
+    o_mlo[...] = st_mlo[...]
+    o_mnode[...] = st_mnode[...]
+    win_ref[...] = cs_node[r_last]
+
+
+def _variant_call(kernel, store, cs, scalars):
+    r, n = cs.hi.shape
+    rows = n // _LANE
+    _i32 = jnp.int32
+    cs_spec = pl.BlockSpec((r, _SB, _LANE),
+                           lambda i: (_i32(0), _i32(i), _i32(0)),
+                           memory_space=pltpu.VMEM)
+    st_spec = pl.BlockSpec((_SB, _LANE), lambda i: (_i32(i), _i32(0)),
+                           memory_space=pltpu.VMEM)
+    st2d = [lane.reshape(rows, _LANE) for lane in store]
+    cs3d = [lane.reshape(r, rows, _LANE) for lane in cs]
+    out_shapes = (
+        [jax.ShapeDtypeStruct((rows, _LANE), lane.dtype) for lane in st2d] +
+        [jax.ShapeDtypeStruct((rows, _LANE), jnp.int32)])
+    outs = pl.pallas_call(
+        kernel,
+        grid=(rows // _SB,),
+        in_specs=([pl.BlockSpec((7,), lambda i: (_i32(0),),
+                                memory_space=pltpu.SMEM)] +
+                  [cs_spec] * 6 + [st_spec] * 9),
+        out_specs=tuple([st_spec] * 10),
+        out_shape=tuple(out_shapes),
+        input_output_aliases={1 + 6 + j: j for j in range(9)},
+    )(scalars, *cs3d, *st2d)
+    return outs[0].reshape(n)
+
+
+def run_variant(name: str, n_keys: int, n_replicas: int, chunk: int,
+                repeats: int = 3) -> float:
+    n_chunks = n_replicas // chunk
+    store = split_store(empty_dense_store(n_keys))
+    cs = split_changeset(make_changeset(chunk, n_keys, seed=0))
+    canonical = jnp.int64(_MILLIS << SHIFT)
+    wall = jnp.int64(_MILLIS + 10_000)
+
+    if name == "full":
+        @jax.jit
+        def run(store, cs):
+            def body(i, carry):
+                st, canon = carry
+                st2, res = pallas_fanin_step(st, cs, canon, jnp.int32(0),
+                                             wall)
+                return (st2, res.new_canonical)
+            st, canon = jax.lax.fori_loop(0, n_chunks, body,
+                                          (store, canonical))
+            return st.hi, canon
+    else:
+        kernel = _join_only_kernel if name == "nojoin" else _copy_kernel
+        canon_hi, canon_lo = _split64(canonical)
+        scalars = jnp.stack([canon_hi, canon_lo.astype(jnp.int32),
+                             jnp.int32(0), canon_hi,
+                             canon_lo.astype(jnp.int32), canon_hi,
+                             canon_lo.astype(jnp.int32)]).astype(jnp.int32)
+
+        @jax.jit
+        def run(store, cs):
+            def body(i, st):
+                hi = _variant_call(kernel, st, cs, scalars)
+                return st._replace(hi=hi)
+            st = jax.lax.fori_loop(0, n_chunks, body, store)
+            return st.hi, st.hi[0]
+
+    out, tok = run(store, cs)
+    jax.device_get(tok)
+    best = float("inf")
+    for _ in range(repeats):
+        t0 = time.perf_counter()
+        out, tok = run(store, cs)
+        jax.device_get(tok)
+        best = min(best, time.perf_counter() - t0)
+    merges = int(jnp.sum(cs.hi != cs.hi.min())) * n_chunks
+    gbytes = ((6 * chunk + 2 * 9) * n_keys * 4) * n_chunks / 1e9
+    print(f"{name:8s} {best * 1e3:8.1f} ms   {merges / best / 1e9:6.2f} "
+          f"B merges/s   {gbytes / best:6.1f} GB/s effective")
+    return best
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--keys", type=int, default=1 << 20)
+    ap.add_argument("--replicas", type=int, default=1024)
+    ap.add_argument("--chunk", type=int, default=8)
+    ap.add_argument("--variants", default="full,nojoin,copy")
+    args = ap.parse_args()
+    for name in args.variants.split(","):
+        run_variant(name, args.keys, args.replicas, args.chunk)
+
+
+if __name__ == "__main__":
+    main()

@@ -109,13 +109,16 @@ def main():
 
     emit(bench_example_oracle)
     emit(bench_example_device)
-    for replicas in (8, 64):
-        emit(lambda: bench(1 << 20, replicas, 8))
+    # Small fan-ins chain more repeats so the one-off dispatch round
+    # trip doesn't dominate (see bench.py protocol note).
+    emit(lambda: bench(1 << 20, 8, 8, repeats=256))
+    emit(lambda: bench(1 << 20, 64, 8, repeats=64))
     # Headline config on BOTH executors, side by side.
     emit(lambda: bench(1 << 20, 1024, 8, path="xla"), tag="xla")
-    emit(lambda: bench(1 << 20, 1024, 8, path="pallas"), tag="pallas")
-    emit(lambda: bench(1 << 20, 1024, 8, config="tombstone"))
-    emit(lambda: bench(1 << 20, 1024, 8, config="tiebreak"))
+    emit(lambda: bench(1 << 20, 1024, 8, path="pallas", repeats=32),
+         tag="pallas")
+    emit(lambda: bench(1 << 20, 1024, 8, config="tombstone", repeats=32))
+    emit(lambda: bench(1 << 20, 1024, 8, config="tiebreak", repeats=32))
     emit(bench_payload_wire)
 
 

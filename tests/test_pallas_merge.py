@@ -11,8 +11,10 @@ import pytest
 
 import jax.numpy as jnp
 
+from crdt_tpu.hlc import SHIFT
 from crdt_tpu.ops.dense import (DenseStore, empty_dense_store, fanin_step)
 from crdt_tpu.ops.pallas_merge import (join_store, pallas_fanin_step,
+                                       pallas_fanin_stream,
                                        split_changeset, split_store)
 
 from test_dense import LOCAL, MILLIS, lt_of, make_changeset
@@ -183,6 +185,125 @@ def test_drift_boundary_counter_bits():
                                jnp.int32(LOCAL), jnp.int64(wall),
                                interpret=True)
     assert bool(res.any_drift)
+
+
+def run_sequential_folds(store, cs, n_chunks, canonical_lt=0,
+                         local_node=LOCAL, wall=MILLIS + 10_000):
+    """The reference semantics for `pallas_fanin_stream`: n_chunks
+    XLA folds, chunk c advancing every clock by c ms, canonical
+    threaded; win masks OR'd. Guard flags from the equivalent
+    sequential kernel steps (column-local semantics)."""
+    st, canon = store, jnp.int64(canonical_lt)
+    pst = split_store(store)
+    pcanon = jnp.int64(canonical_lt)
+    win = np.zeros(store.n_slots, bool)
+    any_dup = any_drift = False
+    for c in range(n_chunks):
+        cs_c = cs._replace(lt=cs.lt + (c << SHIFT))
+        st, res = fanin_step(st, cs_c, canon, jnp.int32(local_node),
+                             jnp.int64(wall))
+        canon = res.new_canonical
+        pst, pres = pallas_fanin_step(pst, split_changeset(cs_c), pcanon,
+                                      jnp.int32(local_node),
+                                      jnp.int64(wall), interpret=True)
+        pcanon = pres.new_canonical
+        win |= np.asarray(pres.win)
+        any_dup |= bool(pres.any_dup)
+        any_drift |= bool(pres.any_drift)
+    return st, canon, win, any_dup, any_drift
+
+
+@pytest.mark.parametrize("seed", range(3))
+def test_stream_matches_sequential_folds(seed):
+    rng = random.Random(seed + 100)
+    r, n, n_chunks = 3, 2 * BLOCK, 4
+    entries = []
+    for ri in range(r):
+        for k in range(n):
+            if rng.random() < 0.6:
+                continue
+            entries.append((ri, k,
+                            lt_of(MILLIS + rng.randrange(40),
+                                  rng.randrange(3)),
+                            rng.randrange(1, 6), rng.randrange(1000),
+                            rng.random() < 0.3))
+    cs = make_changeset(r, n, entries)
+    ref_store, ref_canon, ref_win, ref_dup, ref_drift = \
+        run_sequential_folds(empty_dense_store(n), cs, n_chunks)
+
+    sst, sres = pallas_fanin_stream(
+        split_store(empty_dense_store(n)), split_changeset(cs),
+        jnp.int64(0), jnp.int32(LOCAL), jnp.int64(MILLIS + 10_000),
+        n_chunks=n_chunks, interpret=True)
+
+    assert_stores_equal(ref_store, join_store(sst))
+    assert int(sres.new_canonical) == int(ref_canon)
+    np.testing.assert_array_equal(np.asarray(sres.win), ref_win)
+    assert bool(sres.any_dup) == ref_dup
+    assert bool(sres.any_drift) == ref_drift
+
+
+def test_stream_single_chunk_equals_step():
+    cs = make_changeset(2, BLOCK, [
+        (0, 0, lt_of(MILLIS), 1, 10, False),
+        (1, 0, lt_of(MILLIS), 2, 0, True),
+        (1, 5, lt_of(MILLIS + 3), 4, 7, False)])
+    s0 = split_store(empty_dense_store(BLOCK))
+    a_st, a_res = pallas_fanin_step(s0, split_changeset(cs), jnp.int64(0),
+                                    jnp.int32(LOCAL),
+                                    jnp.int64(MILLIS + 10_000),
+                                    interpret=True)
+    b_st, b_res = pallas_fanin_stream(s0, split_changeset(cs),
+                                      jnp.int64(0), jnp.int32(LOCAL),
+                                      jnp.int64(MILLIS + 10_000),
+                                      n_chunks=1, interpret=True)
+    assert_stores_equal(join_store(a_st), join_store(b_st))
+    assert int(a_res.new_canonical) == int(b_res.new_canonical)
+    np.testing.assert_array_equal(np.asarray(a_res.win),
+                                  np.asarray(b_res.win))
+
+
+def test_stream_guards_across_chunks():
+    # A local-ordinal record beyond canonical trips dup in chunk 0; by
+    # chunk 1 the threaded canonical has absorbed chunk 0's max, but the
+    # chunk-1 record advances by 1ms and trips again — flags accumulate.
+    cs = make_changeset(1, BLOCK, [
+        (0, 0, lt_of(MILLIS), LOCAL, 1, False)])
+    _, res = pallas_fanin_stream(split_store(empty_dense_store(BLOCK)),
+                                 split_changeset(cs), jnp.int64(0),
+                                 jnp.int32(LOCAL),
+                                 jnp.int64(MILLIS + 10_000),
+                                 n_chunks=3, interpret=True)
+    assert bool(res.any_dup) and not bool(res.any_drift)
+
+    # Canonical far ahead: every chunk fast-paths, no flags, no wins.
+    ahead = lt_of(MILLIS + 1000)
+    st, res = pallas_fanin_stream(split_store(empty_dense_store(BLOCK)),
+                                  split_changeset(cs), jnp.int64(ahead),
+                                  jnp.int32(LOCAL),
+                                  jnp.int64(MILLIS + 10_000),
+                                  n_chunks=3, interpret=True)
+    assert not bool(res.any_dup)
+    assert int(res.new_canonical) == ahead
+    # The record itself still merges (guards gate the clock, LWW gates
+    # the store).
+    assert int(join_store(st).val[0]) == 1
+
+
+def test_stream_empty_store_offsets_dont_resurrect_invalid():
+    # Round-2 hazard: chunk offsets must not lift the NEG sentinel of an
+    # invalid lane above an empty store slot.
+    cs = make_changeset(1, BLOCK, [
+        (0, 0, lt_of(MILLIS), 1, 42, False)])   # slot 0 only; rest invalid
+    st, res = pallas_fanin_stream(split_store(empty_dense_store(BLOCK)),
+                                  split_changeset(cs), jnp.int64(0),
+                                  jnp.int32(LOCAL),
+                                  jnp.int64(MILLIS + 10_000),
+                                  n_chunks=4, interpret=True)
+    out = join_store(st)
+    assert int(np.sum(np.asarray(out.occupied))) == 1
+    assert bool(out.occupied[0]) and int(out.val[0]) == 42
+    assert int(np.sum(np.asarray(res.win))) == 1
 
 
 def test_split_roundtrip():
