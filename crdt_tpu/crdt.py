@@ -129,7 +129,7 @@ class Crdt(ABC, Generic[K, V]):
     # --- merge: the lattice join (crdt.dart:77-94) ---
 
     def merge(self, remote_records: Dict[K, Record[V]]) -> None:
-        local_records = self.record_map()
+        local_records = self._local_records_for(remote_records)
 
         wall = self._wall_clock()
         updated: Dict[K, Record[V]] = {}
@@ -181,6 +181,15 @@ class Crdt(ABC, Generic[K, V]):
 
     def __repr__(self) -> str:
         return repr(self.record_map())
+
+    def _local_records_for(self, keys) -> Dict[K, Record[V]]:
+        """Local records consulted by ``merge`` for the given keys.
+
+        Defaults to the full snapshot (the reference shape,
+        crdt.dart:79); backends whose store may exceed memory (e.g.
+        `SqliteCrdt`) override this with a keyed lookup so a delta
+        merge is O(delta), not O(table)."""
+        return self.record_map()
 
     # --- abstract storage primitives (crdt.dart:140-169) ---
 

@@ -1,0 +1,177 @@
+"""SQLite-backed persistent CRDT — the out-of-tree plugin pattern,
+in-tree.
+
+The reference documents persistent backends as `Crdt` subclasses built
+outside the package (README.md:39 points at hive_crdt; the abstract
+storage slots at crdt.dart:140-169 are the plugin contract, and the
+`modified` field exists precisely so such backends can answer delta
+queries, CHANGELOG.md:14-15). This module is that pattern realized on
+Python's stdlib `sqlite3`: a durable replica that speaks the same wire
+format, runs the same conformance suite, and can sync with any other
+backend (`MapCrdt`, `TpuMapCrdt`, `DenseCrdt`) or an external JSON
+peer.
+
+Storage model — one table, one row per record:
+
+- ``hlc``/``modified`` persist through the reference string codec
+  (hlc.dart:102-104), so a row is meaningful to any replica.
+- ``lt``/``modified_lt`` are the packed 64-bit logicalTimes
+  (hlc.dart:16) as INTEGER columns: ``refresh_canonical_time`` is
+  ``MAX(lt)`` (the efficient override the reference invites,
+  crdt.dart:113) and the inclusive delta bound (map_crdt.dart:44-45)
+  is an indexed ``modified_lt >= ?`` scan.
+- ``value`` is JSON text; SQL ``NULL`` is the tombstone
+  (record.dart:17). Custom value types plug in via
+  ``value_encoder``/``value_decoder`` (record.dart:3-9 typedefs).
+
+Resume-from-disk is the constructor: opening an existing database file
+seeds the canonical clock from the stored max (crdt.dart:31-33).
+"""
+
+from __future__ import annotations
+
+import json
+import sqlite3
+from typing import Any, Callable, Dict, Generic, Optional, TypeVar
+
+from ..crdt import Crdt
+from ..hlc import Hlc
+from ..record import Record
+from ..watch import ChangeHub, ChangeStream
+
+K = TypeVar("K")
+V = TypeVar("V")
+
+_SCHEMA = """
+CREATE TABLE IF NOT EXISTS records (
+    key TEXT PRIMARY KEY,
+    hlc TEXT NOT NULL,
+    lt INTEGER NOT NULL,
+    value TEXT,
+    modified TEXT NOT NULL,
+    modified_lt INTEGER NOT NULL
+);
+CREATE INDEX IF NOT EXISTS idx_records_modified_lt
+    ON records (modified_lt);
+"""
+
+
+class SqliteCrdt(Crdt[K, V], Generic[K, V]):
+    """A durable LWW-map CRDT on a SQLite database.
+
+    ``path=":memory:"`` (the default) is an ephemeral store useful for
+    tests; a filesystem path makes the replica survive restarts —
+    reconstructing is just ``SqliteCrdt(node_id, path)`` again.
+    """
+
+    def __init__(self, node_id: Any, path: str = ":memory:", *,
+                 wall_clock: Optional[Callable[[], int]] = None,
+                 key_encoder: Optional[Callable[[K], str]] = None,
+                 key_decoder: Optional[Callable[[str], K]] = None,
+                 value_encoder: Optional[Callable[[V], Any]] = None,
+                 value_decoder: Optional[Callable[[Any], V]] = None,
+                 node_decoder: Optional[Callable[[str], Any]] = None):
+        self._node_id = node_id
+        self._conn = sqlite3.connect(path)
+        self._conn.executescript(_SCHEMA)
+        self._key_enc = key_encoder or str
+        self._key_dec = key_decoder or (lambda s: s)
+        self._val_enc = value_encoder or (lambda v: v)
+        self._val_dec = value_decoder or (lambda v: v)
+        self._node_dec = node_decoder
+        self._hub = ChangeHub()
+        super().__init__(wall_clock=wall_clock)
+
+    @property
+    def node_id(self) -> Any:
+        return self._node_id
+
+    def close(self) -> None:
+        self._conn.close()
+
+    def __enter__(self) -> "SqliteCrdt[K, V]":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+    # --- codecs ---
+
+    def _encode_row(self, key: K, record: Record[V]):
+        value = (None if record.value is None
+                 else json.dumps(self._val_enc(record.value)))
+        return (self._key_enc(key), str(record.hlc),
+                record.hlc.logical_time, value, str(record.modified),
+                record.modified.logical_time)
+
+    def _decode_row(self, row) -> Record[V]:
+        _, hlc, _, value, modified, _ = row
+        return Record(
+            Hlc.parse(hlc, id_decoder=self._node_dec),
+            None if value is None else self._val_dec(json.loads(value)),
+            Hlc.parse(modified, id_decoder=self._node_dec))
+
+    # --- efficient clock rebuild (crdt.dart:113: "should be overridden
+    # if the implementation can do it more efficiently") ---
+
+    def refresh_canonical_time(self) -> None:
+        (max_lt,) = self._conn.execute(
+            "SELECT COALESCE(MAX(lt), 0) FROM records").fetchone()
+        self._canonical_time = Hlc.from_logical_time(max_lt, self._node_id)
+
+    # --- storage primitives (crdt.dart:140-169) ---
+
+    def contains_key(self, key: K) -> bool:
+        return self._conn.execute(
+            "SELECT 1 FROM records WHERE key = ?",
+            (self._key_enc(key),)).fetchone() is not None
+
+    def get_record(self, key: K) -> Optional[Record[V]]:
+        row = self._conn.execute(
+            "SELECT * FROM records WHERE key = ?",
+            (self._key_enc(key),)).fetchone()
+        return None if row is None else self._decode_row(row)
+
+    def put_record(self, key: K, record: Record[V]) -> None:
+        with self._conn:
+            self._conn.execute(
+                "INSERT OR REPLACE INTO records VALUES (?, ?, ?, ?, ?, ?)",
+                self._encode_row(key, record))
+        self._hub.add(key, record.value)
+
+    def put_records(self, record_map: Dict[K, Record[V]]) -> None:
+        with self._conn:
+            self._conn.executemany(
+                "INSERT OR REPLACE INTO records VALUES (?, ?, ?, ?, ?, ?)",
+                [self._encode_row(k, r) for k, r in record_map.items()])
+        for key, record in record_map.items():
+            self._hub.add(key, record.value)
+
+    def _local_records_for(self, keys) -> Dict[K, Record[V]]:
+        # Keyed lookup so delta merges are O(delta) rows, not a full
+        # table scan+parse (the whole point of a beyond-memory store).
+        encoded = [self._key_enc(k) for k in keys]
+        out: Dict[K, Record[V]] = {}
+        for i in range(0, len(encoded), 500):  # SQLite host-param cap
+            batch = encoded[i:i + 500]
+            rows = self._conn.execute(
+                "SELECT * FROM records WHERE key IN "
+                f"({','.join('?' * len(batch))})", batch)
+            out.update({self._key_dec(row[0]): self._decode_row(row)
+                        for row in rows})
+        return out
+
+    def record_map(self, modified_since: Optional[Hlc] = None
+                   ) -> Dict[K, Record[V]]:
+        since = 0 if modified_since is None else modified_since.logical_time
+        rows = self._conn.execute(
+            "SELECT * FROM records WHERE modified_lt >= ?", (since,))
+        return {self._key_dec(row[0]): self._decode_row(row)
+                for row in rows}
+
+    def watch(self, key: Optional[K] = None) -> ChangeStream:
+        return self._hub.stream(key)
+
+    def purge(self) -> None:
+        with self._conn:
+            self._conn.execute("DELETE FROM records")
