@@ -18,6 +18,7 @@ import json
 from datetime import datetime
 from typing import Any, Dict, Optional
 
+from . import native
 from .hlc import Hlc
 from .record import (KeyDecoder, KeyEncoder, NodeIdDecoder, Record,
                      ValueDecoder, ValueEncoder)
@@ -51,11 +52,28 @@ def encode(record_map: Dict[Any, Record],
            key_encoder: Optional[KeyEncoder] = None,
            value_encoder: Optional[ValueEncoder] = None) -> str:
     """Map of records -> wire JSON string (crdt_json.dart:8-17)."""
-    obj = {
-        (dart_str(key) if key_encoder is None else key_encoder(key)):
-            record.to_json(key, value_encoder=value_encoder)
-        for key, record in record_map.items()
-    }
+    codec = native.load()
+    if codec is not None and record_map:
+        # Batch-format the HLC strings natively; None entries (years
+        # outside 0000-9999) fall back to the Python formatter.
+        recs = list(record_map.values())
+        hlcs = codec.format_hlc_batch(
+            [r.hlc.millis for r in recs], [r.hlc.counter for r in recs],
+            [str(r.hlc.node_id) for r in recs])
+        obj = {}
+        for (key, record), hlc_str in zip(record_map.items(), hlcs):
+            k = dart_str(key) if key_encoder is None else key_encoder(key)
+            obj[k] = {
+                "hlc": record.hlc.to_json() if hlc_str is None else hlc_str,
+                "value": (record.value if value_encoder is None
+                          else value_encoder(key, record.value)),
+            }
+    else:
+        obj = {
+            (dart_str(key) if key_encoder is None else key_encoder(key)):
+                record.to_json(key, value_encoder=value_encoder)
+            for key, record in record_map.items()
+        }
     return json.dumps(obj, separators=(",", ":"), ensure_ascii=False,
                       default=_default)
 
@@ -73,6 +91,27 @@ def decode(json_str: str, canonical_time: Hlc,
     now = Hlc.now(canonical_time.node_id, millis=now_millis)
     modified = canonical_time if canonical_time >= now else now
     raw = json.loads(json_str)
+    codec = native.load()
+    if codec is not None and node_id_decoder is None and raw:
+        # Batch-parse the canonical-shape HLC strings natively; None
+        # entries (non-canonical shapes) fall back to the full Python
+        # parser per item.
+        items = list(raw.items())
+        millis_l, counter_l, node_l = codec.parse_hlc_batch(
+            [v["hlc"] for _, v in items])
+        out = {}
+        for (key, value), ms, counter, node in zip(items, millis_l,
+                                                   counter_l, node_l):
+            if ms is None:
+                record = Record.from_json(key, value, modified,
+                                          value_decoder=value_decoder)
+            else:
+                raw_v = value.get("value")
+                decoded = (raw_v if value_decoder is None or raw_v is None
+                           else value_decoder(key, raw_v))
+                record = Record(Hlc(ms, counter, node), decoded, modified)
+            out[key if key_decoder is None else key_decoder(key)] = record
+        return out
     return {
         (key if key_decoder is None else key_decoder(key)):
             Record.from_json(key, value, modified,

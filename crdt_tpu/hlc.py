@@ -22,6 +22,7 @@ outside jit).
 
 from __future__ import annotations
 
+import re
 import secrets
 import time
 from datetime import datetime, timezone
@@ -57,13 +58,59 @@ def to_base36(n: int) -> str:
     return ("-" if neg else "") + "".join(reversed(out))
 
 
+def _civil_from_days(z: int):
+    """Epoch day -> (year, month, day), proleptic Gregorian (Howard
+    Hinnant's civil_from_days — branchless integer math, no datetime)."""
+    z += 719468
+    era = (z if z >= 0 else z - 146096) // 146097
+    doe = z - era * 146097
+    yoe = (doe - doe // 1460 + doe // 36524 - doe // 146096) // 365
+    y = yoe + era * 400
+    doy = doe - (365 * yoe + yoe // 4 - yoe // 100)
+    mp = (5 * doy + 2) // 153
+    d = doy - (153 * mp + 2) // 5 + 1
+    m = mp + (3 if mp < 10 else -9)
+    return y + (m <= 2), m, d
+
+
+_CANONICAL_ISO = re.compile(
+    r"\A(\d{4})-(\d{2})-(\d{2})T(\d{2}):(\d{2}):(\d{2})\.(\d{3})Z\Z",
+    re.ASCII)
+
+_DAYS_IN_MONTH = (31, 28, 31, 30, 31, 30, 31, 31, 30, 31, 30, 31)
+
+
+def _days_in_month(y: int, m: int) -> int:
+    if m == 2 and (y % 4 == 0 and (y % 100 != 0 or y % 400 == 0)):
+        return 29
+    return _DAYS_IN_MONTH[m - 1]
+
+
+def _days_from_civil(y: int, m: int, d: int) -> int:
+    """(year, month, day) -> epoch day (inverse of `_civil_from_days`)."""
+    y -= m <= 2
+    era = (y if y >= 0 else y - 399) // 400
+    yoe = y - era * 400
+    doy = (153 * (m + (-3 if m > 2 else 9)) + 2) // 5 + d - 1
+    doe = yoe * 365 + yoe // 4 - yoe // 100 + doy
+    return era * 146097 + doe - 719468
+
+
 def _iso8601(millis: int) -> str:
     """UTC ISO-8601 with exactly 3 fractional digits and 'Z' suffix,
     matching Dart's DateTime.toIso8601String() for millisecond-precision
-    UTC times (hlc.dart:102)."""
+    UTC times (hlc.dart:102). Years outside 1-9999 raise (the datetime
+    range every parser in the system accepts) — emitting them would
+    poison the wire for all peers."""
     secs, ms = divmod(millis, 1000)
-    dt = datetime.fromtimestamp(secs, tz=timezone.utc)
-    return f"{dt.year:04d}-{dt.month:02d}-{dt.day:02d}T{dt.hour:02d}:{dt.minute:02d}:{dt.second:02d}.{ms:03d}Z"
+    days, sod = divmod(secs, 86400)
+    y, mo, d = _civil_from_days(days)
+    if not 1 <= y <= 9999:
+        raise ValueError(f"year {y} out of range for the wire codec")
+    h, rem = divmod(sod, 3600)
+    mi, s = divmod(rem, 60)
+    return (f"{y:04d}-{mo:02d}-{d:02d}T{h:02d}:{mi:02d}:{s:02d}"
+            f".{ms:03d}Z")
 
 
 _EPOCH = datetime(1970, 1, 1, tzinfo=timezone.utc)
@@ -72,7 +119,21 @@ _EPOCH = datetime(1970, 1, 1, tzinfo=timezone.utc)
 def _parse_iso_millis(s: str) -> int:
     """Parse an ISO-8601 timestamp to epoch millis, accepting the formats
     Dart's DateTime.parse accepts in practice for this codec ('T' or space
-    separator, optional fractional seconds, 'Z' or a UTC offset)."""
+    separator, optional fractional seconds, 'Z' or a UTC offset).
+
+    The canonical 24-char wire shape `YYYY-MM-DDTHH:MM:SS.mmmZ` (exactly
+    what `_iso8601` emits) takes a no-datetime fast path — it dominates
+    every wire ingest. The fast path validates shape AND calendar
+    ranges (ASCII digits only, real month/day, 24h clock); anything
+    else falls through to the strict general parser."""
+    m = _CANONICAL_ISO.match(s)
+    if m is not None:
+        y, mo, d, h, mi, sec, ms = map(int, m.groups())
+        if (1 <= mo <= 12 and 1 <= d <= _days_in_month(y, mo)
+                and h < 24 and mi < 60 and sec < 60):
+            days = _days_from_civil(y, mo, d)
+            return ((days * 86400 + h * 3600 + mi * 60 + sec) * 1000
+                    + ms)
     dt = datetime.fromisoformat(s.strip().replace(" ", "T"))
     if dt.tzinfo is None:
         dt = dt.replace(tzinfo=timezone.utc)

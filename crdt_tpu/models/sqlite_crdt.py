@@ -78,6 +78,13 @@ class SqliteCrdt(Crdt[K, V], Generic[K, V]):
         self._key_dec = key_decoder or (lambda s: s)
         self._val_enc = value_encoder or (lambda v: v)
         self._val_dec = value_decoder or (lambda v: v)
+        # HLC node ids persist as text; without a decoder a non-str
+        # node_id would parse back as str and break tie-break compares
+        # and duplicate-node detection against the typed canonical
+        # clock. Default to the node_id's own type (int("7") etc.);
+        # exotic types must pass node_decoder explicitly.
+        if node_decoder is None and not isinstance(node_id, str):
+            node_decoder = type(node_id)
         self._node_dec = node_decoder
         self._hub = ChangeHub()
         super().__init__(wall_clock=wall_clock)

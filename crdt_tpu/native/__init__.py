@@ -1,0 +1,62 @@
+"""Native runtime components (host side).
+
+The TPU compute path is JAX/XLA/Pallas; the host-side wire boundary
+(JSON codec, crdt_json.dart:8-37) is scalar string work where CPython
+is the bottleneck, so its hot primitive — the per-record HLC string
+codec — has a C implementation (`hlccodec.c`), compiled on first use
+with the system C compiler and cached next to the source.
+
+Everything degrades silently: no compiler, a failed build, or
+``CRDT_TPU_NO_NATIVE=1`` all fall back to the pure-Python codec
+(semantics are identical; the C path only accepts the canonical wire
+shape and defers everything else to Python per-item).
+"""
+
+from __future__ import annotations
+
+import importlib.util
+import os
+import subprocess
+import sysconfig
+from typing import Optional
+
+_mod = None
+_tried = False
+
+
+def load() -> Optional[object]:
+    """The `_hlccodec` extension module, or None when unavailable."""
+    global _mod, _tried
+    if _tried:
+        return _mod
+    _tried = True
+    if os.environ.get("CRDT_TPU_NO_NATIVE"):
+        return None
+    here = os.path.dirname(os.path.abspath(__file__))
+    src = os.path.join(here, "hlccodec.c")
+    suffix = sysconfig.get_config_var("EXT_SUFFIX") or ".so"
+    so = os.path.join(here, "_hlccodec" + suffix)
+    try:
+        if (not os.path.exists(so)
+                or os.path.getmtime(so) < os.path.getmtime(src)):
+            cc = (os.environ.get("CC") or sysconfig.get_config_var("CC")
+                  or "cc").split()[0]
+            include = sysconfig.get_paths()["include"]
+            # Build to a private temp path and rename into place:
+            # os.rename is atomic, so a concurrent process never dlopens
+            # a half-written .so (it sees either the old file or the
+            # complete new one).
+            tmp = f"{so}.build{os.getpid()}"
+            subprocess.run(
+                [cc, "-O2", "-shared", "-fPIC", f"-I{include}", src,
+                 "-o", tmp],
+                check=True, capture_output=True, timeout=120)
+            os.replace(tmp, so)
+        spec = importlib.util.spec_from_file_location(
+            "crdt_tpu.native._hlccodec", so)
+        mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+        _mod = mod
+    except Exception:
+        _mod = None
+    return _mod

@@ -1,0 +1,218 @@
+/* Native batch codec for the HLC wire string
+ * "YYYY-MM-DDTHH:MM:SS.mmmZ-XXXX-<node>" (hlc.dart:102-104).
+ *
+ * The host-side wire boundary (crdt_json.dart:8-37) is a per-record
+ * string codec; at 10k+ records per sync round the Python datetime
+ * round trip dominates ingest. This module batch-converts the
+ * CANONICAL shape only — exactly what `Hlc.__str__` emits — and
+ * returns None for anything else so the Python parser keeps full
+ * reference semantics (space separators, UTC offsets, odd precision).
+ *
+ * Pure CPython C API, no deps; built on first use by
+ * crdt_tpu/native/__init__.py with the system C compiler and loaded
+ * with a silent fallback to the Python path.
+ */
+
+#define PY_SSIZE_T_CLEAN
+#include <Python.h>
+#include <string.h>
+
+/* Howard Hinnant's civil-date algorithms (public domain), int64. */
+static long long days_from_civil(long long y, int m, int d) {
+    y -= m <= 2;
+    long long era = (y >= 0 ? y : y - 399) / 400;
+    long long yoe = y - era * 400;
+    long long doy = (153 * (m + (m > 2 ? -3 : 9)) + 2) / 5 + d - 1;
+    long long doe = yoe * 365 + yoe / 4 - yoe / 100 + doy;
+    return era * 146097 + doe - 719468;
+}
+
+static void civil_from_days(long long z, long long *y, int *m, int *d) {
+    z += 719468;
+    long long era = (z >= 0 ? z : z - 146096) / 146097;
+    long long doe = z - era * 146097;
+    long long yoe = (doe - doe / 1460 + doe / 36524 - doe / 146096) / 365;
+    long long yy = yoe + era * 400;
+    long long doy = doe - (365 * yoe + yoe / 4 - yoe / 100);
+    long long mp = (5 * doy + 2) / 153;
+    *d = (int)(doy - (153 * mp + 2) / 5 + 1);
+    *m = (int)(mp + (mp < 10 ? 3 : -9));
+    *y = yy + (*m <= 2);
+}
+
+static int digits(const char *s, int n, long long *out) {
+    long long v = 0;
+    for (int i = 0; i < n; i++) {
+        if (s[i] < '0' || s[i] > '9') return 0;
+        v = v * 10 + (s[i] - '0');
+    }
+    *out = v;
+    return 1;
+}
+
+static int hex4(const char *s, long long *out) {
+    long long v = 0;
+    for (int i = 0; i < 4; i++) {
+        char c = s[i];
+        int d;
+        if (c >= '0' && c <= '9') d = c - '0';
+        else if (c >= 'A' && c <= 'F') d = c - 'A' + 10;
+        else if (c >= 'a' && c <= 'f') d = c - 'a' + 10;
+        else return 0;
+        v = v * 16 + d;
+    }
+    *out = v;
+    return 1;
+}
+
+static int days_in_month(long long y, int m) {
+    static const int dim[] = {31, 28, 31, 30, 31, 30, 31, 31, 30, 31,
+                              30, 31};
+    if (m == 2 && (y % 4 == 0 && (y % 100 != 0 || y % 400 == 0)))
+        return 29;
+    return dim[m - 1];
+}
+
+/* "YYYY-MM-DDTHH:MM:SS.mmmZ" (24 chars) -> epoch millis. 1 on success.
+ * Validates calendar ranges, not just shape — an invalid date must fall
+ * through to the Python parser's ValueError, never silently normalize. */
+static int parse_canonical_iso(const char *s, long long *out) {
+    long long y, mo, d, h, mi, sec, ms;
+    if (s[4] != '-' || s[7] != '-' || s[10] != 'T' || s[13] != ':' ||
+        s[16] != ':' || s[19] != '.' || s[23] != 'Z')
+        return 0;
+    if (!digits(s, 4, &y) || !digits(s + 5, 2, &mo) ||
+        !digits(s + 8, 2, &d) || !digits(s + 11, 2, &h) ||
+        !digits(s + 14, 2, &mi) || !digits(s + 17, 2, &sec) ||
+        !digits(s + 20, 3, &ms))
+        return 0;
+    if (mo < 1 || mo > 12 || d < 1 || d > days_in_month(y, (int)mo) ||
+        h > 23 || mi > 59 || sec > 59)
+        return 0;
+    *out = (days_from_civil(y, (int)mo, (int)d) * 86400
+            + h * 3600 + mi * 60 + sec) * 1000 + ms;
+    return 1;
+}
+
+/* parse_hlc_batch(list[str]) -> (list, list, list):
+ * per item (millis:int, counter:int, node:str), or (None, None, None)
+ * when the item is not the canonical shape (caller falls back). */
+static PyObject *parse_hlc_batch(PyObject *self, PyObject *arg) {
+    if (!PyList_Check(arg)) {
+        PyErr_SetString(PyExc_TypeError, "expected a list of str");
+        return NULL;
+    }
+    Py_ssize_t n = PyList_GET_SIZE(arg);
+    PyObject *millis_l = PyList_New(n);
+    PyObject *counter_l = PyList_New(n);
+    PyObject *node_l = PyList_New(n);
+    if (!millis_l || !counter_l || !node_l) goto fail;
+
+    for (Py_ssize_t i = 0; i < n; i++) {
+        PyObject *item = PyList_GET_ITEM(arg, i);
+        Py_ssize_t len;
+        const char *s = PyUnicode_Check(item)
+            ? PyUnicode_AsUTF8AndSize(item, &len) : NULL;
+        long long ms, counter;
+        /* 24 iso + '-' + 4 hex + '-' + at least 1 node char */
+        if (!s || len < 31 || s[24] != '-' || s[29] != '-' ||
+            !parse_canonical_iso(s, &ms) || !hex4(s + 25, &counter)) {
+            if (s == NULL) PyErr_Clear();
+            Py_INCREF(Py_None); PyList_SET_ITEM(millis_l, i, Py_None);
+            Py_INCREF(Py_None); PyList_SET_ITEM(counter_l, i, Py_None);
+            Py_INCREF(Py_None); PyList_SET_ITEM(node_l, i, Py_None);
+            continue;
+        }
+        PyObject *node = PyUnicode_FromStringAndSize(s + 30, len - 30);
+        PyObject *ms_o = PyLong_FromLongLong(ms);
+        PyObject *c_o = PyLong_FromLongLong(counter);
+        if (!node || !ms_o || !c_o) {
+            Py_XDECREF(node); Py_XDECREF(ms_o); Py_XDECREF(c_o);
+            goto fail;
+        }
+        PyList_SET_ITEM(millis_l, i, ms_o);
+        PyList_SET_ITEM(counter_l, i, c_o);
+        PyList_SET_ITEM(node_l, i, node);
+    }
+    {
+        PyObject *out = PyTuple_Pack(3, millis_l, counter_l, node_l);
+        Py_DECREF(millis_l); Py_DECREF(counter_l); Py_DECREF(node_l);
+        return out;
+    }
+fail:
+    Py_XDECREF(millis_l); Py_XDECREF(counter_l); Py_XDECREF(node_l);
+    return NULL;
+}
+
+/* format_hlc_batch(list[int] millis, list[int] counter, list[str] node)
+ * -> list[str] "<iso>-<HEX4>-<node>"; None entries where millis is out
+ * of the 4-digit-year window (caller falls back). */
+static PyObject *format_hlc_batch(PyObject *self, PyObject *args) {
+    PyObject *millis_l, *counter_l, *node_l;
+    if (!PyArg_ParseTuple(args, "O!O!O!", &PyList_Type, &millis_l,
+                          &PyList_Type, &counter_l, &PyList_Type, &node_l))
+        return NULL;
+    Py_ssize_t n = PyList_GET_SIZE(millis_l);
+    if (PyList_GET_SIZE(counter_l) != n || PyList_GET_SIZE(node_l) != n) {
+        PyErr_SetString(PyExc_ValueError, "length mismatch");
+        return NULL;
+    }
+    PyObject *out = PyList_New(n);
+    if (!out) return NULL;
+
+    for (Py_ssize_t i = 0; i < n; i++) {
+        long long ms = PyLong_AsLongLong(PyList_GET_ITEM(millis_l, i));
+        long long counter = PyLong_AsLongLong(PyList_GET_ITEM(counter_l, i));
+        if (PyErr_Occurred()) { Py_DECREF(out); return NULL; }
+        PyObject *node_o = PyList_GET_ITEM(node_l, i);
+        Py_ssize_t nlen;
+        const char *node = PyUnicode_AsUTF8AndSize(node_o, &nlen);
+        if (!node) { Py_DECREF(out); return NULL; }
+
+        long long secs = ms >= 0 ? ms / 1000 : (ms - 999) / 1000;
+        int frac = (int)(ms - secs * 1000);
+        long long days = secs >= 0 ? secs / 86400 : (secs - 86399) / 86400;
+        int sod = (int)(secs - days * 86400);
+        long long y; int mo, d;
+        civil_from_days(days, &y, &mo, &d);
+        if (y < 0 || y > 9999 || counter < 0 || counter > 0xFFFF) {
+            Py_INCREF(Py_None);
+            PyList_SET_ITEM(out, i, Py_None);
+            continue;
+        }
+        char buf[64];
+        int w = snprintf(buf, sizeof buf,
+                         "%04lld-%02d-%02dT%02d:%02d:%02d.%03dZ-%04llX-",
+                         y, mo, d, sod / 3600, (sod / 60) % 60, sod % 60,
+                         frac, counter);
+        PyObject *s;
+        if (PyUnicode_IS_ASCII(node_o)) {
+            /* ASCII node: one allocation, two memcpys (bytes == chars) */
+            s = PyUnicode_New(w + nlen, 127);
+            if (s) {
+                memcpy(PyUnicode_DATA(s), buf, w);
+                memcpy((char *)PyUnicode_DATA(s) + w, node, nlen);
+            }
+        } else {
+            PyObject *prefix = PyUnicode_FromStringAndSize(buf, w);
+            s = prefix ? PyUnicode_Concat(prefix, node_o) : NULL;
+            Py_XDECREF(prefix);
+        }
+        if (!s) { Py_DECREF(out); return NULL; }
+        PyList_SET_ITEM(out, i, s);
+    }
+    return out;
+}
+
+static PyMethodDef methods[] = {
+    {"parse_hlc_batch", parse_hlc_batch, METH_O,
+     "Batch-parse canonical HLC wire strings."},
+    {"format_hlc_batch", format_hlc_batch, METH_VARARGS,
+     "Batch-format HLC components to wire strings."},
+    {NULL, NULL, 0, NULL}};
+
+static struct PyModuleDef module = {
+    PyModuleDef_HEAD_INIT, "_hlccodec",
+    "Native batch codec for HLC wire strings.", -1, methods};
+
+PyMODINIT_FUNC PyInit__hlccodec(void) { return PyModule_Create(&module); }

@@ -1,0 +1,117 @@
+"""Native HLC wire codec: differential vs the pure-Python path.
+
+The C batch codec (`crdt_tpu/native/hlccodec.c`) must be bit-identical
+to the Python codec on canonical-shape strings and must defer (None)
+on everything else. The build environment ships a C compiler, so the
+module is REQUIRED to load here — a silent fallback hiding a build
+break would otherwise go unnoticed.
+"""
+
+import random
+
+import pytest
+
+import crdt_tpu.crdt_json as crdt_json
+from crdt_tpu import Hlc, MapCrdt
+from crdt_tpu.native import load
+from crdt_tpu.testing import FakeClock
+
+
+@pytest.fixture(scope="module")
+def codec():
+    mod = load()
+    assert mod is not None, "native codec failed to build/load"
+    return mod
+
+
+def random_hlcs(n, seed=0):
+    rng = random.Random(seed)
+    nodes = ["abc", "node-x", "a-b-c", "x" * 10, "n0", "ünïcode"]
+    return [Hlc(rng.randrange(0, 1 << 44), rng.randrange(0, 1 << 16),
+                rng.choice(nodes)) for _ in range(n)]
+
+
+def test_parse_batch_matches_python(codec):
+    hlcs = random_hlcs(500)
+    strings = [str(h) for h in hlcs]
+    millis_l, counter_l, node_l = codec.parse_hlc_batch(strings)
+    for h, s, ms, c, node in zip(hlcs, strings, millis_l, counter_l,
+                                 node_l):
+        assert ms is not None, s
+        assert Hlc(ms, c, node) == h
+        assert Hlc.parse(s) == Hlc(ms, c, node)
+
+
+def test_format_batch_matches_python(codec):
+    hlcs = random_hlcs(500, seed=1)
+    out = codec.format_hlc_batch([h.millis for h in hlcs],
+                                 [h.counter for h in hlcs],
+                                 [str(h.node_id) for h in hlcs])
+    for h, s in zip(hlcs, out):
+        assert s == str(h)
+
+
+def test_non_canonical_defers(codec):
+    bad = ["", "garbage", "2026-07-29 12:00:00.000Z-0000-n",  # space sep
+           "2026-07-29T12:00:00Z-0000-n",                     # no millis
+           "2026-07-29T12:00:00.000+00:00-0000-n",            # offset
+           "2026-07-29T12:00:00.000Z-00-n"]                   # short hex
+    millis_l, _, _ = codec.parse_hlc_batch(bad)
+    assert millis_l == [None] * len(bad)
+
+
+def test_format_out_of_range_defers(codec):
+    out = codec.format_hlc_batch([-1, 400_000_000_000_000],
+                                 [0, 0], ["n", "n"])
+    # Negative millis -> year < 1970 but >= 0: formatted fine; the
+    # far-future value exceeds year 9999 -> deferred.
+    assert out[0] == str(Hlc(-1, 0, "n"))
+    assert out[1] is None
+
+
+def test_invalid_calendar_dates_rejected(codec):
+    # Shape-valid but calendar-invalid strings must NOT silently
+    # normalize — the C path defers, the Python path raises.
+    bad = ["2026-02-30T00:00:00.000Z-0000-n",   # Feb 30
+           "2026-13-01T00:00:00.000Z-0000-n",   # month 13
+           "2026-01-01T25:00:00.000Z-0000-n",   # hour 25
+           "2026-01-01T00:61:00.000Z-0000-n"]   # minute 61
+    millis_l, _, _ = codec.parse_hlc_batch(bad)
+    assert millis_l == [None] * len(bad)
+    for s in bad:
+        with pytest.raises(ValueError):
+            Hlc.parse(s)
+    # Leap day valid in leap years only.
+    assert codec.parse_hlc_batch(
+        ["2024-02-29T00:00:00.000Z-0000-n"])[0][0] is not None
+    assert codec.parse_hlc_batch(
+        ["2023-02-29T00:00:00.000Z-0000-n"])[0][0] is None
+
+
+def test_out_of_range_year_fails_fast():
+    # Encoding a year beyond 9999 must raise, not emit unparseable wire.
+    from crdt_tpu.hlc import _iso8601
+    with pytest.raises(ValueError):
+        _iso8601(400_000_000_000_000)
+    with pytest.raises(ValueError):
+        _iso8601(-63_000_000_000_000)  # before year 1
+
+
+def test_wire_roundtrip_native_vs_python(monkeypatch):
+    src = MapCrdt("remote", wall_clock=FakeClock())
+    src.put_all({f"k{i}": {"v": i, "s": "x" * (i % 23)}
+                 for i in range(200)})
+    src.delete("k3")
+    native_json = src.to_json()
+
+    monkeypatch.setattr(crdt_json.native, "load", lambda: None)
+    python_json = src.to_json()
+    assert native_json == python_json
+
+    dst_py = MapCrdt("local", wall_clock=FakeClock())
+    dst_py.merge_json(python_json)
+    monkeypatch.undo()
+    dst_nat = MapCrdt("local", wall_clock=FakeClock())
+    dst_nat.merge_json(native_json)
+    assert dst_py.record_map() == dst_nat.record_map()
+    assert dst_py.to_json() == dst_nat.to_json()

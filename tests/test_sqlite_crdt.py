@@ -145,6 +145,22 @@ class TestPersistence:
         again = {k: (r.hlc, r.value) for k, r in crdt.record_map().items()}
         assert seen == again
 
+    def test_int_node_id_roundtrips_typed(self, tmp_path):
+        # Node ids persist as text; resume must restore them with the
+        # node_id's type so tie-breaks and dup detection keep working.
+        db = str(tmp_path / "replica.db")
+        clk = FakeClock()
+        with SqliteCrdt(7, db, wall_clock=clk) as a:
+            a.put("x", 1)
+        with SqliteCrdt(7, db, wall_clock=clk) as b:
+            assert b.get_record("x").hlc.node_id == 7  # int, not "7"
+            # Tie-break against another int node must not TypeError.
+            h = b.get_record("x").hlc
+            remote = Record(Hlc(h.millis, h.counter, 9), 99,
+                            Hlc(h.millis, h.counter, 9))
+            b.merge({"x": remote})
+            assert b.get("x") == 99  # 9 > 7 wins the tie
+
     def test_purge_clears_disk(self, tmp_path):
         db = str(tmp_path / "replica.db")
         with SqliteCrdt("dur", db, wall_clock=FakeClock()) as a:
