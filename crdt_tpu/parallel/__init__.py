@@ -2,15 +2,17 @@
 a `jax.sharding.Mesh`, with XLA collectives riding ICI (DCN across
 slices). See `crdt_tpu.parallel.fanin` for the design."""
 
-from .fanin import (KEY_AXIS, REPLICA_AXIS, ShardedFaninResult,
-                    changeset_sharding, make_fanin_mesh,
+from .fanin import (KEY_AXIS, REPLICA_AXIS, SLICE_AXIS,
+                    ShardedFaninResult, changeset_sharding,
+                    make_fanin_mesh, make_multislice_fanin_mesh,
                     make_sharded_fanin, shard_changeset, shard_store,
                     sharded_delta_mask, sharded_max_logical_time,
                     store_sharding)
 
 __all__ = [
-    "KEY_AXIS", "REPLICA_AXIS", "ShardedFaninResult",
-    "changeset_sharding", "make_fanin_mesh", "make_sharded_fanin",
+    "KEY_AXIS", "REPLICA_AXIS", "SLICE_AXIS", "ShardedFaninResult",
+    "changeset_sharding", "make_fanin_mesh",
+    "make_multislice_fanin_mesh", "make_sharded_fanin",
     "shard_changeset", "shard_store", "sharded_delta_mask",
     "sharded_max_logical_time", "store_sharding",
 ]

@@ -52,6 +52,11 @@ from ..ops.merge import recv_guards
 
 REPLICA_AXIS = "replica"
 KEY_AXIS = "key"
+# Optional outer replica axis for multi-slice meshes: collectives over
+# ("slice", "replica") decompose into an ICI reduction within each
+# slice and a DCN exchange across slices — XLA picks the decomposition
+# from the mesh's device layout; the fan-in code is identical.
+SLICE_AXIS = "slice"
 
 # Plain int (not a jnp scalar): a module-level concrete array would
 # initialize the jax backend at import time, foreclosing the platform
@@ -68,25 +73,54 @@ class ShardedFaninResult(NamedTuple):
     any_drift: jax.Array      # bool — a drift guard tripped
 
 
+def _make_mesh(shape: tuple, axis_names: tuple, devices) -> Mesh:
+    import numpy as np
+    devices = np.asarray(devices if devices is not None else jax.devices())
+    want = int(np.prod(shape))
+    assert devices.size == want, (
+        f"{devices.size} devices != "
+        + "×".join(str(s) for s in shape))
+    return Mesh(devices.reshape(shape), axis_names)
+
+
 def make_fanin_mesh(n_replica_shards: int, n_key_shards: int,
                     devices=None) -> Mesh:
     """A (replica, key) mesh over the given/default devices."""
-    import numpy as np
-    devices = np.asarray(devices if devices is not None else jax.devices())
-    assert devices.size == n_replica_shards * n_key_shards, (
-        f"{devices.size} devices != {n_replica_shards}×{n_key_shards}")
-    return Mesh(devices.reshape(n_replica_shards, n_key_shards),
-                (REPLICA_AXIS, KEY_AXIS))
+    return _make_mesh((n_replica_shards, n_key_shards),
+                      (REPLICA_AXIS, KEY_AXIS), devices)
+
+
+def make_multislice_fanin_mesh(n_slices: int, n_replica_shards: int,
+                               n_key_shards: int, devices=None) -> Mesh:
+    """A (slice, replica, key) mesh for multi-slice deployments.
+
+    The replica fan-in runs over ``(slice, replica)`` jointly: the
+    inner axis reduces over ICI within each slice, the outer over DCN
+    across slices (scaling-book recipe — the mesh's device layout
+    decides which hops each collective takes). Pass the device array
+    slice-major so the outer axis really is the DCN boundary.
+    """
+    return _make_mesh((n_slices, n_replica_shards, n_key_shards),
+                      (SLICE_AXIS, REPLICA_AXIS, KEY_AXIS), devices)
+
+
+def _replica_axes(mesh: Mesh) -> tuple:
+    """Every mesh axis except the key axis fans replicas in, in mesh
+    order — ('replica',) on a 2-D mesh, ('slice', 'replica') on a
+    multi-slice mesh."""
+    return tuple(n for n in mesh.axis_names if n != KEY_AXIS)
 
 
 def store_sharding(mesh: Mesh) -> NamedSharding:
-    """Store lanes: sharded over keys, replicated over the replica axis."""
+    """Store lanes: sharded over keys, replicated over the replica
+    (and slice, if present) axes."""
     return NamedSharding(mesh, P(KEY_AXIS))
 
 
 def changeset_sharding(mesh: Mesh) -> NamedSharding:
-    """Changeset lanes [R, N]: replicas × keys over the full mesh."""
-    return NamedSharding(mesh, P(REPLICA_AXIS, KEY_AXIS))
+    """Changeset lanes [R, N]: replicas × keys over the full mesh (the
+    R dim spans every replica axis on a multi-slice mesh)."""
+    return NamedSharding(mesh, P(_replica_axes(mesh), KEY_AXIS))
 
 
 def shard_store(store: DenseStore, mesh: Mesh) -> DenseStore:
@@ -99,12 +133,15 @@ def shard_changeset(cs: DenseChangeset, mesh: Mesh) -> DenseChangeset:
     return DenseChangeset(*(jax.device_put(lane, s) for lane in cs))
 
 
-def _fanin_block(store: DenseStore, cs: DenseChangeset,
-                 canonical_lt: jax.Array, local_node: jax.Array,
-                 wall_millis: jax.Array
+def _fanin_block(replica_axes: tuple, store: DenseStore,
+                 cs: DenseChangeset, canonical_lt: jax.Array,
+                 local_node: jax.Array, wall_millis: jax.Array
                  ) -> Tuple[DenseStore, ShardedFaninResult]:
     """Per-device body under shard_map: local reduce, then the
-    lexicographic max fan-in over the replica axis."""
+    lexicographic max fan-in over the replica axes (one axis on a flat
+    mesh; (slice, replica) on a multi-slice mesh — ICI inside a slice,
+    DCN across)."""
+    all_axes = replica_axes + (KEY_AXIS,)
     # --- per-device guards (see module docstring for semantics) ---
     # The three flags ride ONE two-lane pmax (lane 0 dup, lane 1
     # drift); exception payloads come from the model's exact host-side
@@ -113,7 +150,7 @@ def _fanin_block(store: DenseStore, cs: DenseChangeset,
         cs.lt, cs.node, cs.valid, canonical_lt, local_node, wall_millis)
     flags = jnp.stack([(any_bad & first_is_dup).astype(jnp.int32),
                        (any_bad & ~first_is_dup).astype(jnp.int32)])
-    flags = jax.lax.pmax(flags, (REPLICA_AXIS, KEY_AXIS))
+    flags = jax.lax.pmax(flags, all_axes)
     any_dup = flags[0] > 0
     any_drift = flags[1] > 0
     any_bad = any_dup | any_drift
@@ -124,26 +161,30 @@ def _fanin_block(store: DenseStore, cs: DenseChangeset,
     best_node = jnp.where(any_valid, best_node, _I32_NEG)
 
     # --- cross-device lexicographic (lt, node) max over the replica
-    # axis: pmax lt → masked pmax node → stable pmin rank → one-hot psum
-    # of the winner's payload lanes. All over ICI (DCN across slices). ---
-    m1 = jax.lax.pmax(best_lt, REPLICA_AXIS)
+    # axes: pmax lt → masked pmax node → stable pmin rank → one-hot psum
+    # of the winner's payload lanes. ICI within a slice, DCN across. ---
+    m1 = jax.lax.pmax(best_lt, replica_axes)
     node_cand = jnp.where(best_lt == m1, best_node, _I32_NEG)
-    m2 = jax.lax.pmax(node_cand, REPLICA_AXIS)
+    m2 = jax.lax.pmax(node_cand, replica_axes)
     has = (best_lt == m1) & (best_node == m2)
-    rank = jax.lax.axis_index(REPLICA_AXIS)
+    # Flat rank across the replica axes, outer-major — the order the
+    # [R, N] changeset rows are laid out over the mesh, so the lowest
+    # flat rank is the earliest replica row (sequential-merge parity).
+    rank = jax.lax.axis_index(replica_axes[0])
+    for a in replica_axes[1:]:
+        rank = rank * jax.lax.axis_size(a) + jax.lax.axis_index(a)
     winner_rank = jax.lax.pmin(jnp.where(has, rank, _BIG_RANK),
-                               REPLICA_AXIS)
+                               replica_axes)
     mine = has & (rank == winner_rank)
-    g_val = jax.lax.psum(jnp.where(mine, best_val, 0), REPLICA_AXIS)
+    g_val = jax.lax.psum(jnp.where(mine, best_val, 0), replica_axes)
     g_tomb = jax.lax.psum(jnp.where(mine, best_tomb, False
-                                    ).astype(jnp.int32), REPLICA_AXIS) > 0
-    g_any = jax.lax.pmax(any_valid.astype(jnp.int32), REPLICA_AXIS) > 0
+                                    ).astype(jnp.int32), replica_axes) > 0
+    g_any = jax.lax.pmax(any_valid.astype(jnp.int32), replica_axes) > 0
 
     # --- canonical absorption: global max over every record seen ---
     new_canonical = jnp.maximum(
         canonical_lt,
-        jax.lax.pmax(jnp.max(jnp.where(g_any, m1, _NEG)),
-                     (REPLICA_AXIS, KEY_AXIS)))
+        jax.lax.pmax(jnp.max(jnp.where(g_any, m1, _NEG)), all_axes))
 
     # --- LWW vs the local key shard (strict: local wins exact ties,
     # crdt.dart:84). Identical on every device of a key column, so the
@@ -175,12 +216,14 @@ def make_sharded_fanin(mesh: Mesh):
     ``store_sharding(mesh)`` and changesets by
     ``changeset_sharding(mesh)``.
     """
+    from functools import partial
+    replica_axes = _replica_axes(mesh)
     step = jax.shard_map(
-        _fanin_block,
+        partial(_fanin_block, replica_axes),
         mesh=mesh,
         in_specs=(
             DenseStore(*([P(KEY_AXIS)] * len(DenseStore._fields))),
-            DenseChangeset(*([P(REPLICA_AXIS, KEY_AXIS)]
+            DenseChangeset(*([P(replica_axes, KEY_AXIS)]
                              * len(DenseChangeset._fields))),
             P(), P(), P(),
         ),
@@ -217,7 +260,7 @@ def sharded_max_logical_time(mesh: Mesh):
 
     def _max(store: DenseStore) -> jax.Array:
         local = jnp.max(jnp.where(store.occupied, store.lt, 0))
-        return jax.lax.pmax(local, (REPLICA_AXIS, KEY_AXIS))
+        return jax.lax.pmax(local, mesh.axis_names)
 
     return jax.jit(jax.shard_map(
         _max, mesh=mesh,

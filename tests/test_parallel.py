@@ -15,9 +15,10 @@ import jax.numpy as jnp
 
 from crdt_tpu.ops.dense import (DenseChangeset, DenseStore,
                                 empty_dense_store, fanin_step)
-from crdt_tpu.parallel import (make_fanin_mesh, make_sharded_fanin,
-                               shard_changeset, shard_store,
-                               sharded_delta_mask,
+from crdt_tpu.parallel import (make_fanin_mesh,
+                               make_multislice_fanin_mesh,
+                               make_sharded_fanin, shard_changeset,
+                               shard_store, sharded_delta_mask,
                                sharded_max_logical_time)
 
 from test_dense import LOCAL, MILLIS, lt_of, make_changeset
@@ -66,6 +67,58 @@ def test_sharded_matches_single_device(mesh_shape, seed):
     assert int(sh_res.new_canonical) == int(ref_res.new_canonical)
     assert int(sh_res.win_count) == int(ref_res.win_count)
     assert not bool(sh_res.any_bad)
+
+
+@pytest.mark.parametrize("mesh_shape", [(2, 2, 2), (4, 2, 1), (2, 1, 4),
+                                        (1, 2, 4)])
+@pytest.mark.parametrize("seed", [0, 1])
+def test_multislice_matches_single_device(mesh_shape, seed):
+    # (slice, replica, key) mesh: the fan-in reduces over BOTH replica
+    # axes (ICI within a slice, DCN across on real hardware) and must
+    # stay bit-identical to the single-device fold.
+    rng = random.Random(seed + 50)
+    r, n = 8, 32
+    cs = random_changeset(rng, r, n)
+    store = empty_dense_store(n)
+
+    ref_store, ref_res = fanin_step(store, cs, jnp.int64(0),
+                                    jnp.int32(LOCAL),
+                                    jnp.int64(MILLIS + 10_000))
+
+    mesh = make_multislice_fanin_mesh(*mesh_shape)
+    step = make_sharded_fanin(mesh)
+    sh_store, sh_res = step(shard_store(store, mesh),
+                            shard_changeset(cs, mesh),
+                            jnp.int64(0), jnp.int32(LOCAL),
+                            jnp.int64(MILLIS + 10_000))
+
+    for lane in DenseStore._fields:
+        np.testing.assert_array_equal(
+            np.asarray(getattr(ref_store, lane)),
+            np.asarray(getattr(sh_store, lane)), err_msg=lane)
+    assert int(sh_res.new_canonical) == int(ref_res.new_canonical)
+    assert int(sh_res.win_count) == int(ref_res.win_count)
+    assert not bool(sh_res.any_bad)
+    assert int(sharded_max_logical_time(mesh)(sh_store)) == \
+        int(ref_res.new_canonical)
+
+
+def test_multislice_stable_tie_across_slice_boundary():
+    # Identical (lt, node) records land on different SLICES; the lowest
+    # flat replica row must still win (outer-major rank composition).
+    mesh = make_multislice_fanin_mesh(2, 2, 2)
+    step = make_sharded_fanin(mesh)
+    n = 8
+    cs = make_changeset(4, n, [
+        (3, 0, lt_of(MILLIS), 3, 333, False),   # slice 1, inner row 1
+        (1, 0, lt_of(MILLIS), 3, 111, False),   # slice 0, inner row 1
+        (2, 0, lt_of(MILLIS), 3, 222, False),   # slice 1, inner row 0
+    ])
+    store, _ = step(shard_store(empty_dense_store(n), mesh),
+                    shard_changeset(cs, mesh),
+                    jnp.int64(0), jnp.int32(LOCAL),
+                    jnp.int64(MILLIS + 10_000))
+    assert int(store.val[0]) == 111
 
 
 def test_sharded_identical_hlc_stable_tie():

@@ -151,6 +151,30 @@ def test_fuzz_sharded_vs_plain(mesh_shape, seed):
     assert_occupied_lanes_equal(sharded, plain)
 
 
+@pytest.mark.parametrize("mesh_shape", [(2, 2, 2), (2, 1, 4)])
+def test_multislice_model_merge(mesh_shape):
+    # Regression (round-2 review): merge on a multislice mesh must pad
+    # the replica dim to the PRODUCT of the replica axes, not just the
+    # inner "replica" axis — a 1-peer merge exercises the worst case.
+    from crdt_tpu.parallel import make_multislice_fanin_mesh
+    mesh = make_multislice_fanin_mesh(*mesh_shape)
+    sharded = ShardedDenseCrdt("ns", N, mesh,
+                               wall_clock=FakeClock(start=BASE))
+    plain = DenseCrdt("ns", N, wall_clock=FakeClock(start=BASE))
+    peer = DenseCrdt("peer", N, wall_clock=FakeClock(start=BASE + 3))
+    peer.put_batch([0, 3, 9], [5, 6, 7])
+    peer.delete_batch([3])
+    delta = peer.export_delta()
+    sharded.merge_many([delta])
+    plain.merge_many([delta])
+    assert_occupied_lanes_equal(sharded, plain)
+    assert sharded.canonical_time == plain.canonical_time
+    b = DenseCrdt("nb", N, wall_clock=FakeClock(start=BASE + 9))
+    b.put_batch([4], [44])
+    sync_dense(sharded, b)
+    assert sharded.get(4) == 44 and b.get(0) == 5
+
+
 def test_clear_and_purge_stay_sharded():
     mesh = make_fanin_mesh(2, 4)
     c = ShardedDenseCrdt("nc", N, mesh, wall_clock=FakeClock(start=BASE))
