@@ -72,24 +72,44 @@ def bench_example_device(n_keys=1000, repeats=5):
         best, path="tpu_map_crdt", platform=jax.devices()[0].platform)
 
 
-def bench_payload_wire(n_keys=10_000, repeats=3):
-    """Config 5: variable-length string/JSON payloads over the wire —
-    JSON decode + merge into the device-columnar backend (payloads stay
-    host-side; only indices/winners touch the device)."""
+def _bench_wire(dst_factory, metric: str, path: str, n_keys: int,
+                repeats: int, sync_key=None):
+    """Config 5 body: varlen-payload wire JSON decode + merge into the
+    backend ``dst_factory`` builds; ``sync_key`` forces a device sync
+    after the merge (device backends only)."""
     src = MapCrdt("remote", wall_clock=FakeClock(start=_MILLIS))
     src.put_all({f"key-{i}": {"s": "x" * (8 + i % 57), "i": i}
                  for i in range(n_keys)})
     wire = src.to_json()
     best = float("inf")
     for _ in range(repeats):
-        dst = TpuMapCrdt("local", wall_clock=FakeClock(start=_MILLIS + 10))
+        dst = dst_factory()
         t0 = time.perf_counter()
         dst.merge_json(wire)
-        dst.get_record("key-0")
+        if sync_key is not None:
+            dst.get_record(sync_key)
         best = min(best, time.perf_counter() - t0)
-    return result_dict(
-        f"wire_json_{n_keys}key_varlen_payload_merges_per_sec", n_keys,
-        best, path="wire-json-host")
+    return result_dict(metric, n_keys, best, path=path)
+
+
+def bench_payload_wire(n_keys=10_000, repeats=3):
+    """Config 5: wire ingest into the device-columnar backend (payloads
+    stay host-side; only indices/winners touch the device)."""
+    return _bench_wire(
+        lambda: TpuMapCrdt("local", wall_clock=FakeClock(start=_MILLIS + 10)),
+        f"wire_json_{n_keys}key_varlen_payload_merges_per_sec",
+        "wire-json-host", n_keys, repeats, sync_key="key-0")
+
+
+def bench_payload_wire_oracle(n_keys=10_000, repeats=5):
+    """Config 5 on the host-only oracle — isolates the wire codec
+    (native batch HLC parse + merge loop) from device round-trip
+    latency, which dominates and jitters the TpuMapCrdt row on a
+    remote-proxied chip."""
+    return _bench_wire(
+        lambda: MapCrdt("local", wall_clock=FakeClock(start=_MILLIS + 10)),
+        f"wire_json_oracle_{n_keys}key_varlen_payload_merges_per_sec",
+        "wire-json-oracle-host", n_keys, repeats)
 
 
 def main():
@@ -120,6 +140,7 @@ def main():
     emit(lambda: bench(1 << 20, 1024, 8, config="tombstone", repeats=32))
     emit(lambda: bench(1 << 20, 1024, 8, config="tiebreak", repeats=32))
     emit(bench_payload_wire)
+    emit(bench_payload_wire_oracle)
 
 
 if __name__ == "__main__":

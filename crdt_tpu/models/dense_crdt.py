@@ -545,15 +545,11 @@ class ShardedDenseCrdt(DenseCrdt):
         self._store = self._shard(self._store)
 
     def _dispatch_fanin(self, cs: DenseChangeset, wall: int):
-        from ..parallel import shard_changeset
-        from ..parallel.fanin import _replica_axes
+        from ..parallel import replica_extent, shard_changeset
         # The replica dim shards over EVERY non-key mesh axis (just
         # "replica" on a flat mesh; ("slice", "replica") on a
-        # multi-slice one) — pad rows to the product of those sizes.
-        r_total = 1
-        for a in _replica_axes(self._mesh):
-            r_total *= self._mesh.shape[a]
-        cs = pad_replica_rows(cs, r_total)
+        # multi-slice one).
+        cs = pad_replica_rows(cs, replica_extent(self._mesh))
         cs = shard_changeset(cs, self._mesh)
         return self._sharded_step(
             self._store, cs,

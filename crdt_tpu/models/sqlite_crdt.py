@@ -139,17 +139,25 @@ class SqliteCrdt(Crdt[K, V], Generic[K, V]):
             (self._key_enc(key),)).fetchone()
         return None if row is None else self._decode_row(row)
 
+    # Upsert (NOT "INSERT OR REPLACE", which deletes + reinserts and so
+    # reassigns the rowid): existing keys keep their rowid, making
+    # `ORDER BY rowid` the dict-like first-insertion iteration order —
+    # wire bytes match the in-memory backends op-for-op.
+    _UPSERT = (
+        "INSERT INTO records VALUES (?, ?, ?, ?, ?, ?) "
+        "ON CONFLICT(key) DO UPDATE SET hlc=excluded.hlc, "
+        "lt=excluded.lt, value=excluded.value, "
+        "modified=excluded.modified, modified_lt=excluded.modified_lt")
+
     def put_record(self, key: K, record: Record[V]) -> None:
         with self._conn:
-            self._conn.execute(
-                "INSERT OR REPLACE INTO records VALUES (?, ?, ?, ?, ?, ?)",
-                self._encode_row(key, record))
+            self._conn.execute(self._UPSERT, self._encode_row(key, record))
         self._hub.add(key, record.value)
 
     def put_records(self, record_map: Dict[K, Record[V]]) -> None:
         with self._conn:
             self._conn.executemany(
-                "INSERT OR REPLACE INTO records VALUES (?, ?, ?, ?, ?, ?)",
+                self._UPSERT,
                 [self._encode_row(k, r) for k, r in record_map.items()])
         for key, record in record_map.items():
             self._hub.add(key, record.value)
@@ -172,7 +180,8 @@ class SqliteCrdt(Crdt[K, V], Generic[K, V]):
                    ) -> Dict[K, Record[V]]:
         since = 0 if modified_since is None else modified_since.logical_time
         rows = self._conn.execute(
-            "SELECT * FROM records WHERE modified_lt >= ?", (since,))
+            "SELECT * FROM records WHERE modified_lt >= ? ORDER BY rowid",
+            (since,))
         return {self._key_dec(row[0]): self._decode_row(row)
                 for row in rows}
 

@@ -111,6 +111,16 @@ def _replica_axes(mesh: Mesh) -> tuple:
     return tuple(n for n in mesh.axis_names if n != KEY_AXIS)
 
 
+def replica_extent(mesh: Mesh) -> int:
+    """Total replica shards = product of every non-key axis size; the
+    changeset's R dim must pad to a multiple of this before
+    `shard_changeset`."""
+    extent = 1
+    for a in _replica_axes(mesh):
+        extent *= mesh.shape[a]
+    return extent
+
+
 def store_sharding(mesh: Mesh) -> NamedSharding:
     """Store lanes: sharded over keys, replicated over the replica
     (and slice, if present) axes."""

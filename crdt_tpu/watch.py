@@ -10,6 +10,10 @@ hard part 6).
 
 from __future__ import annotations
 
+import asyncio
+import threading
+import weakref
+from collections import deque
 from typing import Any, Callable, List, NamedTuple, Optional
 
 
@@ -83,6 +87,103 @@ class ChangeStream:
     def cancel(self) -> None:
         if self in self._hub._streams:
             self._hub._streams.remove(self)
+
+    def aiter(self) -> "AsyncChangeIterator":
+        """Async iteration over future events — the Dart ``await for``
+        shape (map_crdt.dart:48-49 streams are async there natively).
+
+        Events emitted before the first ``await`` are buffered; call
+        ``close()`` (or use ``async with``) to end iteration.
+        """
+        return AsyncChangeIterator(self)
+
+
+class AsyncChangeIterator:
+    """Bridges the synchronous ChangeHub to an ``async for`` consumer.
+
+    Emission may happen on any thread (device backends emit host-side
+    after kernel writes); a lock serializes the pending-buffer → queue
+    handoff, after which delivery is marshalled onto the consuming
+    event loop with ``call_soon_threadsafe``.
+
+    Detach deterministically with ``close()`` / ``async with`` /
+    ``await aclose()`` (works with ``contextlib.aclosing``); a dropped
+    iterator also detaches on garbage collection so a bare
+    ``async for ... break`` cannot leak the hub subscription forever.
+    """
+
+    _CLOSE = object()
+
+    def __init__(self, stream: ChangeStream):
+        self._pending: deque = deque()
+        self._queue: Optional[asyncio.Queue] = None
+        self._loop: Optional[asyncio.AbstractEventLoop] = None
+        self._lock = threading.Lock()
+        self._closed = False
+        # Subscribe through a weak shim: a bound-method callback would
+        # make the iterator reachable FROM the hub (hub -> stream ->
+        # callback -> iterator), so an abandoned iterator could never
+        # be collected and __del__ could never detach it.
+        ref = weakref.ref(self)
+
+        def shim(event, _ref=ref):
+            it = _ref()
+            if it is not None:
+                it._on_event(event)
+
+        self._unsubscribe = stream.listen(shim)
+
+    def _on_event(self, event) -> None:
+        with self._lock:
+            if self._queue is None:
+                self._pending.append(event)
+                return
+            loop, queue = self._loop, self._queue
+        try:
+            loop.call_soon_threadsafe(queue.put_nowait, event)
+        except RuntimeError:
+            pass  # consuming loop already closed; drop quietly
+
+    def close(self) -> None:
+        """Stop receiving; pending events still drain, then iteration
+        raises StopAsyncIteration."""
+        if self._closed:
+            return
+        self._closed = True
+        self._unsubscribe()
+        self._on_event(self._CLOSE)
+
+    async def aclose(self) -> None:
+        self.close()
+
+    def __del__(self) -> None:
+        try:
+            if not self._closed:
+                self._unsubscribe()
+                self._closed = True
+        except Exception:
+            pass  # interpreter shutdown / partial construction
+
+    def __aiter__(self) -> "AsyncChangeIterator":
+        return self
+
+    async def __anext__(self) -> ChangeEvent:
+        if self._queue is None:
+            with self._lock:
+                self._loop = asyncio.get_running_loop()
+                self._queue = asyncio.Queue()
+                while self._pending:
+                    self._queue.put_nowait(self._pending.popleft())
+        event = await self._queue.get()
+        if event is self._CLOSE:
+            raise StopAsyncIteration
+        return event
+
+    async def __aenter__(self) -> "AsyncChangeIterator":
+        return self
+
+    async def __aexit__(self, *exc) -> None:
+        self.close()
 
 
 class ChangeHub:

@@ -131,6 +131,25 @@ def test_differential_oracle_vs_tpu(seed):
 
 
 @pytest.mark.parametrize("seed", range(3))
+def test_differential_oracle_vs_sqlite(seed):
+    # Same random-op differential as the TPU backend, against the
+    # durable backend (values are ints here so the JSON column
+    # round-trips exactly).
+    from crdt_tpu import SqliteCrdt
+    rng = random.Random(seed + 70)
+    ops = _random_ops(rng, peers=["n1", "n2", "zz"])
+    oracle = MapCrdt("abc", wall_clock=FakeClock())
+    lite = SqliteCrdt("abc", wall_clock=FakeClock())
+    for op, args in ops:
+        import copy
+        getattr(oracle, op)(*copy.deepcopy(list(args)))
+        getattr(lite, op)(*copy.deepcopy(list(args)))
+    assert oracle.to_json() == lite.to_json()
+    assert oracle.canonical_time == lite.canonical_time
+    assert oracle.map == lite.map
+
+
+@pytest.mark.parametrize("seed", range(3))
 def test_differential_replica_convergence(seed):
     """3 mixed-backend replicas converge through the wire format."""
     rng = random.Random(100 + seed)

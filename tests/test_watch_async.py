@@ -1,0 +1,126 @@
+"""Async change streams: the Dart `await for` shape over watch().
+
+The reference's watch() returns a Dart broadcast Stream consumed with
+`await for` (crdt_test.dart:95-131 uses emitsInAnyOrder). The sync
+callback hub stays the primitive; `ChangeStream.aiter()` bridges it to
+asyncio consumers.
+"""
+
+import asyncio
+
+from conformance import FakeClock
+
+from crdt_tpu import MapCrdt, SqliteCrdt, TpuMapCrdt
+
+
+def run(coro):
+    return asyncio.new_event_loop().run_until_complete(coro)
+
+
+def test_aiter_receives_pre_and_mid_iteration_events():
+    crdt = MapCrdt("n", wall_clock=FakeClock())
+    it = crdt.watch().aiter()
+    crdt.put("before", 1)  # emitted before the first await: buffered
+
+    async def consume():
+        got = []
+        async def producer():
+            await asyncio.sleep(0)
+            crdt.put("during", 2)
+        task = asyncio.ensure_future(producer())
+        async for event in it:
+            got.append((event.key, event.value))
+            if len(got) == 2:
+                it.close()
+        await task
+        return got
+
+    assert run(consume()) == [("before", 1), ("during", 2)]
+
+
+def test_aiter_key_filter():
+    crdt = MapCrdt("n", wall_clock=FakeClock())
+    it = crdt.watch(key="y").aiter()
+    crdt.put("x", 1)
+    crdt.put("y", 2)
+    crdt.put("y", 3)
+
+    async def consume():
+        got = []
+        async with it:
+            async for event in it:
+                got.append((event.key, event.value))
+                if len(got) == 2:
+                    break
+        return got
+
+    assert run(consume()) == [("y", 2), ("y", 3)]
+
+
+def test_close_drains_then_stops():
+    crdt = MapCrdt("n", wall_clock=FakeClock())
+    it = crdt.watch().aiter()
+    crdt.put("a", 1)
+    crdt.put("b", 2)
+    it.close()
+    crdt.put("after-close", 3)  # must NOT be delivered
+
+    async def consume():
+        return [(e.key, e.value) async for e in it]
+
+    assert run(consume()) == [("a", 1), ("b", 2)]
+
+
+def test_cross_thread_emission_no_loss():
+    # Events emitted from a worker thread racing the first __anext__
+    # must all arrive (the pending->queue handoff is lock-serialized).
+    import threading
+    crdt = MapCrdt("n", wall_clock=FakeClock())
+    it = crdt.watch().aiter()
+    n = 200
+
+    def producer():
+        for i in range(n):
+            crdt.put(f"k{i % 7}", i)
+        it.close()
+
+    async def consume():
+        t = threading.Thread(target=producer)
+        t.start()
+        got = [e.value async for e in it]
+        t.join()
+        return got
+
+    got = run(consume())
+    assert got == list(range(n))
+
+
+def test_break_without_close_detaches_on_gc():
+    import gc
+    crdt = MapCrdt("n", wall_clock=FakeClock())
+    hub = crdt._hub
+
+    async def consume():
+        it = crdt.watch().aiter()
+        crdt.put("a", 1)
+        async for _ in it:
+            break  # no close(), no async-with
+
+    run(consume())
+    gc.collect()
+    # The dropped iterator's subscription must not keep the hub hot.
+    assert not hub.active
+
+
+def test_aiter_works_on_all_backends():
+    for crdt in (MapCrdt("n", wall_clock=FakeClock()),
+                 TpuMapCrdt("n", wall_clock=FakeClock()),
+                 SqliteCrdt("n", wall_clock=FakeClock())):
+        it = crdt.watch().aiter()
+        crdt.put("k", 7)
+        it.close()
+
+        async def consume():
+            return [(e.key, e.value) async for e in it]
+
+        assert run(consume()) == [("k", 7)], type(crdt).__name__
