@@ -34,8 +34,9 @@ from bench import make_changeset, _MILLIS
 from crdt_tpu.hlc import SHIFT
 from crdt_tpu.ops.dense import empty_dense_store
 from crdt_tpu.ops.pallas_merge import (_SB, _LANE, _lex_gt, _split64,
-                                       pallas_fanin_step, split_changeset,
-                                       split_store)
+                                       NEG_HI, pallas_fanin_step,
+                                       pallas_fanin_stream,
+                                       split_changeset, split_store)
 
 
 def _join_only_kernel(scalars_ref,
@@ -103,6 +104,81 @@ def _copy_kernel(scalars_ref,
     win_ref[...] = cs_node[r_last]
 
 
+def _stream_noguard_kernel(n_chunks_ignored, scalars_ref,
+                           cs_hi, cs_lo, cs_node, cs_vhi, cs_vlo, cs_tomb,
+                           st_hi, st_lo, st_node, st_vhi, st_vlo, st_tomb,
+                           st_mhi, st_mlo, st_mnode,
+                           o_hi, o_lo, o_node, o_vhi, o_vlo, o_tomb,
+                           o_mhi, o_mlo, o_mnode, win_ref):
+    """The stream kernel's join with ALL guard work removed — isolates
+    the guard cost inside the fused chunk loop."""
+    c = pl.program_id(1)
+    first = c == 0
+    local_node = scalars_ref[2]
+    off = (c << SHIFT).astype(jnp.uint32)
+    b_hi = jnp.where(first, st_hi[...], o_hi[...])
+    b_lo = jnp.where(first, st_lo[...], o_lo[...])
+    b_node = jnp.where(first, st_node[...], o_node[...])
+    b_vhi = jnp.where(first, st_vhi[...], o_vhi[...])
+    b_vlo = jnp.where(first, st_vlo[...], o_vlo[...])
+    b_tomb = jnp.where(first, st_tomb[...], o_tomb[...])
+    win_prev = jnp.where(first, jnp.int32(0), win_ref[...])
+    win = jnp.zeros(b_hi.shape, jnp.bool_)
+    for r in range(cs_hi.shape[0]):
+        hi0 = cs_hi[r]
+        lo0 = cs_lo[r]
+        node = cs_node[r]
+        lo = lo0 + jnp.where(hi0 == NEG_HI, jnp.uint32(0), off)
+        hi = hi0 + (lo < lo0).astype(jnp.int32)
+        gt = _lex_gt(hi, lo, node, b_hi, b_lo, b_node)
+        b_hi = jnp.where(gt, hi, b_hi)
+        b_lo = jnp.where(gt, lo, b_lo)
+        b_node = jnp.where(gt, node, b_node)
+        b_vhi = jnp.where(gt, cs_vhi[r], b_vhi)
+        b_vlo = jnp.where(gt, cs_vlo[r], b_vlo)
+        b_tomb = jnp.where(gt, cs_tomb[r], b_tomb)
+        win = win | gt
+    o_hi[...] = b_hi
+    o_lo[...] = b_lo
+    o_node[...] = b_node
+    o_vhi[...] = b_vhi
+    o_vlo[...] = b_vlo
+    o_tomb[...] = b_tomb
+    o_mhi[...] = jnp.where(win, scalars_ref[5], st_mhi[...])
+    o_mlo[...] = jnp.where(win, scalars_ref[6].astype(jnp.uint32),
+                           st_mlo[...])
+    o_mnode[...] = jnp.where(win, local_node, st_mnode[...])
+    win_ref[...] = win_prev | win.astype(jnp.int32)
+
+
+def _stream_call(kernel, store, cs, scalars, n_chunks):
+    from functools import partial
+    r, n = cs.hi.shape
+    rows = n // _LANE
+    _i32 = jnp.int32
+    cs_spec = pl.BlockSpec((r, _SB, _LANE),
+                           lambda i, c: (_i32(0), _i32(i), _i32(0)),
+                           memory_space=pltpu.VMEM)
+    st_spec = pl.BlockSpec((_SB, _LANE), lambda i, c: (_i32(i), _i32(0)),
+                           memory_space=pltpu.VMEM)
+    st2d = [lane.reshape(rows, _LANE) for lane in store]
+    cs3d = [lane.reshape(r, rows, _LANE) for lane in cs]
+    out_shapes = (
+        [jax.ShapeDtypeStruct((rows, _LANE), lane.dtype) for lane in st2d] +
+        [jax.ShapeDtypeStruct((rows, _LANE), jnp.int32)])
+    outs = pl.pallas_call(
+        partial(kernel, n_chunks),
+        grid=(rows // _SB, n_chunks),
+        in_specs=([pl.BlockSpec((7,), lambda i, c: (_i32(0),),
+                                memory_space=pltpu.SMEM)] +
+                  [cs_spec] * 6 + [st_spec] * 9),
+        out_specs=tuple([st_spec] * 10),
+        out_shape=tuple(out_shapes),
+        input_output_aliases={1 + 6 + j: j for j in range(9)},
+    )(scalars, *cs3d, *st2d)
+    return outs[0].reshape(n)
+
+
 def _variant_call(kernel, store, cs, scalars):
     r, n = cs.hi.shape
     rows = n // _LANE
@@ -149,6 +225,25 @@ def run_variant(name: str, n_keys: int, n_replicas: int, chunk: int,
             st, canon = jax.lax.fori_loop(0, n_chunks, body,
                                           (store, canonical))
             return st.hi, canon
+    elif name == "stream":
+        @jax.jit
+        def run(store, cs):
+            st, res = pallas_fanin_stream(store, cs, canonical,
+                                          jnp.int32(0), wall,
+                                          n_chunks=n_chunks)
+            return st.hi, res.new_canonical
+    elif name == "stream-noguard":
+        canon_hi, canon_lo = _split64(canonical)
+        scalars = jnp.stack([canon_hi, canon_lo.astype(jnp.int32),
+                             jnp.int32(0), canon_hi,
+                             canon_lo.astype(jnp.int32), canon_hi,
+                             canon_lo.astype(jnp.int32)]).astype(jnp.int32)
+
+        @jax.jit
+        def run(store, cs):
+            hi = _stream_call(_stream_noguard_kernel, store, cs, scalars,
+                              n_chunks)
+            return hi, hi[0]
     else:
         kernel = _join_only_kernel if name == "nojoin" else _copy_kernel
         canon_hi, canon_lo = _split64(canonical)

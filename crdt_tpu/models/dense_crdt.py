@@ -54,7 +54,11 @@ class DenseCrdt:
     def __init__(self, node_id: Any, n_slots: int,
                  wall_clock: Optional[Callable[[], int]] = None,
                  store: Optional[DenseStore] = None,
-                 node_ids: Optional[Sequence[Any]] = None):
+                 node_ids: Optional[Sequence[Any]] = None,
+                 executor: str = "auto"):
+        assert executor in ("auto", "xla", "pallas", "pallas-interpret"), \
+            executor
+        self._executor = executor
         self._node_id = node_id
         self._wall_clock = wall_clock or wall_clock_millis
         # A seeded store's ordinal lanes index sorted(node_ids); build
@@ -409,11 +413,28 @@ class DenseCrdt:
     STREAM_THRESHOLD_ROWS = 16
     STREAM_CHUNK_ROWS = 8
 
+    def _use_pallas(self) -> bool:
+        """Route merges through the Mosaic kernel? ``executor=`` forces
+        it on ("pallas" / "pallas-interpret") or off ("xla"); "auto"
+        takes the kernel whenever the store is tile-aligned and the
+        backend is an accelerator."""
+        if self._executor == "xla":
+            return False
+        if self._executor in ("pallas", "pallas-interpret"):
+            return True
+        from ..ops.pallas_merge import TILE
+        # Mosaic lowers on TPU only — a GPU backend must keep the XLA
+        # fold, not crash in pltpu BlockSpecs.
+        return (self.n_slots % TILE == 0
+                and jax.devices()[0].platform == "tpu")
+
     def _dispatch_fanin(self, cs: DenseChangeset, wall: int):
         """Run the fan-in join; subclasses route to other executors.
         Returns ``(new_store, res)`` with a FaninResult-compatible res."""
         canonical = jnp.int64(self._canonical_time.logical_time)
         local = jnp.int32(self._table.ordinal(self._node_id))
+        if self._use_pallas():
+            return self._dispatch_pallas(cs, canonical, local, wall)
         r = cs.lt.shape[0]
         if r <= self.STREAM_THRESHOLD_ROWS:
             return fanin_step(self._store, cs, canonical, local,
@@ -427,13 +448,51 @@ class DenseCrdt:
         return fanin_stream(self._store, chunks, canonical, local,
                             jnp.int64(wall), stamp)
 
+    def _dispatch_pallas(self, cs: DenseChangeset, canonical, local,
+                         wall: int):
+        """The Mosaic executor: split 32-bit lanes through
+        `pallas_fanin_batch` (store VMEM-resident across row-group
+        chunks; optimistic guard flags — `_exact_guards` recomputes on
+        a trip because the result carries no first-offender fields)."""
+        from ..ops.pallas_merge import (join_store, pallas_fanin_batch,
+                                        split_changeset, split_store)
+        cs = pad_replica_rows(cs, self.STREAM_CHUNK_ROWS)
+        sst, pres = pallas_fanin_batch(
+            split_store(self._store), split_changeset(cs), canonical,
+            local, jnp.int64(wall),
+            chunk_rows=self.STREAM_CHUNK_ROWS,
+            interpret=self._executor == "pallas-interpret")
+        res = FaninResult(
+            new_canonical=pres.new_canonical,
+            win_count=jnp.sum(pres.win).astype(jnp.int32),
+            win=pres.win,
+            any_bad=pres.any_dup | pres.any_drift,
+            first_bad=None, first_is_dup=None, canonical_at_fail=None)
+        return join_store(sst), res
+
     def _exact_guards(self, cs: DenseChangeset, res, wall: int):
         """Exact r-major sequential guard diagnostics (the visit order
-        of crdt.dart:80-94). The single-device fan-in guards are already
-        exact; executors with coarser flags (sharded) override this to
-        recompute on the failure path — returning None clears a false
-        positive and lets the merge proceed."""
-        return res
+        of crdt.dart:80-94). The XLA fan-in's flags are already exact
+        and carry first-offender fields — returned as-is. Executors
+        with coarse/superset flags (the sharded collectives, the
+        optimistic Pallas guards) produce results WITHOUT
+        ``first_bad``; recompute exactly on the unsharded changeset —
+        failure path only — so raised exceptions carry the sequential
+        path's first-offender payload, and false positives are cleared
+        (None → merge proceeds)."""
+        if getattr(res, "first_bad", None) is not None:
+            return res
+        any_bad, first_bad, first_is_dup, canonical_at_fail = recv_guards(
+            cs.lt, cs.node, cs.valid,
+            jnp.int64(self._canonical_time.logical_time),
+            jnp.int32(self._table.ordinal(self._node_id)),
+            jnp.int64(wall))
+        if not bool(any_bad):
+            return None
+        return FaninResult(
+            new_canonical=res.new_canonical, win_count=res.win_count,
+            win=res.win, any_bad=any_bad, first_bad=first_bad,
+            first_is_dup=first_is_dup, canonical_at_fail=canonical_at_fail)
 
     def _raise_guard(self, cs: DenseChangeset, res, wall: int) -> None:
         # Store untouched; canonical rolled to the pre-failure value
@@ -557,26 +616,9 @@ class ShardedDenseCrdt(DenseCrdt):
             jnp.int32(self._table.ordinal(self._node_id)),
             jnp.int64(wall))
 
-    def _exact_guards(self, cs: DenseChangeset, res, wall: int):
-        """The sharded collectives' per-device shielding flags a
-        SUPERSET of the sequential r-major guard trips (a record on one
-        device is never shielded by an earlier record on another —
-        `crdt_tpu.parallel.fanin` docstring). Recompute the guards
-        exactly on the unsharded changeset — failure path only — so
-        raised exceptions carry the single-device path's first-offender
-        payload, and false positives are cleared (None → merge
-        proceeds, matching the single-device executor)."""
-        any_bad, first_bad, first_is_dup, canonical_at_fail = recv_guards(
-            cs.lt, cs.node, cs.valid,
-            jnp.int64(self._canonical_time.logical_time),
-            jnp.int32(self._table.ordinal(self._node_id)),
-            jnp.int64(wall))
-        if not bool(any_bad):
-            return None
-        return FaninResult(
-            new_canonical=res.new_canonical, win_count=res.win_count,
-            win=res.win, any_bad=any_bad, first_bad=first_bad,
-            first_is_dup=first_is_dup, canonical_at_fail=canonical_at_fail)
+    # _exact_guards: inherited — ShardedFaninResult carries no
+    # first_bad field, so the base recompute path handles the sharded
+    # collectives' superset flags (see `crdt_tpu.parallel.fanin`).
 
     def put_batch(self, slots, values) -> None:
         super().put_batch(slots, values)

@@ -35,8 +35,9 @@ from .dense import (DenseStore, DenseChangeset, FaninResult,
                     dense_delta_mask, dense_max_logical_time,
                     store_to_changeset)
 from .pallas_merge import (SplitStore, SplitChangeset, PallasFaninResult,
-                           pallas_fanin_step, pallas_fanin_stream,
-                           split_store, split_changeset, join_store, TILE)
+                           pallas_fanin_batch, pallas_fanin_step,
+                           pallas_fanin_stream, split_store,
+                           split_changeset, join_store, TILE)
 
 __all__ = [
     "NodeTable", "pack_logical_time", "unpack_logical_time",
@@ -46,6 +47,6 @@ __all__ = [
     "fanin_step", "fanin_stream", "dense_delta_mask",
     "dense_max_logical_time", "store_to_changeset",
     "SplitStore", "SplitChangeset", "PallasFaninResult",
-    "pallas_fanin_step", "pallas_fanin_stream", "split_store",
-    "split_changeset", "join_store", "TILE",
+    "pallas_fanin_batch", "pallas_fanin_step", "pallas_fanin_stream",
+    "split_store", "split_changeset", "join_store", "TILE",
 ]

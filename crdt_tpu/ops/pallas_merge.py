@@ -146,7 +146,8 @@ def _add_off64(hi, lo, off_u32):
     return hi + (lo2 < lo).astype(hi.dtype), lo2
 
 
-def _fanin_stream_kernel(scalars_ref,
+def _fanin_stream_kernel(exact_guards, advance_clock,
+                         scalars_ref,
                          cs_hi, cs_lo, cs_node, cs_vhi, cs_vlo, cs_tomb,
                          st_hi, st_lo, st_node, st_vhi, st_vlo, st_tomb,
                          st_mhi, st_mlo, st_mnode,
@@ -160,7 +161,21 @@ def _fanin_stream_kernel(scalars_ref,
     every logicalTime advanced by ``c`` ms (the steady-state write
     stream `bench.build_stream_fn` models); results are bit-identical
     to ``n_chunks`` sequential `fanin_step` folds threading the
-    canonical clock."""
+    canonical clock.
+
+    ``exact_guards`` (static): True runs the column-local shielded
+    recv guards in-kernel (~half the per-row compute is the running
+    cummax chain); False skips ALL in-kernel guard work — the caller
+    derives superset flags from closed-form scalar reductions instead
+    (see `pallas_fanin_stream`).
+
+    ``advance_clock`` (static): True replays ONE changeset block with
+    chunk clocks advancing 1ms per chunk (`pallas_fanin_stream`);
+    False walks DISTINCT changeset row groups with no offsets — the
+    chunked form of a single merge, stamped with the union-final
+    canonical (`pallas_fanin_batch`)."""
+    assert advance_clock or not exact_guards, (
+        "exact guards are only defined for the clock-advancing stream")
     rb = pl.program_id(0)
     c = pl.program_id(1)
     first = c == 0
@@ -173,18 +188,18 @@ def _fanin_stream_kernel(scalars_ref,
     bmax_hi = scalars_ref[5]
     bmax_lo = scalars_ref[6].astype(jnp.uint32)
 
-    off = (c << SHIFT).astype(jnp.uint32)
-    # Canonical clock after chunk c (threaded exactly as the sequential
-    # fold does): newc_c = max(canon_0, basemax + c<<SHIFT); the run
-    # seed for chunk c is newc_{c-1} (= canon_0 at c == 0).
-    nc_hi, nc_lo = _max64(canon_hi, canon_lo,
-                          *_add_off64(bmax_hi, bmax_lo, off))
-    pv_hi, pv_lo = _max64(
-        canon_hi, canon_lo,
-        *_add_off64(bmax_hi, bmax_lo,
-                    ((c - 1) << SHIFT).astype(jnp.uint32)))
-    seed_hi = jnp.where(first, canon_hi, pv_hi)
-    seed_lo = jnp.where(first, canon_lo, pv_lo)
+    if advance_clock:
+        off = (c << SHIFT).astype(jnp.uint32)
+        # Canonical clock after chunk c (threaded exactly as the
+        # sequential fold does): newc_c = max(canon_0,
+        # basemax + c<<SHIFT); the run seed for chunk c is newc_{c-1}
+        # (= canon_0 at c == 0).
+        nc_hi, nc_lo = _max64(canon_hi, canon_lo,
+                              *_add_off64(bmax_hi, bmax_lo, off))
+    else:
+        # One logical merge: every chunk stamps winners with the
+        # union-final canonical (ops.dense.fanin_stream semantics).
+        nc_hi, nc_lo = _max64(canon_hi, canon_lo, bmax_hi, bmax_lo)
 
     b_hi = jnp.where(first, st_hi[...], o_hi[...])
     b_lo = jnp.where(first, st_lo[...], o_lo[...])
@@ -193,32 +208,44 @@ def _fanin_stream_kernel(scalars_ref,
     b_vlo = jnp.where(first, st_vlo[...], o_vlo[...])
     b_tomb = jnp.where(first, st_tomb[...], o_tomb[...])
     win_prev = jnp.where(first, jnp.int32(0), win_ref[...])
-
-    run_hi = jnp.full(b_hi.shape, seed_hi, jnp.int32)
-    run_lo = jnp.full(b_hi.shape, seed_lo, jnp.uint32)
-    acc_dup = jnp.zeros(b_hi.shape, jnp.int32)
-    acc_drift = jnp.zeros(b_hi.shape, jnp.int32)
     win = jnp.zeros(b_hi.shape, jnp.bool_)
+
+    if exact_guards:
+        pv_hi, pv_lo = _max64(
+            canon_hi, canon_lo,
+            *_add_off64(bmax_hi, bmax_lo,
+                        ((c - 1) << SHIFT).astype(jnp.uint32)))
+        seed_hi = jnp.where(first, canon_hi, pv_hi)
+        seed_lo = jnp.where(first, canon_lo, pv_lo)
+        run_hi = jnp.full(b_hi.shape, seed_hi, jnp.int32)
+        run_lo = jnp.full(b_hi.shape, seed_lo, jnp.uint32)
+        acc_dup = jnp.zeros(b_hi.shape, jnp.int32)
+        acc_drift = jnp.zeros(b_hi.shape, jnp.int32)
 
     for r in range(cs_hi.shape[0]):  # static unroll over replica rows
         hi0 = cs_hi[r]
         lo0 = cs_lo[r]
         node = cs_node[r]
-        # Advance the chunk clock on real lanes only: the NEG sentinel
-        # must stay the unique minimum (its lo is 0, so a masked offset
-        # also never carries into hi).
-        lo = lo0 + jnp.where(hi0 == NEG_HI, jnp.uint32(0), off)
-        hi = hi0 + (lo < lo0).astype(jnp.int32)
+        if advance_clock:
+            # Advance the chunk clock on real lanes only: the NEG
+            # sentinel must stay the unique minimum (its lo is 0, so a
+            # masked offset also never carries into hi).
+            lo = lo0 + jnp.where(hi0 == NEG_HI, jnp.uint32(0), off)
+            hi = hi0 + (lo < lo0).astype(jnp.int32)
+        else:
+            hi, lo = hi0, lo0
 
-        slow = _lex_gt(hi, lo, jnp.int32(0), run_hi, run_lo, jnp.int32(0))
-        dup = slow & (node == local_node)
-        drift = (slow & ~dup &
-                 _lex_gt(hi, lo, jnp.int32(0),
-                         thresh_hi, thresh_lo, jnp.int32(0)))
-        acc_dup = acc_dup | dup.astype(jnp.int32)
-        acc_drift = acc_drift | drift.astype(jnp.int32)
-        run_hi = jnp.where(slow, hi, run_hi)
-        run_lo = jnp.where(slow, lo, run_lo)
+        if exact_guards:
+            slow = _lex_gt(hi, lo, jnp.int32(0),
+                           run_hi, run_lo, jnp.int32(0))
+            dup = slow & (node == local_node)
+            drift = (slow & ~dup &
+                     _lex_gt(hi, lo, jnp.int32(0),
+                             thresh_hi, thresh_lo, jnp.int32(0)))
+            acc_dup = acc_dup | dup.astype(jnp.int32)
+            acc_drift = acc_drift | drift.astype(jnp.int32)
+            run_hi = jnp.where(slow, hi, run_hi)
+            run_lo = jnp.where(slow, lo, run_lo)
 
         gt = _lex_gt(hi, lo, node, b_hi, b_lo, b_node)
         b_hi = jnp.where(gt, hi, b_hi)
@@ -248,8 +275,9 @@ def _fanin_stream_kernel(scalars_ref,
         dup_ref[0, 0] = jnp.int32(0)
         drift_ref[0, 0] = jnp.int32(0)
 
-    dup_ref[0, 0] = dup_ref[0, 0] | jnp.max(acc_dup)
-    drift_ref[0, 0] = drift_ref[0, 0] | jnp.max(acc_drift)
+    if exact_guards:
+        dup_ref[0, 0] = dup_ref[0, 0] | jnp.max(acc_dup)
+        drift_ref[0, 0] = drift_ref[0, 0] | jnp.max(acc_drift)
 
 
 # Tile geometry: (sublane, lane) int32 tiles (Mosaic floor: sublane %
@@ -280,21 +308,38 @@ def pallas_fanin_step(store: SplitStore, cs: SplitChangeset,
                                interpret=interpret)
 
 
-@partial(jax.jit, static_argnames=("n_chunks", "interpret"))
+@partial(jax.jit, static_argnames=("n_chunks", "guards", "interpret"))
 def pallas_fanin_stream(store: SplitStore, cs: SplitChangeset,
                         canonical_lt: jax.Array, local_node: jax.Array,
                         wall_millis: jax.Array, *, n_chunks: int,
+                        guards: str = "exact",
                         interpret: bool = False
                         ) -> Tuple[SplitStore, PallasFaninResult]:
     """``n_chunks`` sequential fan-in folds fused into ONE kernel launch.
 
     Chunk ``c`` applies ``cs`` with every logicalTime advanced by ``c``
     ms and the canonical clock threaded through (the steady-state write
-    stream). Bit-identical store/canonical/flags to the equivalent loop
-    of `fanin_step` / `pallas_fanin_step` calls, but the store block is
-    VMEM-resident across the chunk grid dimension, so HBM traffic is
-    ~``n_chunks``× lower than the sequential loop: the memory system
-    sees each store and changeset lane once per row block.
+    stream). Bit-identical store/canonical results to the equivalent
+    loop of `fanin_step` / `pallas_fanin_step` calls, but the store
+    block is VMEM-resident across the chunk grid dimension, so HBM
+    traffic is ~``n_chunks``× lower than the sequential loop: the
+    memory system sees each store and changeset lane once per row block.
+
+    ``guards`` selects the recv-guard executor (store lanes, canonical
+    and ``win`` are identical either way):
+
+    - ``"exact"`` — the column-local shielded semantics in-kernel
+      (flags bit-identical to `pallas_fanin_step` loops). The running
+      cummax chain is ~half the per-row compute.
+    - ``"fast"`` — optimistic guards: ZERO per-row guard work; flags
+      come from closed-form scalar bounds (max local-node logicalTime
+      vs the threaded canonical; changeset max vs the drift
+      threshold). A strict SUPERSET of the exact flags — no anomaly is
+      missed, but a shielded record may flag. The model layer's
+      contract already handles this: on any trip it recomputes the
+      guards exactly on host for first-offender diagnostics and clears
+      false positives (`DenseCrdt._exact_guards`). Measured ~1.9×
+      faster at the 1M×1024 headline.
 
     ``win`` is the OR across chunks (slots adopted at least once);
     ``new_canonical`` is the post-final-chunk canonical time.
@@ -306,8 +351,67 @@ def pallas_fanin_stream(store: SplitStore, cs: SplitChangeset,
 
     # Base changeset max (chunk 0's clock ceiling): chunk c's ceiling is
     # basemax + c<<SHIFT, threaded against canonical in-kernel.
+    assert guards in ("exact", "fast"), guards
     m_hi = jnp.max(cs.hi)
     m_lo = jnp.max(jnp.where(cs.hi == m_hi, cs.lo, 0))
+    outs = _launch_stream_grid(
+        guards == "exact", True, store, cs, canonical_lt, local_node,
+        wall_millis, m_hi, m_lo, cs_block_rows=r,
+        cs_index_map=lambda i, c: (jnp.int32(0), jnp.int32(i),
+                                   jnp.int32(0)),
+        n_chunks=n_chunks, interpret=interpret)
+
+    final_off = ((n_chunks - 1) << SHIFT)
+    basemax = _join64(m_hi, m_lo)
+    new_canonical = jnp.maximum(canonical_lt, basemax + final_off)
+    new_store = SplitStore(*(o.reshape(n) for o in outs[:9]))
+
+    if guards == "exact":
+        any_dup = outs[10][0, 0] > 0
+        any_drift = outs[11][0, 0] > 0
+    else:
+        # Optimistic superset flags in closed form. A chunk-c dup
+        # candidate is a local-node record above the threaded canonical
+        # newc_{c-1} = max(canon_0, basemax + (c-1)<<SHIFT); with
+        # M_loc = max local-node logicalTime, "exists c" collapses to
+        # the c=0 test plus (for c>=1) a c-independent bound against
+        # basemax and the last chunk's test against canon_0. Drift is
+        # the changeset ceiling vs the wall threshold.
+        m_loc = _max_local_lt(cs, local_node)
+        any_dup = m_loc > canonical_lt
+        if n_chunks > 1:
+            any_dup = any_dup | ((m_loc > basemax - (1 << SHIFT))
+                                 & (m_loc + final_off > canonical_lt))
+        thresh = ((wall_millis + MAX_DRIFT) << SHIFT) | MAX_COUNTER
+        any_drift = basemax + final_off > thresh
+
+    return new_store, PallasFaninResult(
+        new_canonical=new_canonical,
+        win=outs[9].reshape(n).astype(bool),
+        any_dup=any_dup,
+        any_drift=any_drift,
+    )
+
+
+def _max_local_lt(cs: SplitChangeset, local_node: jax.Array) -> jax.Array:
+    """Max logicalTime over the changeset's local-node records (the
+    closed-form dup-candidate bound); NEG when there are none."""
+    loc = cs.node == local_node
+    ml_hi = jnp.max(jnp.where(loc, cs.hi, NEG_HI))
+    ml_lo = jnp.max(jnp.where(loc & (cs.hi == ml_hi), cs.lo, 0))
+    return _join64(ml_hi, ml_lo)
+
+
+def _launch_stream_grid(exact_guards, advance_clock, store, cs,
+                        canonical_lt, local_node, wall_millis, m_hi, m_lo,
+                        *, cs_block_rows, cs_index_map, n_chunks,
+                        interpret):
+    """Shared pallas_call plumbing for the (row_blocks, n_chunks) grid:
+    scalar stack, block specs, reshapes, out shapes, store aliasing.
+    The two wrappers differ only in the kernel's static flags and the
+    changeset block geometry/index map."""
+    r, n = cs.hi.shape
+    rows = n // _LANE
     canon_hi, canon_lo = _split64(canonical_lt)
     thresh_hi, thresh_lo = _split64(
         ((wall_millis + MAX_DRIFT) << SHIFT) | MAX_COUNTER)
@@ -317,8 +421,7 @@ def pallas_fanin_stream(store: SplitStore, cs: SplitChangeset,
         m_hi, m_lo.astype(jnp.int32)]).astype(jnp.int32)
 
     _i32 = jnp.int32
-    cs_spec = pl.BlockSpec((r, _SB, _LANE),
-                           lambda i, c: (_i32(0), _i32(i), _i32(0)),
+    cs_spec = pl.BlockSpec((cs_block_rows, _SB, _LANE), cs_index_map,
                            memory_space=pltpu.VMEM)
     st_spec = pl.BlockSpec((_SB, _LANE), lambda i, c: (_i32(i), _i32(0)),
                            memory_space=pltpu.VMEM)
@@ -334,8 +437,8 @@ def pallas_fanin_stream(store: SplitStore, cs: SplitChangeset,
          jax.ShapeDtypeStruct((1, 1), jnp.int32),         # any_dup
          jax.ShapeDtypeStruct((1, 1), jnp.int32)])        # any_drift
 
-    outs = pl.pallas_call(
-        _fanin_stream_kernel,
+    return pl.pallas_call(
+        partial(_fanin_stream_kernel, exact_guards, advance_clock),
         grid=(rows // _SB, n_chunks),
         in_specs=([pl.BlockSpec((7,), lambda i, c: (_i32(0),),
                                 memory_space=pltpu.SMEM)] +
@@ -346,13 +449,50 @@ def pallas_fanin_stream(store: SplitStore, cs: SplitChangeset,
         interpret=interpret,
     )(scalars, *cs3d, *st2d)
 
-    final_off = ((n_chunks - 1) << SHIFT)
-    new_canonical = jnp.maximum(canonical_lt,
-                                _join64(m_hi, m_lo) + final_off)
+
+@partial(jax.jit, static_argnames=("chunk_rows", "interpret"))
+def pallas_fanin_batch(store: SplitStore, cs: SplitChangeset,
+                       canonical_lt: jax.Array, local_node: jax.Array,
+                       wall_millis: jax.Array, *, chunk_rows: int = 8,
+                       interpret: bool = False
+                       ) -> Tuple[SplitStore, PallasFaninResult]:
+    """ONE logical merge of an [R, N] changeset, walked in-kernel as
+    ``R / chunk_rows`` DISTINCT row groups with the store block
+    VMEM-resident across the chunk grid dimension — the kernel
+    counterpart of `ops.dense.fanin_stream` (union-final canonical
+    stamping, no per-chunk clock offsets). Store lanes, ``win``, and
+    ``new_canonical`` match `pallas_fanin_step` on the full batch
+    bit-for-bit; guard flags are the optimistic closed-form superset
+    (`pallas_fanin_stream` guards="fast" contract): the model layer
+    recomputes exactly on host when one trips.
+
+    ``r`` must be a multiple of ``chunk_rows`` (pad with invalid rows)
+    and ``n_slots`` a multiple of ``TILE``."""
+    r, n = cs.hi.shape
+    assert n % TILE == 0, (n, TILE)
+    assert r % chunk_rows == 0, (r, chunk_rows)
+    n_chunks = r // chunk_rows
+
+    m_hi = jnp.max(cs.hi)
+    m_lo = jnp.max(jnp.where(cs.hi == m_hi, cs.lo, 0))
+    # Chunk c reads row group c — the block index map's only difference
+    # from the replay stream.
+    outs = _launch_stream_grid(
+        False, False, store, cs, canonical_lt, local_node, wall_millis,
+        m_hi, m_lo, cs_block_rows=chunk_rows,
+        cs_index_map=lambda i, c: (c, jnp.int32(i), jnp.int32(0)),
+        n_chunks=n_chunks, interpret=interpret)
+
+    thresh = ((wall_millis + MAX_DRIFT) << SHIFT) | MAX_COUNTER
+    new_canonical = jnp.maximum(canonical_lt, _join64(m_hi, m_lo))
     new_store = SplitStore(*(o.reshape(n) for o in outs[:9]))
+
+    # Optimistic superset flags (no offsets, so the c=0 bound covers
+    # every chunk): a local-node record above the pre-merge canonical,
+    # or any record past the drift threshold.
     return new_store, PallasFaninResult(
         new_canonical=new_canonical,
         win=outs[9].reshape(n).astype(bool),
-        any_dup=outs[10][0, 0] > 0,
-        any_drift=outs[11][0, 0] > 0,
+        any_dup=_max_local_lt(cs, local_node) > canonical_lt,
+        any_drift=_join64(m_hi, m_lo) > thresh,
     )

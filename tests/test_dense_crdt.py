@@ -668,3 +668,94 @@ class TestResume:
         sync_dense(a, b)
         assert b.stats.merges == 1
         assert b.stats.records_adopted == 2
+
+
+class TestPallasExecutor:
+    """DenseCrdt(executor="pallas-interpret") — the Mosaic merge path
+    through the MODEL API, differential against the XLA executor.
+    Interpret mode stands in for the chip (tile-aligned slot count)."""
+
+    NP = 8192  # TILE-aligned
+
+    def make_pair(self):
+        k = dict(wall_clock=FakeClock(start=BASE))
+        return (DenseCrdt("ns", self.NP, executor="pallas-interpret", **k),
+                DenseCrdt("ns", self.NP, executor="xla",
+                          wall_clock=FakeClock(start=BASE)))
+
+    def assert_equal(self, a, b):
+        for lane in ("lt", "node", "val", "mod_lt", "mod_node",
+                     "occupied", "tomb"):
+            occ = np.asarray(b.store.occupied)
+            np.testing.assert_array_equal(
+                np.asarray(getattr(a.store, lane))[occ],
+                np.asarray(getattr(b.store, lane))[occ], err_msg=lane)
+        np.testing.assert_array_equal(np.asarray(a.store.occupied),
+                                      np.asarray(b.store.occupied))
+        assert a.canonical_time == b.canonical_time
+
+    def test_multi_peer_merge_matches_xla(self):
+        pal, xla = self.make_pair()
+        peers = []
+        for i, name in enumerate(["p1", "p2", "p3"]):
+            p = DenseCrdt(name, self.NP,
+                          wall_clock=FakeClock(start=BASE + i))
+            p.put_batch(jnp.arange(i * 5, i * 5 + 20),
+                        jnp.arange(20, dtype=jnp.int64) + 100 * i)
+            if i == 1:
+                p.delete_batch(jnp.arange(7, 9))
+            peers.append(p.export_delta())
+        pal.merge_many(peers)
+        xla.merge_many(peers)
+        self.assert_equal(pal, xla)
+        assert pal.stats.records_adopted == xla.stats.records_adopted
+
+    def test_dup_guard_same_exception_payload(self):
+        pal, xla = self.make_pair()
+        bad = DenseCrdt("ns", self.NP,
+                        wall_clock=FakeClock(start=BASE + 50))
+        bad.put_batch([3], [1])  # same node id, clock ahead
+        delta = bad.export_delta()
+        errs = []
+        for c in (pal, xla):
+            with pytest.raises(DuplicateNodeException) as ei:
+                c.merge_many([delta])
+            errs.append((str(ei.value),
+                         c.canonical_time.logical_time))
+        assert errs[0] == errs[1]
+
+    def test_shielded_false_positive_cleared(self):
+        # A local-ordinal record shielded by an earlier larger record
+        # trips the optimistic flags but must NOT raise: the exact
+        # host recompute clears it and the merge proceeds.
+        pal, xla = self.make_pair()
+        peer = DenseCrdt("peer", self.NP,
+                         wall_clock=FakeClock(start=BASE + 80))
+        peer.put_batch([0], [11])
+        shield_cs, ids = peer.export_delta()
+        # Forge a changeset where row 0 (earlier) carries the larger
+        # foreign record and row 1 a smaller LOCAL-node record: the
+        # exact sequential order shields row 1.
+        import jax.numpy as j
+        lt_hi = int(shield_cs.lt.max())
+        forged = type(shield_cs)(
+            lt=j.stack([shield_cs.lt[0],
+                        j.full_like(shield_cs.lt[0], 0).at[5].set(
+                            lt_hi - (1 << 16))]),
+            node=j.stack([shield_cs.node[0],
+                          j.zeros_like(shield_cs.node[0])]),
+            val=j.stack([shield_cs.val[0],
+                         j.zeros_like(shield_cs.val[0])]),
+            tomb=j.stack([shield_cs.tomb[0],
+                          j.zeros_like(shield_cs.tomb[0])]),
+            valid=j.stack([shield_cs.valid[0],
+                           j.zeros_like(shield_cs.valid[0]).at[5].set(
+                               True)]),
+        )
+        ids2 = list(ids) + ["ns"]
+        forged = forged._replace(
+            node=forged.node.at[1, 5].set(ids2.index("ns")))
+        for c in (pal, xla):
+            c.merge_many([(forged, ids2)])
+        self.assert_equal(pal, xla)
+        assert pal.get(0) == 11

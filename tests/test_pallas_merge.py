@@ -13,7 +13,8 @@ import jax.numpy as jnp
 
 from crdt_tpu.hlc import SHIFT
 from crdt_tpu.ops.dense import (DenseStore, empty_dense_store, fanin_step)
-from crdt_tpu.ops.pallas_merge import (join_store, pallas_fanin_step,
+from crdt_tpu.ops.pallas_merge import (join_store, pallas_fanin_batch,
+                                       pallas_fanin_step,
                                        pallas_fanin_stream,
                                        split_changeset, split_store)
 
@@ -290,6 +291,76 @@ def test_stream_guards_across_chunks():
     assert int(join_store(st).val[0]) == 1
 
 
+@pytest.mark.parametrize("seed", range(3))
+def test_fast_guards_same_results_superset_flags(seed):
+    # guards="fast" must produce identical store/win/canonical and flag
+    # a SUPERSET of exact mode's guard trips.
+    rng = random.Random(seed + 300)
+    r, n, n_chunks = 3, BLOCK, 3
+    entries = []
+    for ri in range(r):
+        for k in range(n):
+            if rng.random() < 0.7:
+                continue
+            # Include local-ordinal records and shielding patterns.
+            node = rng.choice([1, 2, LOCAL, LOCAL, 5])
+            entries.append((ri, k,
+                            lt_of(MILLIS + rng.randrange(10),
+                                  rng.randrange(2)),
+                            node, rng.randrange(1000),
+                            rng.random() < 0.3))
+    cs = make_changeset(r, n, entries)
+    canon = lt_of(MILLIS + rng.randrange(8))
+    args = (split_store(empty_dense_store(n)), split_changeset(cs),
+            jnp.int64(canon), jnp.int32(LOCAL), jnp.int64(MILLIS + 10_000))
+    e_st, e_res = pallas_fanin_stream(*args, n_chunks=n_chunks,
+                                      guards="exact", interpret=True)
+    f_st, f_res = pallas_fanin_stream(*args, n_chunks=n_chunks,
+                                      guards="fast", interpret=True)
+    assert_stores_equal(join_store(e_st), join_store(f_st))
+    np.testing.assert_array_equal(np.asarray(e_res.win),
+                                  np.asarray(f_res.win))
+    assert int(e_res.new_canonical) == int(f_res.new_canonical)
+    # Superset: exact trip => fast trip.
+    assert (not bool(e_res.any_dup)) or bool(f_res.any_dup)
+    assert (not bool(e_res.any_drift)) or bool(f_res.any_drift)
+
+
+def test_fast_guards_clean_on_steady_state():
+    # No local-node records, clocks within drift: neither mode flags.
+    cs = make_changeset(2, BLOCK, [
+        (0, 0, lt_of(MILLIS), 1, 10, False),
+        (1, 3, lt_of(MILLIS + 2), 2, 11, False)])
+    for mode in ("exact", "fast"):
+        _, res = pallas_fanin_stream(
+            split_store(empty_dense_store(BLOCK)), split_changeset(cs),
+            jnp.int64(0), jnp.int32(LOCAL), jnp.int64(MILLIS + 10_000),
+            n_chunks=4, guards=mode, interpret=True)
+        assert not bool(res.any_dup), mode
+        assert not bool(res.any_drift), mode
+
+
+def test_fast_guards_catch_real_anomalies():
+    # A genuine duplicate-node record and a genuine drift record must
+    # trip fast mode (no false negatives).
+    dup_cs = make_changeset(1, BLOCK, [
+        (0, 0, lt_of(MILLIS), LOCAL, 1, False)])
+    _, res = pallas_fanin_stream(
+        split_store(empty_dense_store(BLOCK)), split_changeset(dup_cs),
+        jnp.int64(0), jnp.int32(LOCAL), jnp.int64(MILLIS + 10_000),
+        n_chunks=2, guards="fast", interpret=True)
+    assert bool(res.any_dup)
+
+    from crdt_tpu.hlc import MAX_DRIFT
+    drift_cs = make_changeset(1, BLOCK, [
+        (0, 0, lt_of(MILLIS + MAX_DRIFT + 1), 1, 1, False)])
+    _, res = pallas_fanin_stream(
+        split_store(empty_dense_store(BLOCK)), split_changeset(drift_cs),
+        jnp.int64(0), jnp.int32(LOCAL), jnp.int64(MILLIS),
+        n_chunks=1, guards="fast", interpret=True)
+    assert bool(res.any_drift)
+
+
 def test_stream_empty_store_offsets_dont_resurrect_invalid():
     # Round-2 hazard: chunk offsets must not lift the NEG sentinel of an
     # invalid lane above an empty store slot.
@@ -304,6 +375,57 @@ def test_stream_empty_store_offsets_dont_resurrect_invalid():
     assert int(np.sum(np.asarray(out.occupied))) == 1
     assert bool(out.occupied[0]) and int(out.val[0]) == 42
     assert int(np.sum(np.asarray(res.win))) == 1
+
+
+@pytest.mark.parametrize("seed", range(3))
+def test_batch_matches_one_shot_step(seed):
+    # pallas_fanin_batch walks DISTINCT row groups of ONE logical
+    # merge: store/win/canonical must match the full-batch step
+    # bit-for-bit, for any chunk_rows that divides R.
+    rng = random.Random(seed + 500)
+    r, n = 8, BLOCK
+    entries = []
+    for ri in range(r):
+        for k in range(n):
+            if rng.random() < 0.6:
+                continue
+            entries.append((ri, k,
+                            lt_of(MILLIS + rng.randrange(20),
+                                  rng.randrange(3)),
+                            rng.randrange(1, 6), rng.randrange(1000),
+                            rng.random() < 0.3))
+    cs = make_changeset(r, n, entries)
+    canon = lt_of(MILLIS + 3)
+    args = (split_store(empty_dense_store(n)), split_changeset(cs),
+            jnp.int64(canon), jnp.int32(LOCAL), jnp.int64(MILLIS + 10_000))
+    ref_st, ref_res = pallas_fanin_step(*args, interpret=True)
+    for chunk_rows in (2, 4, 8):
+        b_st, b_res = pallas_fanin_batch(*args, chunk_rows=chunk_rows,
+                                         interpret=True)
+        assert_stores_equal(join_store(ref_st), join_store(b_st))
+        np.testing.assert_array_equal(np.asarray(ref_res.win),
+                                      np.asarray(b_res.win))
+        assert int(ref_res.new_canonical) == int(b_res.new_canonical)
+
+
+def test_batch_guard_superset():
+    # Dup/drift anomalies must trip the batch's optimistic flags.
+    dup = make_changeset(2, BLOCK, [
+        (1, 0, lt_of(MILLIS), LOCAL, 1, False)])
+    _, res = pallas_fanin_batch(
+        split_store(empty_dense_store(BLOCK)), split_changeset(dup),
+        jnp.int64(0), jnp.int32(LOCAL), jnp.int64(MILLIS + 10_000),
+        chunk_rows=2, interpret=True)
+    assert bool(res.any_dup)
+
+    clean = make_changeset(2, BLOCK, [
+        (0, 0, lt_of(MILLIS), 1, 1, False),
+        (1, 1, lt_of(MILLIS + 1), 2, 2, False)])
+    _, res = pallas_fanin_batch(
+        split_store(empty_dense_store(BLOCK)), split_changeset(clean),
+        jnp.int64(0), jnp.int32(LOCAL), jnp.int64(MILLIS + 10_000),
+        chunk_rows=2, interpret=True)
+    assert not bool(res.any_dup) and not bool(res.any_drift)
 
 
 def test_split_roundtrip():
