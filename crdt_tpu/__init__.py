@@ -33,7 +33,7 @@ from .models.sqlite_crdt import SqliteCrdt
 from .sync import sync, sync_json
 from .checkpoint import load_dense, load_json, save_dense, save_json
 
-__version__ = "0.1.0"
+__version__ = "0.3.0"
 
 __all__ = [
     "Hlc", "ClockDriftException", "DuplicateNodeException",

@@ -1,0 +1,64 @@
+"""Offline-first sync: a durable edge replica, a device-backed hub,
+and an async UI consumer — the reference's deployment story
+(README.md:39 persistent backends + example/crdt_example.dart wire
+exchange) on this framework's backends.
+
+- The EDGE node is a `SqliteCrdt`: writes survive restarts; resuming
+  is just reopening the file (crdt.dart:31-33 refreshCanonicalTime).
+- The HUB is a `TpuMapCrdt`: the same `Crdt` surface with merges
+  running on the accelerator.
+- Sync is the reference's anti-entropy round (full push + inclusive
+  delta pull, test/map_crdt_test.dart:273-279) over the JSON wire.
+- The "UI" consumes `watch().aiter()` — the Dart `await for` shape.
+
+Run: python examples/offline_sync_example.py
+"""
+
+import asyncio
+import os
+import tempfile
+
+from crdt_tpu import SqliteCrdt, TpuMapCrdt, sync_json
+
+
+async def main() -> None:
+    db = os.path.join(tempfile.mkdtemp(), "edge.db")
+
+    # --- day 1: the edge writes offline, then goes away ---
+    with SqliteCrdt("edge-1", db) as edge:
+        edge.put("cart:apples", 3)
+        edge.put("cart:pears", 2)
+        edge.delete("cart:pears")
+    print("edge wrote offline and shut down")
+
+    # --- the hub accumulates state from another replica meanwhile ---
+    hub = TpuMapCrdt("hub")
+    hub.put("cart:plums", 7)
+
+    # --- day 2: the edge comes back and syncs over the JSON wire ---
+    edge = SqliteCrdt("edge-1", db)   # resume: clock rebuilt from disk
+    ui_events = []
+
+    async def ui():
+        async with edge.watch().aiter() as stream:
+            async for event in stream:
+                ui_events.append(f"{event.key} -> {event.value}")
+
+    ui_task = asyncio.ensure_future(ui())
+    await asyncio.sleep(0)            # let the UI subscribe
+
+    sync_json(edge, hub)              # full push + inclusive delta pull
+    await asyncio.sleep(0.05)
+
+    print(f"edge map:  {dict(sorted(edge.map.items()))}")
+    print(f"hub map:   {dict(sorted(hub.map.items()))}")
+    assert edge.map == hub.map == {"cart:apples": 3, "cart:plums": 7}
+    assert hub.is_deleted("cart:pears") is True  # tombstone propagated
+    print(f"ui saw:    {sorted(ui_events)}")
+
+    ui_task.cancel()
+    edge.close()
+
+
+if __name__ == "__main__":
+    asyncio.run(main())
