@@ -207,6 +207,38 @@ class DenseCrdt:
         canonical clock and node table are untouched."""
         self._store = empty_dense_store(self.n_slots)
 
+    def grow(self, n_slots: int) -> None:
+        """Grow the slot capacity to ``n_slots`` (records keep their
+        slots; new slots start empty). The dense analogue of the
+        reference map's unbounded growth (map_crdt.dart:10) — capacity
+        is a layout choice, not a data bound. Shrinking would drop
+        records; it is refused.
+
+        Peers at the old capacity keep syncing with this replica
+        (their narrower changesets are padded on ingest); merging THIS
+        replica's wider changesets into an ungrown peer raises there
+        until the peer grows too. With ``executor="auto"`` the Mosaic
+        kernel path engages/disengages with tile alignment
+        (`crdt_tpu.ops.TILE`); a forced ``executor="pallas"`` refuses
+        an unaligned growth outright."""
+        if n_slots < self.n_slots:
+            raise ValueError(
+                f"cannot shrink {self.n_slots} -> {n_slots} slots "
+                "(records would be dropped); build a new replica and "
+                "merge instead")
+        if self._executor in ("pallas", "pallas-interpret"):
+            from ..ops.pallas_merge import TILE
+            if n_slots % TILE:
+                raise ValueError(
+                    f"executor={self._executor!r} needs n_slots % "
+                    f"{TILE} == 0; got {n_slots}")
+        if n_slots == self.n_slots:
+            return
+        pad = empty_dense_store(n_slots - self.n_slots)
+        self._store = DenseStore(*(
+            jnp.concatenate([lane, pad_lane])
+            for lane, pad_lane in zip(self._store, pad)))
+
     def __len__(self) -> int:
         return int(jnp.sum(self.live_mask))
 
@@ -385,6 +417,28 @@ class DenseCrdt:
         cs = store_to_changeset(self._store, since_lt)
         return cs, [self._table.id_of(i) for i in range(len(self._table))]
 
+    def _fit_slots(self, cs: DenseChangeset) -> DenseChangeset:
+        """Normalize a peer changeset's slot width to this replica's
+        capacity: a NARROWER peer (pre-`grow` rollout) pads with
+        invalid lanes; a WIDER one would silently drop records past
+        capacity, so it raises with the remedy instead of dying in an
+        XLA shape error."""
+        width = cs.lt.shape[1]
+        if width == self.n_slots:
+            return cs
+        if width > self.n_slots:
+            raise ValueError(
+                f"peer changeset covers {width} slots but this replica "
+                f"holds {self.n_slots}; call grow({width}) first")
+        pad = self.n_slots - width
+        return DenseChangeset(
+            lt=jnp.pad(cs.lt, ((0, 0), (0, pad))),
+            node=jnp.pad(cs.node, ((0, 0), (0, pad))),
+            val=jnp.pad(cs.val, ((0, 0), (0, pad))),
+            tomb=jnp.pad(cs.tomb, ((0, 0), (0, pad))),
+            valid=jnp.pad(cs.valid, ((0, 0), (0, pad))),
+        )
+
     def _intern_ids(self, node_ids: Sequence[Any]) -> None:
         """Intern ids into the table, re-encoding stored lanes when new
         ids shift existing ordinals."""
@@ -540,7 +594,8 @@ class DenseCrdt:
         for _, ids in changesets:
             union.update(ids)
         self._intern_ids(union)
-        parts = [self._encode_peer(cs, ids) for cs, ids in changesets]
+        parts = [self._encode_peer(self._fit_slots(cs), ids)
+                 for cs, ids in changesets]
         cs = DenseChangeset(*(jnp.concatenate([getattr(p, f) for p in parts])
                               for f in DenseChangeset._fields))
         # Lazy device scalar: no device->host sync on the hot path.
@@ -630,6 +685,16 @@ class ShardedDenseCrdt(DenseCrdt):
 
     def purge(self) -> None:
         super().purge()
+        self._store = self._shard(self._store)
+
+    def grow(self, n_slots: int) -> None:
+        from ..parallel import KEY_AXIS
+        k = self._mesh.shape[KEY_AXIS]
+        if n_slots % k:
+            raise ValueError(
+                f"n_slots={n_slots} not divisible by the mesh's "
+                f"{k} key shards")
+        super().grow(n_slots)
         self._store = self._shard(self._store)
 
 

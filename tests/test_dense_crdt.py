@@ -759,3 +759,70 @@ class TestPallasExecutor:
             c.merge_many([(forged, ids2)])
         self.assert_equal(pal, xla)
         assert pal.get(0) == 11
+
+
+class TestGrow:
+    def test_grow_preserves_records_and_clock(self):
+        a = DenseCrdt("na", N, wall_clock=FakeClock(start=BASE))
+        a.put_batch([0, N - 1], [10, 20])
+        a.delete_batch([0])
+        canon = a.canonical_time
+        a.grow(4 * N)
+        assert a.n_slots == 4 * N
+        assert a.is_deleted(0) is True
+        assert a.get(N - 1) == 20
+        assert a.get(2 * N) is None and not a.contains_slot(2 * N)
+        assert a.canonical_time == canon
+        # New capacity is writable and mergeable.
+        a.put_batch([3 * N], [33])
+        b = DenseCrdt("nb", 4 * N, wall_clock=FakeClock(start=BASE + 9))
+        sync_dense(a, b)
+        assert b.get(3 * N) == 33 and b.get(N - 1) == 20
+
+    def test_grow_refuses_shrink(self):
+        a = DenseCrdt("na", N, wall_clock=FakeClock(start=BASE))
+        with pytest.raises(ValueError):
+            a.grow(N - 1)
+
+    def test_mixed_capacity_sync(self):
+        # Staggered grow rollout: the grown replica ingests narrow
+        # changesets; the ungrown peer gets a clear error (not an XLA
+        # shape crash) until it grows too.
+        a = DenseCrdt("na", N, wall_clock=FakeClock(start=BASE))
+        b = DenseCrdt("nb", N, wall_clock=FakeClock(start=BASE + 5))
+        a.grow(2 * N)
+        b.put_batch([3], [33])
+        a.merge(*b.export_delta())         # narrow -> wide: pads
+        assert a.get(3) == 33
+        a.put_batch([N + 1], [44])
+        with pytest.raises(ValueError, match=r"grow\(128\)"):
+            b.merge(*a.export_delta())     # wide -> narrow: explicit
+        b.grow(2 * N)
+        sync_dense(a, b)
+        assert b.get(N + 1) == 44 and b.get(3) == 33
+
+    def test_grow_forced_pallas_requires_alignment(self):
+        a = DenseCrdt("na", 8192, wall_clock=FakeClock(start=BASE),
+                      executor="pallas-interpret")
+        with pytest.raises(ValueError, match="8192"):
+            a.grow(8192 + 16)
+        a.grow(2 * 8192)                   # aligned growth fine
+        assert a.n_slots == 2 * 8192
+
+    def test_grow_sharded_stays_sharded(self):
+        import jax
+        from crdt_tpu import ShardedDenseCrdt
+        from crdt_tpu.parallel import make_fanin_mesh
+        if len(jax.devices()) < 8:
+            pytest.skip("needs 8 virtual devices")
+        mesh = make_fanin_mesh(2, 4)
+        a = ShardedDenseCrdt("na", N, mesh, wall_clock=FakeClock(start=BASE))
+        a.put_batch([1], [5])
+        a.grow(2 * N)
+        assert a.get(1) == 5 and a.n_slots == 2 * N
+        with pytest.raises(ValueError):
+            a.grow(2 * N + 3)  # not divisible by key shards
+        b = DenseCrdt("nb", 2 * N, wall_clock=FakeClock(start=BASE + 3))
+        b.put_batch([N + 5], [7])
+        sync_dense(a, b)
+        assert a.get(N + 5) == 7 and b.get(1) == 5
