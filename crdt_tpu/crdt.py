@@ -31,7 +31,7 @@ from . import crdt_json
 from .hlc import Hlc, wall_clock_millis
 from .record import (KeyDecoder, KeyEncoder, Record, ValueDecoder,
                      ValueEncoder)
-from .watch import ChangeEvent, ChangeStream
+from .watch import ChangeStream
 
 K = TypeVar("K")
 V = TypeVar("V")

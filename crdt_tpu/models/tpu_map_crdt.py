@@ -30,7 +30,7 @@ import jax
 import jax.numpy as jnp
 
 from ..crdt import Crdt
-from ..hlc import (ClockDriftException, DuplicateNodeException, Hlc, SHIFT)
+from ..hlc import ClockDriftException, DuplicateNodeException, Hlc
 from ..record import Record
 from ..watch import ChangeHub, ChangeStream
 from ..ops.merge import (Changeset, Store, delta_mask, empty_store,

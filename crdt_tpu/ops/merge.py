@@ -24,7 +24,6 @@ entries) so the whole step is one fused XLA program.
 
 from __future__ import annotations
 
-from functools import partial
 from typing import NamedTuple
 
 import jax

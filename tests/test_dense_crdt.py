@@ -809,6 +809,52 @@ class TestGrow:
         a.grow(2 * 8192)                   # aligned growth fine
         assert a.n_slots == 2 * 8192
 
+    @pytest.mark.parametrize("seed", range(2))
+    def test_fuzz_mixed_capacity_convergence(self, seed):
+        # Random ops + staggered growth across 3 replicas; all must
+        # converge once everyone reaches the final capacity.
+        import random
+        rng = random.Random(seed * 7 + 1)
+        caps = [N, N, N]
+        reps = [DenseCrdt(f"n{i}", N,
+                          wall_clock=FakeClock(start=BASE + i * 3))
+                for i in range(3)]
+        for step in range(30):
+            i = rng.randrange(3)
+            r = reps[i]
+            op = rng.random()
+            if op < 0.5:
+                s = rng.randrange(caps[i])
+                r.put_batch([s], [rng.randrange(1000)])
+            elif op < 0.7 and len(r):
+                r.delete_batch([rng.randrange(caps[i])])
+            elif op < 0.85 and caps[i] < 4 * N:
+                caps[i] *= 2
+                r.grow(caps[i])
+            else:
+                j = rng.randrange(3)
+                if j != i and caps[j] == caps[i]:
+                    sync_dense(reps[j], r)
+                elif j != i and caps[j] > caps[i]:
+                    reps[j].merge(*r.export_delta())
+        for r, c in zip(reps, caps):
+            if c < 4 * N:
+                r.grow(4 * N)
+        for _ in range(2):
+            for i in range(3):
+                for j in range(3):
+                    if i != j:
+                        reps[j].merge(*reps[i].export_delta())
+        base = np.asarray(reps[0].store.occupied)
+        for r in reps[1:]:
+            np.testing.assert_array_equal(np.asarray(r.store.occupied),
+                                          base)
+            for lane in ("lt", "node", "val", "tomb"):
+                np.testing.assert_array_equal(
+                    np.asarray(getattr(r.store, lane))[base],
+                    np.asarray(getattr(reps[0].store, lane))[base],
+                    err_msg=lane)
+
     def test_grow_sharded_stays_sharded(self):
         import jax
         from crdt_tpu import ShardedDenseCrdt

@@ -13,7 +13,7 @@ import pytest
 import jax
 import jax.numpy as jnp
 
-from crdt_tpu.ops.dense import (DenseChangeset, DenseStore,
+from crdt_tpu.ops.dense import (DenseStore,
                                 empty_dense_store, fanin_step)
 from crdt_tpu.parallel import (make_fanin_mesh,
                                make_multislice_fanin_mesh,

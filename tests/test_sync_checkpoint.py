@@ -4,7 +4,7 @@ import numpy as np
 import jax.numpy as jnp
 import pytest
 
-from crdt_tpu import Hlc, MapCrdt, Record, TpuMapCrdt
+from crdt_tpu import MapCrdt, TpuMapCrdt
 from crdt_tpu.checkpoint import (load_dense, load_json, save_dense,
                                  save_json)
 from crdt_tpu.ops.dense import DenseStore, empty_dense_store, fanin_step
