@@ -1,0 +1,161 @@
+"""Hardware validation: the Mosaic kernels vs the XLA fold ON THE CHIP.
+
+The test suite proves kernel correctness in interpreter mode on CPU;
+this script closes the remaining gap — Mosaic compilation could in
+principle diverge from the interpreter — by running randomized
+differentials on the real accelerator:
+
+1. `pallas_fanin_stream` (exact and fast guards) vs the sequential
+   XLA fold with threaded clocks — store lanes, win, canonical.
+2. `pallas_fanin_batch` vs one-shot `fanin_step` on the same batch.
+3. `DenseCrdt(executor="pallas")` vs `DenseCrdt(executor="xla")`
+   through the model API, including a guard-trip exception.
+
+Exits 0 and prints PASS per section; any mismatch raises.
+
+Usage: python benchmarks/validate_on_chip.py [--keys 32768] [--seeds 3]
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))  # repo root
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from bench import build_stream_fn, make_changeset, _MILLIS
+from crdt_tpu.hlc import SHIFT
+from crdt_tpu.ops.dense import empty_dense_store, fanin_step
+from crdt_tpu.ops.pallas_merge import (join_store, pallas_fanin_batch,
+                                       pallas_fanin_stream,
+                                       split_changeset, split_store)
+
+
+def assert_lanes_equal(a, b, where):
+    occ = np.asarray(a.occupied)
+    np.testing.assert_array_equal(occ, np.asarray(b.occupied),
+                                  err_msg=f"{where}: occupied")
+    for lane in ("lt", "node", "val", "mod_lt", "mod_node", "tomb"):
+        np.testing.assert_array_equal(
+            np.asarray(getattr(a, lane))[occ],
+            np.asarray(getattr(b, lane))[occ],
+            err_msg=f"{where}: {lane}")
+
+
+def validate_stream(n_keys, n_chunks, seed):
+    cs = make_changeset(4, n_keys, seed=seed, fill=0.7)
+    canonical = jnp.int64(_MILLIS << SHIFT)
+    wall = jnp.int64(_MILLIS + 10_000)
+    store = empty_dense_store(n_keys)
+
+    # The reference semantics ARE bench.build_stream_fn — use it, so
+    # the validator can't drift from the contract the bench measures.
+    ref_store, ref_canon = build_stream_fn(n_chunks)(
+        store, cs, canonical, jnp.int32(0), wall)
+    ref_canon = int(ref_canon)
+
+    for guards in ("exact", "fast"):
+        sst, sres = pallas_fanin_stream(
+            split_store(store), split_changeset(cs), canonical,
+            jnp.int32(0), wall, n_chunks=n_chunks, guards=guards)
+        assert_lanes_equal(ref_store, join_store(sst),
+                           f"stream[{guards}] seed={seed}")
+        assert int(sres.new_canonical) == ref_canon, guards
+        assert not bool(sres.any_dup) and not bool(sres.any_drift)
+
+    # Positive guard path ON HARDWARE: a local-node (ordinal 0) record
+    # ahead of the canonical clock must set any_dup in BOTH guard
+    # modes (the exact in-kernel cummax chain and the closed-form
+    # bound) — an all-clear-only check would miss a compiled-kernel
+    # flag bug.
+    dup_cs = cs._replace(
+        node=cs.node.at[0, 0].set(0),
+        valid=cs.valid.at[0, 0].set(True))
+    for guards in ("exact", "fast"):
+        _, dres = pallas_fanin_stream(
+            split_store(store), split_changeset(dup_cs),
+            jnp.int64(0), jnp.int32(0), wall, n_chunks=n_chunks,
+            guards=guards)
+        assert bool(dres.any_dup), f"dup flag missed [{guards}]"
+    print(f"PASS stream n_chunks={n_chunks} seed={seed} "
+          f"(exact+fast bit-identical to XLA fold; dup flag fires)")
+
+
+def validate_batch(n_keys, seed):
+    cs = make_changeset(16, n_keys, seed=seed + 50, fill=0.6)
+    canonical = jnp.int64(_MILLIS << SHIFT)
+    wall = jnp.int64(_MILLIS + 10_000)
+    store = empty_dense_store(n_keys)
+
+    ref_store, ref_res = fanin_step(store, cs, canonical, jnp.int32(0),
+                                    wall)
+    sst, sres = pallas_fanin_batch(
+        split_store(store), split_changeset(cs), canonical, jnp.int32(0),
+        wall, chunk_rows=8)
+    assert_lanes_equal(ref_store, join_store(sst), f"batch seed={seed}")
+    assert int(sres.new_canonical) == int(ref_res.new_canonical)
+    np.testing.assert_array_equal(np.asarray(sres.win),
+                                  np.asarray(ref_res.win))
+    print(f"PASS batch seed={seed} (16 rows, chunked 8, == fanin_step)")
+
+
+def validate_model(n_keys):
+    from crdt_tpu import DenseCrdt, DuplicateNodeException
+    from crdt_tpu.testing import FakeClock
+    BASE = _MILLIS
+    pal = DenseCrdt("ns", n_keys, wall_clock=FakeClock(start=BASE),
+                    executor="pallas")
+    xla = DenseCrdt("ns", n_keys, wall_clock=FakeClock(start=BASE),
+                    executor="xla")
+    peers = []
+    for i, name in enumerate(["p1", "p2", "p3"]):
+        p = DenseCrdt(name, n_keys, wall_clock=FakeClock(start=BASE + i))
+        p.put_batch(jnp.arange(i * 100, i * 100 + 500),
+                    jnp.arange(500, dtype=jnp.int64) + 1000 * i)
+        p.delete_batch(jnp.arange(i * 100, i * 100 + 7))
+        peers.append(p.export_delta())
+    pal.merge_many(peers)
+    xla.merge_many(peers)
+    assert_lanes_equal(pal.store, xla.store, "model")
+    assert pal.canonical_time == xla.canonical_time
+
+    bad = DenseCrdt("ns", n_keys, wall_clock=FakeClock(start=BASE + 900))
+    bad.put_batch([1], [1])
+    payloads = []
+    for c in (pal, xla):
+        try:
+            c.merge_many([bad.export_delta()])
+            raise AssertionError("guard did not trip")
+        except DuplicateNodeException as e:
+            payloads.append((str(e), c.canonical_time.logical_time))
+    assert payloads[0] == payloads[1], payloads
+    print("PASS model (pallas executor == xla executor on chip, "
+          "guard payloads identical)")
+
+
+def main():
+    from crdt_tpu.ops.pallas_merge import TILE
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--keys", type=int, default=4 * 8192)
+    ap.add_argument("--seeds", type=int, default=3)
+    args = ap.parse_args()
+    if args.keys % TILE:
+        ap.error(f"--keys must be a multiple of the Pallas tile "
+                 f"({TILE}); got {args.keys}")
+    print(f"platform: {jax.devices()[0].platform} ({jax.devices()[0]})")
+    for seed in range(args.seeds):
+        validate_stream(args.keys, n_chunks=4, seed=seed)
+        validate_batch(args.keys, seed)
+    validate_model(args.keys)
+    print("ALL PASS")
+
+
+if __name__ == "__main__":
+    main()
