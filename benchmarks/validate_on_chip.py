@@ -38,15 +38,8 @@ from crdt_tpu.ops.pallas_merge import (join_store, pallas_fanin_batch,
                                        split_changeset, split_store)
 
 
-def assert_lanes_equal(a, b, where):
-    occ = np.asarray(a.occupied)
-    np.testing.assert_array_equal(occ, np.asarray(b.occupied),
-                                  err_msg=f"{where}: occupied")
-    for lane in ("lt", "node", "val", "mod_lt", "mod_node", "tomb"):
-        np.testing.assert_array_equal(
-            np.asarray(getattr(a, lane))[occ],
-            np.asarray(getattr(b, lane))[occ],
-            err_msg=f"{where}: {lane}")
+from crdt_tpu.testing import assert_dense_stores_equal as \
+    assert_lanes_equal  # noqa: E402  (one definition of store equality)
 
 
 def validate_stream(n_keys, n_chunks, seed):

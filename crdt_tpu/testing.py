@@ -44,6 +44,23 @@ class FakeClock:
         return self._millis
 
 
+def assert_dense_stores_equal(a, b, where: str = "store") -> None:
+    """Lane-exact equality of two `DenseStore`s on OCCUPIED slots (an
+    unoccupied slot's lane contents are unobservable through
+    `record_map`, so executors may differ there). Shared by the test
+    suite and the on-chip validation harness — one definition of
+    store equality."""
+    import numpy as np
+    occ = np.asarray(a.occupied)
+    np.testing.assert_array_equal(occ, np.asarray(b.occupied),
+                                  err_msg=f"{where}: occupied")
+    for lane in ("lt", "node", "val", "mod_lt", "mod_node", "tomb"):
+        np.testing.assert_array_equal(
+            np.asarray(getattr(a, lane))[occ],
+            np.asarray(getattr(b, lane))[occ],
+            err_msg=f"{where}: {lane}")
+
+
 class CrdtConformance:
     """Inherit and implement ``make_crdt`` to run the conformance suite."""
 

@@ -35,14 +35,10 @@ def run_both(store, cs, canonical_lt=0, local_node=LOCAL,
 
 
 def assert_stores_equal(a: DenseStore, b: DenseStore):
-    occ = np.asarray(a.occupied)
-    np.testing.assert_array_equal(occ, np.asarray(b.occupied))
-    for lane in ("lt", "node", "val", "mod_lt", "mod_node", "tomb"):
-        # Unoccupied slots: dense keeps zeros, split keeps sentinels —
-        # only occupied slots are observable (record_map filters).
-        np.testing.assert_array_equal(
-            np.asarray(getattr(a, lane))[occ],
-            np.asarray(getattr(b, lane))[occ], err_msg=lane)
+    # Unoccupied slots: dense keeps zeros, split keeps sentinels —
+    # only occupied slots are observable (record_map filters).
+    from crdt_tpu.testing import assert_dense_stores_equal
+    assert_dense_stores_equal(a, b)
 
 
 @pytest.mark.parametrize("seed", range(4))
