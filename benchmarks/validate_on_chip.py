@@ -133,11 +133,73 @@ def validate_model(n_keys):
           "guard payloads identical)")
 
 
+def validate_model_soak(n_keys, rounds, seed=0):
+    """Randomized op-sequence soak: a pallas-executor replica and an
+    xla-executor replica apply IDENTICAL random local writes, deletes,
+    multi-peer merges, and clears; lane equality is asserted after
+    every round."""
+    import random
+    from crdt_tpu import DenseCrdt
+    from crdt_tpu.testing import FakeClock
+    rng = random.Random(seed)
+    BASE = _MILLIS
+    pal_clk = FakeClock(start=BASE)
+    xla_clk = FakeClock(start=BASE)
+    pal = DenseCrdt("ns", n_keys, wall_clock=pal_clk, executor="pallas")
+    xla = DenseCrdt("ns", n_keys, wall_clock=xla_clk, executor="xla")
+    peer_clk = BASE
+    for rnd in range(rounds):
+        # Keep the replicas' wall clocks tracking the peer clock:
+        # unbounded divergence would eventually trip the (correct)
+        # drift guard as a harness artifact, not a finding.
+        for clk in (pal_clk, xla_clk):
+            clk.advance(max(0, peer_clk - clk.millis))
+        op = rng.random()
+        if op < 0.35:
+            k = rng.randrange(1, 200)
+            slots = rng.sample(range(n_keys), k)
+            vals = [rng.randrange(1 << 40) for _ in slots]
+            pal.put_batch(slots, vals)
+            xla.put_batch(slots, vals)
+        elif op < 0.5:
+            slots = rng.sample(range(n_keys), rng.randrange(1, 50))
+            pal.delete_batch(slots)
+            xla.delete_batch(slots)
+        elif op < 0.9:
+            deltas = []
+            for p in range(rng.randrange(1, 5)):
+                peer_clk += rng.randrange(1, 4)
+                peer = DenseCrdt(f"p{rng.randrange(6)}", n_keys,
+                                 wall_clock=FakeClock(start=peer_clk))
+                slots = rng.sample(range(n_keys), rng.randrange(1, 300))
+                peer.put_batch(slots, [rng.randrange(1 << 40)
+                                       for _ in slots])
+                if rng.random() < 0.4:
+                    peer.delete_batch(rng.sample(slots,
+                                                 max(1, len(slots) // 4)))
+                deltas.append(peer.export_delta())
+            pal.merge_many(deltas)
+            xla.merge_many(deltas)
+        else:
+            # clear(): tombstone every live slot via one batch
+            pal.clear()
+            xla.clear()
+        assert_lanes_equal(pal.store, xla.store, f"soak round {rnd}")
+        assert pal.canonical_time.logical_time == \
+            xla.canonical_time.logical_time, rnd
+    print(f"PASS soak ({rounds} rounds, seed={seed}: pallas == xla "
+          "after every round)")
+
+
 def main():
     from crdt_tpu.ops.pallas_merge import TILE
     ap = argparse.ArgumentParser()
     ap.add_argument("--keys", type=int, default=4 * 8192)
     ap.add_argument("--seeds", type=int, default=3)
+    ap.add_argument("--soak", type=int, default=0, metavar="ROUNDS",
+                    help="additionally run a randomized op-sequence "
+                         "soak of this many rounds")
+    ap.add_argument("--soak-seed", type=int, default=0)
     args = ap.parse_args()
     if args.keys % TILE:
         ap.error(f"--keys must be a multiple of the Pallas tile "
@@ -147,6 +209,8 @@ def main():
         validate_stream(args.keys, n_chunks=4, seed=seed)
         validate_batch(args.keys, seed)
     validate_model(args.keys)
+    if args.soak:
+        validate_model_soak(args.keys, args.soak, seed=args.soak_seed)
     print("ALL PASS")
 
 
