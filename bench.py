@@ -121,7 +121,7 @@ CONFIGS = {
 
 
 def bench(n_keys: int, n_replicas: int, chunk_replicas: int,
-          repeats: int = 32, path: str = "auto",
+          repeats: int = 64, path: str = "auto",
           config: str = "fanin") -> dict:
     platform = jax.devices()[0].platform
     # The kernel path is the default on ANY accelerator platform (the
@@ -207,7 +207,7 @@ def main() -> None:
     ap.add_argument("--path", choices=("auto", "xla", "pallas"),
                     default="auto")
     ap.add_argument("--config", choices=tuple(CONFIGS), default="fanin")
-    ap.add_argument("--repeats", type=int, default=32,
+    ap.add_argument("--repeats", type=int, default=64,
                     help="chained timed runs (one readback at the end)")
     args = ap.parse_args()
 

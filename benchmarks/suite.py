@@ -134,11 +134,11 @@ def main():
     emit(lambda: bench(1 << 20, 8, 8, repeats=256))
     emit(lambda: bench(1 << 20, 64, 8, repeats=64))
     # Headline config on BOTH executors, side by side.
-    emit(lambda: bench(1 << 20, 1024, 8, path="xla"), tag="xla")
-    emit(lambda: bench(1 << 20, 1024, 8, path="pallas", repeats=32),
+    emit(lambda: bench(1 << 20, 1024, 8, path="xla", repeats=32), tag="xla")
+    emit(lambda: bench(1 << 20, 1024, 8, path="pallas", repeats=64),
          tag="pallas")
-    emit(lambda: bench(1 << 20, 1024, 8, config="tombstone", repeats=32))
-    emit(lambda: bench(1 << 20, 1024, 8, config="tiebreak", repeats=32))
+    emit(lambda: bench(1 << 20, 1024, 8, config="tombstone", repeats=64))
+    emit(lambda: bench(1 << 20, 1024, 8, config="tiebreak", repeats=64))
     emit(bench_payload_wire)
     emit(bench_payload_wire_oracle)
 
