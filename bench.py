@@ -176,10 +176,13 @@ def bench(n_keys: int, n_replicas: int, chunk_replicas: int,
     elapsed = time.perf_counter() - t0
 
     suffix = "" if config == "fanin" else f"_{config}"
-    return result_dict(
+    out = result_dict(
         f"record_merges_per_sec_{n_keys // 1000}k_keys_"
         f"x{n_replicas}_replicas{suffix}", merges * repeats, elapsed,
         path=path, platform=platform)
+    out["repeats"] = repeats  # protocol transparency: rows at different
+    #                           amortization levels must be comparable
+    return out
 
 
 def result_dict(metric: str, merges: int, secs: float,

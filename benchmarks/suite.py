@@ -134,7 +134,7 @@ def main():
     emit(lambda: bench(1 << 20, 8, 8, repeats=256))
     emit(lambda: bench(1 << 20, 64, 8, repeats=64))
     # Headline config on BOTH executors, side by side.
-    emit(lambda: bench(1 << 20, 1024, 8, path="xla", repeats=32), tag="xla")
+    emit(lambda: bench(1 << 20, 1024, 8, path="xla", repeats=64), tag="xla")
     emit(lambda: bench(1 << 20, 1024, 8, path="pallas", repeats=64),
          tag="pallas")
     emit(lambda: bench(1 << 20, 1024, 8, config="tombstone", repeats=64))
