@@ -62,6 +62,11 @@ class SqliteCrdt(Crdt[K, V], Generic[K, V]):
     ``path=":memory:"`` (the default) is an ephemeral store useful for
     tests; a filesystem path makes the replica survive restarts —
     reconstructing is just ``SqliteCrdt(node_id, path)`` again.
+
+    Like the reference's single-isolate model, a replica instance is
+    single-threaded (sqlite3's default ``check_same_thread`` guard is
+    left on); cross-thread consumption happens through the watch
+    streams (`watch().aiter()` marshals onto the consumer's loop).
     """
 
     def __init__(self, node_id: Any, path: str = ":memory:", *,
