@@ -55,7 +55,8 @@ def encode(record_map: Dict[Any, Record],
     codec = native.load()
     if codec is not None and record_map:
         # Batch-format the HLC strings natively; None entries (years
-        # outside 0000-9999) fall back to the Python formatter.
+        # outside 0001-9999) fall back to the Python formatter (which
+        # raises, keeping native and pure codecs behaviorally equal).
         recs = list(record_map.values())
         hlcs = codec.format_hlc_batch(
             [r.hlc.millis for r in recs], [r.hlc.counter for r in recs],

@@ -56,8 +56,17 @@ class DenseCrdt:
                  store: Optional[DenseStore] = None,
                  node_ids: Optional[Sequence[Any]] = None,
                  executor: str = "auto"):
-        assert executor in ("auto", "xla", "pallas", "pallas-interpret"), \
-            executor
+        if executor not in ("auto", "xla", "pallas", "pallas-interpret"):
+            raise ValueError(f"unknown executor {executor!r}")
+        if executor in ("pallas", "pallas-interpret"):
+            # Validate eagerly (mirroring grow()): deferring to the
+            # first merge's kernel-level check would mis-run silently
+            # under `python -O` when that check was an assert.
+            from ..ops.pallas_merge import TILE
+            if n_slots % TILE:
+                raise ValueError(
+                    f"executor={executor!r} needs n_slots % {TILE} == 0; "
+                    f"got {n_slots}")
         self._executor = executor
         self._node_id = node_id
         self._wall_clock = wall_clock or wall_clock_millis
@@ -68,7 +77,10 @@ class DenseCrdt:
         self._table = NodeTable(node_ids or [])
         self._store = store if store is not None else empty_dense_store(
             n_slots)
-        assert self._store.n_slots == n_slots
+        if self._store.n_slots != n_slots:  # must survive `python -O`
+            raise ValueError(
+                f"store holds {self._store.n_slots} slots but "
+                f"n_slots={n_slots}")
         if node_id not in self._table:
             self._intern_ids([node_id])
         self.stats = MergeStats()

@@ -183,10 +183,17 @@ class SqliteCrdt(Crdt[K, V], Generic[K, V]):
 
     def record_map(self, modified_since: Optional[Hlc] = None
                    ) -> Dict[K, Record[V]]:
-        since = 0 if modified_since is None else modified_since.logical_time
-        rows = self._conn.execute(
-            "SELECT * FROM records WHERE modified_lt >= ? ORDER BY rowid",
-            (since,))
+        if modified_since is None:
+            # No WHERE clause: a `>= 0` default would silently drop rows
+            # whose modified HLC has pre-epoch (negative) millis —
+            # reachable via the public put_record primitive, where the
+            # reference recordMap() returns all records.
+            rows = self._conn.execute(
+                "SELECT * FROM records ORDER BY rowid")
+        else:
+            rows = self._conn.execute(
+                "SELECT * FROM records WHERE modified_lt >= ? "
+                "ORDER BY rowid", (modified_since.logical_time,))
         return {self._key_dec(row[0]): self._decode_row(row)
                 for row in rows}
 

@@ -175,7 +175,10 @@ static PyObject *format_hlc_batch(PyObject *self, PyObject *args) {
         int sod = (int)(secs - days * 86400);
         long long y; int mo, d;
         civil_from_days(days, &y, &mo, &d);
-        if (y < 0 || y > 9999 || counter < 0 || counter > 0xFFFF) {
+        /* y < 1 (not < 0): the pure-Python _iso8601 raises for year 0,
+         * so the native formatter must defer it to that fallback — the
+         * two codecs stay behaviorally identical at the boundary. */
+        if (y < 1 || y > 9999 || counter < 0 || counter > 0xFFFF) {
             Py_INCREF(Py_None);
             PyList_SET_ITEM(out, i, Py_None);
             continue;

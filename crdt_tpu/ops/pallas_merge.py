@@ -345,13 +345,18 @@ def pallas_fanin_stream(store: SplitStore, cs: SplitChangeset,
     ``new_canonical`` is the post-final-chunk canonical time.
     """
     r, n = cs.hi.shape
-    assert n % TILE == 0, (n, TILE)
-    assert 0 < n_chunks < (1 << 15), n_chunks  # c << 16 must fit int32
+    if n % TILE:  # ValueError, not assert: must survive `python -O`
+        raise ValueError(f"n_slots={n} not a multiple of TILE={TILE}")
+    if not 0 < n_chunks < (1 << 15):  # c << 16 must fit int32
+        raise ValueError(f"n_chunks={n_chunks} out of range [1, 2^15)")
     rows = n // _LANE
 
+    if guards not in ("exact", "fast"):
+        # ValueError, not assert: a stripped assert under `python -O`
+        # would silently route an unknown mode to the fast branch.
+        raise ValueError(f"unknown guards mode {guards!r}")
     # Base changeset max (chunk 0's clock ceiling): chunk c's ceiling is
     # basemax + c<<SHIFT, threaded against canonical in-kernel.
-    assert guards in ("exact", "fast"), guards
     m_hi = jnp.max(cs.hi)
     m_lo = jnp.max(jnp.where(cs.hi == m_hi, cs.lo, 0))
     outs = _launch_stream_grid(
@@ -469,8 +474,11 @@ def pallas_fanin_batch(store: SplitStore, cs: SplitChangeset,
     ``r`` must be a multiple of ``chunk_rows`` (pad with invalid rows)
     and ``n_slots`` a multiple of ``TILE``."""
     r, n = cs.hi.shape
-    assert n % TILE == 0, (n, TILE)
-    assert r % chunk_rows == 0, (r, chunk_rows)
+    if n % TILE:  # ValueError, not assert: must survive `python -O`
+        raise ValueError(f"n_slots={n} not a multiple of TILE={TILE}")
+    if r % chunk_rows:
+        raise ValueError(f"replica rows {r} not a multiple of "
+                         f"chunk_rows={chunk_rows} (pad with invalid rows)")
     n_chunks = r // chunk_rows
 
     m_hi = jnp.max(cs.hi)

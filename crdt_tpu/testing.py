@@ -176,6 +176,55 @@ class CrdtConformance:
         crdt.put("y", 2)
         assert [(e.key, e.value) for e in stream.events] == [("y", 2)]
 
+    def test_watch_put_all_unordered(self):
+        # putAll emits one event per record; delivery order is
+        # unspecified (the reference asserts emitsInAnyOrder,
+        # crdt_test.dart:106-114).
+        crdt = self.make_crdt()
+        stream = crdt.watch().record()
+        crdt.put_all({"x": 1, "y": 2, "z": 3})
+        assert sorted((e.key, e.value) for e in stream.events) == \
+            [("x", 1), ("y", 2), ("z", 3)]
+
+    def test_watch_delete_emits_none(self):
+        # Deletes notify with a null value (crdt_test.dart:116-122:
+        # MapEntry(key, null)).
+        crdt = self.make_crdt()
+        crdt.put("x", 1)
+        stream = crdt.watch().record()
+        crdt.delete("x")
+        assert ("x", None) in [(e.key, e.value) for e in stream.events]
+
+    def test_watch_merge_emits_winners_only(self):
+        # Merge-driven reactivity: adopted records reach putRecords and
+        # emit (map_crdt.dart:33-39); LWW losers never do. Includes a
+        # merged-in tombstone (value None event) and the idempotent
+        # re-merge (no events).
+        cs1, cs2, _ = self._seeded_changesets()
+        crdt = self.make_crdt()
+        stream = crdt.watch().record()
+        crdt.merge(dict(cs1))          # both records new -> both emit
+        assert sorted((e.key, e.value) for e in stream.events) == \
+            [("x", 1), ("y", 7)]
+        crdt.merge(dict(self._seeded_changesets()[0]))  # idempotent
+        assert len(stream.events) == 2  # no new events
+        # cs2: "x" ties on logical time, nodeB > nodeA -> remote wins;
+        # "z" is a new tombstone -> merge-driven None event.
+        crdt.merge(dict(cs2))
+        assert sorted(((e.key, e.value) for e in stream.events[2:]),
+                      key=lambda kv: kv[0]) == [("x", 2), ("z", None)]
+
+    def test_watch_key_filter_under_merge(self):
+        # Per-key filtering applies to merge-driven events too
+        # (crdt_test.dart:124-131 shape, driven through merge).
+        cs1, _, cs3 = self._seeded_changesets()
+        crdt = self.make_crdt()
+        stream = crdt.watch(key="y").record()
+        crdt.merge(dict(cs1))          # y=7 wins, x=1 wins (filtered out)
+        crdt.merge(dict(cs3))          # y=9 wins, z=4 wins (filtered out)
+        assert [(e.key, e.value) for e in stream.events] == \
+            [("y", 7), ("y", 9)]
+
     # --- Merge algebra: the CRDT laws (SURVEY.md §5 race-detection
     # equivalent — commutativity/associativity/idempotence under
     # permutation, map_crdt_test.dart:252-269 in spirit) ---

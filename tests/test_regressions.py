@@ -64,3 +64,48 @@ def test_unsubscribe_idempotent():
     unsub()  # second call must not raise
     crdt.put("y", 2)
     assert len(seen) == 1
+
+
+# --- round-2 advisor findings ---
+
+# 0001-01-01T00:00:00Z in epoch millis; one day earlier is year 0.
+_YEAR1_MS = -62135596800000
+
+
+def test_native_formatter_defers_year_zero():
+    """The C formatter must not emit year-0 wire strings the pure-Python
+    codec refuses: both paths raise for year < 1 (hlccodec.c guard)."""
+    from crdt_tpu import crdt_json, native
+    year0 = Hlc(_YEAR1_MS - 86_400_000, 0, "n")
+    rec = Record(year0, 1, year0)
+    with pytest.raises(ValueError):
+        crdt_json.encode({"k": rec})
+    codec = native.load()
+    if codec is not None:  # direct check of the C guard boundary
+        assert codec.format_hlc_batch(
+            [year0.millis], [0], ["n"]) == [None]
+        assert codec.format_hlc_batch(
+            [_YEAR1_MS], [0], ["n"]) == ["0001-01-01T00:00:00.000Z-0000-n"]
+
+
+def test_sqlite_record_map_includes_pre_epoch_modified():
+    """record_map() with no bound must return ALL rows, including ones
+    whose modified HLC has negative millis (reachable via put_record;
+    a default `modified_lt >= 0` filter silently dropped them)."""
+    from crdt_tpu import SqliteCrdt
+    crdt = SqliteCrdt("abc", wall_clock=FakeClock())
+    old = Hlc(-5000, 0, "abc")
+    crdt.put_record("k", Record(old, 1, old))
+    assert "k" in crdt.record_map()
+    assert crdt.record_map()["k"].value == 1
+
+
+def test_dense_pallas_executor_rejects_unaligned_capacity_eagerly():
+    """A forced pallas executor must refuse a TILE-unaligned n_slots at
+    construction (not via a strippable assert at first merge)."""
+    from crdt_tpu import DenseCrdt
+    from crdt_tpu.ops.pallas_merge import TILE
+    with pytest.raises(ValueError, match="n_slots"):
+        DenseCrdt("abc", TILE + 1, executor="pallas")
+    with pytest.raises(ValueError, match="executor"):
+        DenseCrdt("abc", TILE, executor="warp")
