@@ -224,6 +224,19 @@ class Hlc(Generic[T]):
         return cls(logical_time >> SHIFT, logical_time & MAX_COUNTER, node_id)
 
     @classmethod
+    def _raw(cls, millis: int, counter: int, node_id: T) -> "Hlc[T]":
+        """Unchecked fast construction for batch decode loops (values
+        already validated lane-side: counter fits 16 bits, millis is
+        genuine millis). ~3x cheaper than ``__init__`` at the 1M-record
+        export scales where Hlc construction dominates."""
+        h = cls.__new__(cls)
+        s = object.__setattr__
+        s(h, "millis", millis)
+        s(h, "counter", counter)
+        s(h, "node_id", node_id)
+        return h
+
+    @classmethod
     def parse(cls, timestamp: str,
               id_decoder: Optional[Callable[[str], T]] = None) -> "Hlc[T]":
         """Parse '<iso8601>-<4-hex-counter>-<nodeId>' (hlc.dart:39-46).

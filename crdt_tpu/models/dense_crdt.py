@@ -39,7 +39,8 @@ from ..hlc import (ClockDriftException, DuplicateNodeException, Hlc,
 from ..ops.dense import (DenseChangeset, DenseStore, FaninResult, _NEG,
                          dense_delta_mask, dense_max_logical_time,
                          empty_dense_store, fanin_step, fanin_stream,
-                         pad_replica_rows, store_to_changeset)
+                         pad_replica_rows, sparse_fanin_step,
+                         store_to_changeset)
 from ..ops.merge import recv_guards
 from ..ops.packing import NodeTable
 from ..record import (KeyDecoder, KeyEncoder, Record, ValueDecoder,
@@ -186,10 +187,12 @@ class DenseCrdt:
 
     def get(self, slot: int) -> Optional[int]:
         self._check_slot(slot)
-        occ, tomb, val = (bool(self._store.occupied[slot]),
-                          bool(self._store.tomb[slot]),
-                          int(self._store.val[slot]))
-        return val if occ and not tomb else None
+        # One batched fetch: three sequential scalar reads pay three
+        # full round trips on remote-proxied backends.
+        occ, tomb, val = jax.device_get(
+            (self._store.occupied[slot], self._store.tomb[slot],
+             self._store.val[slot]))
+        return int(val) if bool(occ) and not bool(tomb) else None
 
     def contains_slot(self, slot: int) -> bool:
         """True if the slot holds a record, live OR tombstoned
@@ -289,40 +292,101 @@ class DenseCrdt:
     # a dense replica can sync with MapCrdt/TpuMapCrdt or external
     # JSON peers, not just other dense stores. ---
 
-    def record_map(self, modified_since: Optional[Hlc] = None
-                   ) -> Dict[int, Record]:
-        """Slot→Record export (recordMap semantics, crdt.dart:140-169,
-        inclusive ``modified_since`` bound) — the bridge between the
-        columnar lanes and the record-dict/JSON world. One device→host
-        transfer; per-record work is host-side decode of winners only."""
+    def _delta_mask(self, modified_since: Optional[Hlc]) -> np.ndarray:
         if modified_since is None:
             mask = self._store.occupied
         else:
             mask = dense_delta_mask(
                 self._store, jnp.int64(modified_since.logical_time))
+        return mask
+
+    def record_map(self, modified_since: Optional[Hlc] = None
+                   ) -> Dict[int, Record]:
+        """Slot→Record export (recordMap semantics, crdt.dart:140-169,
+        inclusive ``modified_since`` bound) — the bridge between the
+        columnar lanes and the record-dict/JSON world. One device→host
+        transfer; decode is vectorized (numpy unpack + object-array
+        node gather), with per-record work reduced to the raw
+        ``Hlc``/``Record`` allocations."""
+        mask = self._delta_mask(modified_since)
         # One batched fetch (async prefetch per leaf) instead of seven
         # sequential device->host round trips.
         mask, lt, node, val, mod_lt, mod_node, tomb = jax.device_get(
             (mask, self._store.lt, self._store.node, self._store.val,
              self._store.mod_lt, self._store.mod_node, self._store.tomb))
-        out: Dict[int, Record] = {}
-        for slot in np.nonzero(mask)[0]:
-            h = Hlc.from_logical_time(
-                int(lt[slot]), self._table.id_of(int(node[slot])))
-            m = Hlc.from_logical_time(
-                int(mod_lt[slot]), self._table.id_of(int(mod_node[slot])))
-            out[int(slot)] = Record(
-                h, None if tomb[slot] else int(val[slot]), m)
-        return out
+        idx = np.nonzero(mask)[0]
+        if idx.size == 0:
+            return {}
+        ids = np.array(self._table.ids(), object)
+        from ..hlc import MAX_COUNTER, SHIFT
+        cols = (idx.tolist(),
+                (lt[idx] >> SHIFT).tolist(),
+                (lt[idx] & MAX_COUNTER).tolist(),
+                ids[node[idx]],
+                val[idx].tolist(), tomb[idx].tolist(),
+                (mod_lt[idx] >> SHIFT).tolist(),
+                (mod_lt[idx] & MAX_COUNTER).tolist(),
+                ids[mod_node[idx]])
+        raw = Hlc._raw
+        return {
+            slot: Record(raw(ms, c, n), None if tb else v,
+                         raw(mms, mc, mn))
+            for slot, ms, c, n, v, tb, mms, mc, mn in zip(*cols)
+        }
 
     def to_json(self, modified_since: Optional[Hlc] = None,
                 key_encoder: Optional[KeyEncoder] = None,
                 value_encoder: Optional[ValueEncoder] = None) -> str:
         """Wire JSON export (crdt.dart:124-135): slots stringify as int
-        keys, matching the reference's int-key golden format."""
+        keys, matching the reference's int-key golden format.
+
+        With default coders this streams straight from the lanes —
+        numpy unpack, C-codec batch HLC formatting, direct string
+        assembly (every piece is JSON-plain: int keys, int/null
+        values) — byte-identical to the generic encoder but without
+        materializing a Record dict (a 1M-slot export runs in seconds,
+        benchmarks/suite.py `dense_to_json`)."""
+        if key_encoder is None and value_encoder is None:
+            fast = self._to_json_fast(modified_since)
+            if fast is not None:
+                return fast
         return crdt_json.encode(self.record_map(modified_since),
                                 key_encoder=key_encoder,
                                 value_encoder=value_encoder)
+
+    def _to_json_fast(self, modified_since: Optional[Hlc]) -> Optional[str]:
+        """Lane-direct wire export, or None to defer to the generic
+        path (no native codec; a node id that needs JSON escaping; an
+        out-of-range year)."""
+        from .. import native
+        codec = native.load()
+        if codec is None:
+            return None
+        id_strs = [str(n) for n in self._table.ids()]
+        if any('"' in s or "\\" in s or any(ord(c) < 0x20 for c in s)
+               for s in id_strs):
+            return None  # embedded hlc strings would need escaping
+        mask = self._delta_mask(modified_since)
+        # `modified` is local-only and never serialized
+        # (record.dart:28-31) — the wire fetch skips those lanes.
+        mask, lt, node, val, tomb = jax.device_get(
+            (mask, self._store.lt, self._store.node, self._store.val,
+             self._store.tomb))
+        idx = np.nonzero(mask)[0]
+        if idx.size == 0:
+            return "{}"
+        from ..hlc import MAX_COUNTER, SHIFT
+        hlcs = codec.format_hlc_batch(
+            (lt[idx] >> SHIFT).tolist(), (lt[idx] & MAX_COUNTER).tolist(),
+            np.array(id_strs, object)[node[idx]].tolist())
+        if None in hlcs:
+            return None  # year outside 0001-9999: generic path raises
+        parts = [
+            f'"{slot}":{{"hlc":"{h}","value":{"null" if tb else v}}}'
+            for slot, h, v, tb in zip(idx.tolist(), hlcs,
+                                      val[idx].tolist(), tomb[idx].tolist())
+        ]
+        return "{" + ",".join(parts) + "}"
 
     def merge_records(self, record_map: Dict[int, Record]) -> None:
         """Fan-in a record dict (from a MapCrdt/TpuMapCrdt peer or a
@@ -336,26 +400,23 @@ class DenseCrdt:
         exactly. A slot-ordered device-side check could disagree on
         which records the fast path shields (hlc.dart:85). After
         absorption the canonical clock is ≥ every remote lt, so the
-        device guards stay structurally quiet and the join itself is
-        order-independent."""
+        join itself needs no further guard work and is
+        order-independent.
+
+        Cost is O(k) in the delta size — host arrays, transfer, and
+        the `sparse_fanin_step` gather/scatter are all k-wide (a
+        10-record JSON sync into a 1M-slot replica must not
+        materialize 1M-wide lanes). Equivalence with the full-width
+        changeset join is property-tested
+        (tests/test_dense_crdt.py::TestSparseWireDelta)."""
         if not record_map:
             self.merge_many([])
             return
+        self.stats.merges += 1
+        # add_seen_lazy (host int here): `records_seen +=` would drain
+        # any pending lazy device scalar with a blocking readback.
+        self.stats.add_seen_lazy(len(record_map))
         wall = self._wall_clock()
-        for rec in record_map.values():
-            self._canonical_time = Hlc.recv(self._canonical_time, rec.hlc,
-                                            millis=wall)
-        slots = np.fromiter(record_map.keys(), np.int64,
-                            count=len(record_map))
-        self._check_slots(slots)
-        ids = sorted({r.hlc.node_id for r in record_map.values()})
-        id_to_ord = {nid: i for i, nid in enumerate(ids)}
-        n = self.n_slots
-        lt = np.zeros((n,), np.int64)
-        node = np.zeros((n,), np.int32)
-        val = np.zeros((n,), np.int64)
-        tomb = np.zeros((n,), bool)
-        valid = np.zeros((n,), bool)
         for slot, rec in record_map.items():
             if rec.value is not None and not isinstance(
                     rec.value, (int, np.integer)):
@@ -365,16 +426,54 @@ class DenseCrdt:
                 raise TypeError(
                     f"DenseCrdt values must be ints; slot {slot} got "
                     f"{type(rec.value).__name__}")
-            lt[slot] = rec.hlc.logical_time
-            node[slot] = id_to_ord[rec.hlc.node_id]
-            val[slot] = 0 if rec.value is None else int(rec.value)
-            tomb[slot] = rec.is_deleted
-            valid[slot] = True
-        cs = DenseChangeset(
-            lt=jnp.asarray(lt)[None], node=jnp.asarray(node)[None],
-            val=jnp.asarray(val)[None], tomb=jnp.asarray(tomb)[None],
-            valid=jnp.asarray(valid)[None])
-        self.merge(cs, ids)
+            self._canonical_time = Hlc.recv(self._canonical_time, rec.hlc,
+                                            millis=wall)
+        k = len(record_map)
+        slots = np.fromiter(record_map.keys(), np.int64, count=k)
+        self._check_slots(slots)
+        recs = list(record_map.values())
+        self._intern_ids({r.hlc.node_id for r in recs})
+        ords = {nid: i for i, nid in enumerate(self._table.ids())}
+        # Pad k to a power of two so the jitted step compiles O(log k)
+        # distinct shapes, not one per delta size.
+        padded = 1 << max(k - 1, 1).bit_length()
+        lt = np.zeros((padded,), np.int64)
+        node = np.zeros((padded,), np.int32)
+        val = np.zeros((padded,), np.int64)
+        tomb = np.zeros((padded,), bool)
+        valid = np.zeros((padded,), bool)
+        slot_arr = np.full((padded,), self.n_slots, np.int64)
+        slot_arr[:k] = slots
+        valid[:k] = True
+        lt[:k] = [r.hlc.logical_time for r in recs]
+        node[:k] = [ords[r.hlc.node_id] for r in recs]
+        val[:k] = [0 if r.value is None else int(r.value) for r in recs]
+        tomb[:k] = [r.is_deleted for r in recs]
+
+        stamp = jnp.int64(self._canonical_time.logical_time)
+        with merge_annotation("crdt_tpu.dense_merge"):
+            new_store, win = sparse_fanin_step(
+                self._store, jnp.asarray(slot_arr), jnp.asarray(lt),
+                jnp.asarray(node), jnp.asarray(val), jnp.asarray(tomb),
+                jnp.asarray(valid), stamp,
+                jnp.int32(self._table.ordinal(self._node_id)))
+        self._store = self._postprocess_store(new_store)
+
+        if self._hub.active:
+            win_h = np.asarray(jax.device_get(win))[:k]
+            self.stats.records_adopted += int(win_h.sum())
+            for i, (slot, rec) in enumerate(record_map.items()):
+                if win_h[i]:
+                    self._hub.add(int(slot),
+                                  None if rec.is_deleted else int(rec.value))
+        else:
+            # No subscriber: keep the win mask on device — the warm
+            # sparse path then has ZERO device->host fetches (each one
+            # is a full round trip on remote-proxied backends); the
+            # adopted counter drains lazily when stats are read.
+            self.stats.add_adopted_lazy(jnp.sum(win))
+        self._canonical_time = Hlc.send(self._canonical_time,
+                                        millis=self._wall_clock())
 
     def merge_json(self, json_str: str,
                    key_decoder: Optional[KeyDecoder] = None,
@@ -395,8 +494,7 @@ class DenseCrdt:
         lanes index into (`crdt_tpu.checkpoint.save_dense`)."""
         from ..checkpoint import save_dense
         save_dense(self._store, path,
-                   node_ids=[self._table.id_of(i)
-                             for i in range(len(self._table))])
+                   node_ids=self._table.ids())
 
     @classmethod
     def load(cls, node_id: Any, path: str,
@@ -427,7 +525,7 @@ class DenseCrdt:
         node-id list its ordinals index into."""
         since_lt = None if since is None else jnp.int64(since.logical_time)
         cs = store_to_changeset(self._store, since_lt)
-        return cs, [self._table.id_of(i) for i in range(len(self._table))]
+        return cs, self._table.ids()
 
     def _fit_slots(self, cs: DenseChangeset) -> DenseChangeset:
         """Normalize a peer changeset's slot width to this replica's
@@ -560,6 +658,11 @@ class DenseCrdt:
             win=res.win, any_bad=any_bad, first_bad=first_bad,
             first_is_dup=first_is_dup, canonical_at_fail=canonical_at_fail)
 
+    def _postprocess_store(self, store: DenseStore) -> DenseStore:
+        """Hook for subclasses to re-annotate a freshly written store
+        (the sharded model re-applies its NamedSharding here)."""
+        return store
+
     def _raise_guard(self, cs: DenseChangeset, res, wall: int) -> None:
         # Store untouched; canonical rolled to the pre-failure value
         # (sequential-merge parity, crdt.dart:77-94 throw path).
@@ -686,6 +789,12 @@ class ShardedDenseCrdt(DenseCrdt):
     # _exact_guards: inherited — ShardedFaninResult carries no
     # first_bad field, so the base recompute path handles the sharded
     # collectives' superset flags (see `crdt_tpu.parallel.fanin`).
+
+    def _postprocess_store(self, store):
+        # Sparse scatters land with XLA-chosen output sharding; pin the
+        # key-axis NamedSharding back on (no copy when it already
+        # matches).
+        return self._shard(store)
 
     def put_batch(self, slots, values) -> None:
         super().put_batch(slots, values)

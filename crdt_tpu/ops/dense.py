@@ -266,6 +266,46 @@ def fanin_stream(store: DenseStore, chunks: DenseChangeset,
 
 
 @jax.jit
+def sparse_fanin_step(store: DenseStore, slot: jax.Array, lt: jax.Array,
+                      node: jax.Array, val: jax.Array, tomb: jax.Array,
+                      valid: jax.Array, stamp_lt: jax.Array,
+                      local_node: jax.Array
+                      ) -> Tuple[DenseStore, jax.Array]:
+    """O(k) slot-indexed scatter join of a k-record delta into an
+    N-slot store — the wire-delta shape (a 10-record JSON sync into a
+    1M-slot replica must not materialize 1M-wide lanes).
+
+    Clock absorption and recv guards are the CALLER's job (run
+    host-side in the payload's visit order, crdt.dart:80-85, before
+    invoking); ``stamp_lt`` is the post-absorption canonical that
+    winners' ``modified`` lanes take (crdt.dart:86-87). Slots must be
+    unique (a dict-keyed delta guarantees it). Returns
+    ``(new_store, win)`` with ``win`` over the k entries."""
+    l_lt = store.lt.at[slot].get(mode="fill", fill_value=0)
+    l_node = store.node.at[slot].get(mode="fill", fill_value=0)
+    l_occ = store.occupied.at[slot].get(mode="fill", fill_value=False)
+
+    # Strict (lt, node) compare: local wins exact ties (crdt.dart:84).
+    remote_newer = (lt > l_lt) | ((lt == l_lt) & (node > l_node))
+    win = valid & (~l_occ | remote_newer)
+
+    target = jnp.where(win, slot, store.n_slots).astype(jnp.int32)
+    k = slot.shape[0]
+    new_store = DenseStore(
+        lt=store.lt.at[target].set(lt, mode="drop"),
+        node=store.node.at[target].set(node, mode="drop"),
+        val=store.val.at[target].set(val, mode="drop"),
+        mod_lt=store.mod_lt.at[target].set(
+            jnp.zeros((k,), jnp.int64) + stamp_lt, mode="drop"),
+        mod_node=store.mod_node.at[target].set(
+            jnp.zeros((k,), jnp.int32) + local_node, mode="drop"),
+        occupied=store.occupied.at[target].set(True, mode="drop"),
+        tomb=store.tomb.at[target].set(tomb, mode="drop"),
+    )
+    return new_store, win
+
+
+@jax.jit
 def dense_delta_mask(store: DenseStore, since_lt: jax.Array) -> jax.Array:
     """modifiedSince filter — INCLUSIVE bound on the modified lane
     (map_crdt.dart:44-45)."""

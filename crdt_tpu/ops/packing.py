@@ -61,6 +61,10 @@ class NodeTable:
     def id_of(self, ordinal: int) -> Any:
         return self._sorted[ordinal]
 
+    def ids(self) -> List[Any]:
+        """All interned ids in ordinal order (a copy)."""
+        return list(self._sorted)
+
     def intern(self, node_ids: Sequence[Any]
                ) -> Optional[np.ndarray]:
         """Add any unseen ids. Returns an int32 remap vector mapping old

@@ -26,11 +26,12 @@ class MergeStats:
     device→host transfer; reading the property drains them.
     """
     merges: int = 0            # merge() calls
-    records_adopted: int = 0   # LWW winners written
     puts: int = 0              # local write batches (put/put_all)
     records_put: int = 0       # local records written
     _seen: int = 0
     _seen_pending: Any = None  # lazy running sum (device scalar)
+    _adopted: int = 0
+    _adopted_pending: Any = None
 
     @property
     def records_seen(self) -> int:
@@ -50,6 +51,24 @@ class MergeStats:
         forcing a sync; kept as one running device sum (O(1) memory)."""
         self._seen_pending = (count if self._seen_pending is None
                               else self._seen_pending + count)
+
+    @property
+    def records_adopted(self) -> int:
+        """LWW winners written; may drain a lazy device sum."""
+        if self._adopted_pending is not None:
+            self._adopted += int(self._adopted_pending)
+            self._adopted_pending = None
+        return self._adopted
+
+    @records_adopted.setter
+    def records_adopted(self, value: int) -> None:
+        self._adopted_pending = None
+        self._adopted = value
+
+    def add_adopted_lazy(self, count: Any) -> None:
+        self._adopted_pending = (
+            count if self._adopted_pending is None
+            else self._adopted_pending + count)
 
     def as_dict(self) -> dict:
         return {k: getattr(self, k) for k in

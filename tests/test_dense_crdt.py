@@ -872,3 +872,139 @@ class TestGrow:
         b.put_batch([N + 5], [7])
         sync_dense(a, b)
         assert a.get(N + 5) == 7 and b.get(1) == 5
+
+
+class TestSparseWireDelta:
+    """merge_records is O(k): slot-indexed sparse scatter, equivalent
+    to the full-width changeset join lane-for-lane."""
+
+    @staticmethod
+    def _full_width_merge(crdt, record_map):
+        """The pre-sparse formulation: absorb host-side, then
+        materialize an [1, n_slots] DenseChangeset and run the fused
+        fan-in — the old merge_records shape, kept as the oracle."""
+        wall = crdt._wall_clock()
+        for rec in record_map.values():
+            crdt._canonical_time = Hlc.recv(
+                crdt._canonical_time, rec.hlc, millis=wall)
+        ids = sorted({r.hlc.node_id for r in record_map.values()})
+        id_to_ord = {nid: i for i, nid in enumerate(ids)}
+        n = crdt.n_slots
+        lanes = dict(lt=np.zeros((n,), np.int64),
+                     node=np.zeros((n,), np.int32),
+                     val=np.zeros((n,), np.int64),
+                     tomb=np.zeros((n,), bool),
+                     valid=np.zeros((n,), bool))
+        for slot, rec in record_map.items():
+            lanes["lt"][slot] = rec.hlc.logical_time
+            lanes["node"][slot] = id_to_ord[rec.hlc.node_id]
+            lanes["val"][slot] = 0 if rec.value is None else int(rec.value)
+            lanes["tomb"][slot] = rec.is_deleted
+            lanes["valid"][slot] = True
+        from crdt_tpu.ops.dense import DenseChangeset
+        cs = DenseChangeset(**{k: jnp.asarray(v)[None]
+                               for k, v in lanes.items()})
+        crdt.merge(cs, ids)
+
+    @pytest.mark.parametrize("seed", range(6))
+    def test_matches_full_width_join(self, seed):
+        from crdt_tpu.testing import assert_dense_stores_equal
+        from crdt_tpu import Record
+        rng = np.random.default_rng(seed)
+        k = int(rng.integers(1, 40))
+        slots = rng.choice(N, size=k, replace=False)
+        nodes = ["nb", "nc", "nd"]
+        recs = {}
+        for s in slots:
+            h = Hlc(BASE + int(rng.integers(0, 5)),
+                    int(rng.integers(0, 3)), nodes[int(rng.integers(3))])
+            v = None if rng.random() < 0.3 else int(rng.integers(100))
+            recs[int(s)] = Record(h, v, h)
+        a, b = make(), make()
+        # Pre-seed both with identical local state so LWW ties and
+        # occupied-slot compares are exercised.
+        a.put_batch([0, 1, 2], [7, 8, 9])
+        b.put_batch([0, 1, 2], [7, 8, 9])
+        a.merge_records(dict(recs))          # sparse path
+        self._full_width_merge(b, dict(recs))  # full-width oracle
+        assert_dense_stores_equal(a.store, b.store, "sparse vs full")
+        assert a.canonical_time == b.canonical_time
+
+    def test_host_and_transfer_cost_is_delta_sized(self, monkeypatch):
+        """A k-record delta into a large store must ship k-wide arrays
+        to the device, not n_slots-wide lanes."""
+        import crdt_tpu.models.dense_crdt as m
+        big = DenseCrdt("na", 1 << 16, wall_clock=FakeClock(start=BASE))
+        seen = {}
+        real = m.sparse_fanin_step
+
+        def spy(store, slot, lt, *args, **kw):
+            seen["width"] = slot.shape[0]
+            return real(store, slot, lt, *args, **kw)
+
+        monkeypatch.setattr(m, "sparse_fanin_step", spy)
+        h = Hlc(BASE + 1, 0, "nb")
+        from crdt_tpu import Record
+        big.merge_records({5: Record(h, 1, h), 9: Record(h, 2, h),
+                           (1 << 16) - 1: Record(h, 3, h)})
+        assert seen["width"] == 4  # 3 records padded to pow2, not 65536
+        assert big.get(5) == 1 and big.get((1 << 16) - 1) == 3
+
+    def test_sharded_merge_records_stays_sharded(self):
+        import jax
+        from crdt_tpu.models.dense_crdt import ShardedDenseCrdt
+        from crdt_tpu.parallel import make_fanin_mesh
+        if jax.device_count() < 8:
+            pytest.skip("needs an 8-device mesh")
+        mesh = make_fanin_mesh(2, 4)
+        c = ShardedDenseCrdt("na", N, mesh,
+                             wall_clock=FakeClock(start=BASE))
+        h = Hlc(BASE + 1, 0, "nb")
+        from crdt_tpu import Record
+        c.merge_records({3: Record(h, 30, h)})
+        assert c.get(3) == 30
+        # The key axis sharding survives the sparse scatter.
+        shardings = {str(c.store.lt.sharding), str(c.store.val.sharding)}
+        assert len(shardings) == 1 and "key" in shardings.pop()
+
+
+class TestFastJsonExport:
+    """The lane-direct to_json must be byte-identical to the generic
+    Record-dict encoder, falling back whenever it can't be."""
+
+    def _populated(self, node="na"):
+        a, b = make(node), make("nb", BASE + 5)
+        a.put_batch([0, 3, 7], [10, 30, 70])
+        b.put_batch([3, 9], [31, 90])
+        b.delete_batch([9])
+        sync_dense(a, b)
+        return a
+
+    def test_matches_generic_encoder(self):
+        from crdt_tpu import crdt_json
+        a = self._populated()
+        generic = crdt_json.encode(a.record_map())
+        assert a.to_json() == generic
+        # Delta export too (inclusive bound).
+        t = a.canonical_time
+        a.put_batch([1], [11])
+        assert a.to_json(modified_since=t) == crdt_json.encode(
+            a.record_map(modified_since=t))
+
+    def test_empty_store(self):
+        assert make().to_json() == "{}"
+
+    def test_escape_needing_node_id_falls_back(self):
+        import json
+        a = self._populated(node='quo"te\\n')
+        out = a.to_json()
+        parsed = json.loads(out)          # still valid JSON
+        assert any('quo"te' in v["hlc"] for v in parsed.values())
+        from crdt_tpu import crdt_json
+        assert out == crdt_json.encode(a.record_map())
+
+    def test_round_trips_through_merge_json(self):
+        a = self._populated()
+        c = make("nc", BASE + 50)
+        c.merge_json(a.to_json())
+        assert c.record_map() == a.record_map()
