@@ -27,7 +27,7 @@ import time
 sys.path.insert(0, os.path.dirname(os.path.dirname(
     os.path.abspath(__file__))))  # repo root (bench.py helpers)
 
-from bench import _MILLIS, bench, result_dict
+from bench import _MILLIS, bench, bench_distinct, result_dict
 from crdt_tpu import Hlc, MapCrdt, Record, TpuMapCrdt
 from crdt_tpu.testing import FakeClock
 
@@ -55,8 +55,8 @@ def bench_example_oracle(n_keys=1000, repeats=5):
 
 
 def bench_example_device(n_keys=1000, repeats=5):
-    """Config 1 on the device-columnar backend (host encode included —
-    this measures the drop-in TpuMapCrdt path, not the dense kernel)."""
+    """Config 1 on the drop-in TpuMapCrdt (host-shadow vectorized merge;
+    reads are fetch-free, the device mirror syncs lazily)."""
     remote = scalar_records(n_keys, "remote")
     best = float("inf")
     for _ in range(repeats):
@@ -64,12 +64,11 @@ def bench_example_device(n_keys=1000, repeats=5):
                           wall_clock=FakeClock(start=_MILLIS + 10_000))
         t0 = time.perf_counter()
         crdt.merge(dict(remote))
-        crdt.get_record("k0")  # force device sync
+        crdt.get_record("k0")
         best = min(best, time.perf_counter() - t0)
-    import jax
     return result_dict(
         f"tpu_backend_2replica_{n_keys}key_int_merges_per_sec", n_keys,
-        best, path="tpu_map_crdt", platform=jax.devices()[0].platform)
+        best, path="tpu_map_crdt-host-shadow")
 
 
 def _bench_wire(dst_factory, metric: str, path: str, n_keys: int,
@@ -93,12 +92,31 @@ def _bench_wire(dst_factory, metric: str, path: str, n_keys: int,
 
 
 def bench_payload_wire(n_keys=10_000, repeats=3):
-    """Config 5: wire ingest into the device-columnar backend (payloads
-    stay host-side; only indices/winners touch the device)."""
+    """Config 5: wire ingest into TpuMapCrdt — columnar decode (C batch
+    HLC parse) + vectorized shadow-lane join, no Record/Hlc objects."""
     return _bench_wire(
         lambda: TpuMapCrdt("local", wall_clock=FakeClock(start=_MILLIS + 10)),
         f"wire_json_{n_keys}key_varlen_payload_merges_per_sec",
-        "wire-json-host", n_keys, repeats, sync_key="key-0")
+        "wire-json-columnar", n_keys, repeats, sync_key="key-0")
+
+
+def bench_dense_to_json(n_slots=1 << 20, repeats=3):
+    """1M-slot full wire export on the dense model (the interop contract
+    crdt.dart:124-135 at dense scale): lane-direct C-codec formatting."""
+    import numpy as np
+    from crdt_tpu import DenseCrdt
+    c = DenseCrdt("na", n_slots, wall_clock=FakeClock(start=_MILLIS))
+    c.put_batch(np.arange(n_slots), np.arange(n_slots, dtype=np.int64))
+    c.delete_batch(np.arange(0, n_slots, 7))
+    best = float("inf")
+    for _ in range(repeats):
+        t0 = time.perf_counter()
+        out = c.to_json()
+        best = min(best, time.perf_counter() - t0)
+    assert out.startswith('{"0":')
+    return result_dict(
+        f"dense_to_json_{n_slots // 1000}k_records_per_sec", n_slots,
+        best, path="lane-direct-c-codec")
 
 
 def bench_payload_wire_oracle(n_keys=10_000, repeats=5):
@@ -133,14 +151,23 @@ def main():
     # trip doesn't dominate (see bench.py protocol note).
     emit(lambda: bench(1 << 20, 8, 8, repeats=256))
     emit(lambda: bench(1 << 20, 64, 8, repeats=64))
-    # Headline config on BOTH executors, side by side.
+    # Write-stream headline config on BOTH executors, side by side.
     emit(lambda: bench(1 << 20, 1024, 8, path="xla", repeats=64), tag="xla")
     emit(lambda: bench(1 << 20, 1024, 8, path="pallas", repeats=64),
          tag="pallas")
+    # GENUINELY DISTINCT replica rows resident in HBM (the
+    # BASELINE.md:26 north-star workload; every counted merge pays its
+    # full HBM read — see bench.bench_distinct).
+    emit(lambda: bench_distinct(1 << 20, 128, loops=16))
     emit(lambda: bench(1 << 20, 1024, 8, config="tombstone", repeats=64))
     emit(lambda: bench(1 << 20, 1024, 8, config="tiebreak", repeats=64))
     emit(bench_payload_wire)
     emit(bench_payload_wire_oracle)
+    # 1M-key wire ingest: the drop-in backend vs the oracle at the
+    # scale DenseCrdt stores actually run at.
+    emit(lambda: bench_payload_wire(n_keys=1 << 20, repeats=1))
+    emit(lambda: bench_payload_wire_oracle(n_keys=1 << 20, repeats=1))
+    emit(bench_dense_to_json)
 
 
 if __name__ == "__main__":

@@ -122,6 +122,59 @@ def decode(json_str: str, canonical_time: Hlc,
     }
 
 
+def decode_columns(json_str: str,
+                   key_decoder: Optional[KeyDecoder] = None,
+                   value_decoder: Optional[ValueDecoder] = None,
+                   node_id_decoder: Optional[NodeIdDecoder] = None):
+    """Wire JSON -> columnar ``(keys, lt, node_ids, values)`` without
+    materializing `Record`/`Hlc` objects — the ingest shape the
+    vectorized backends consume (``lt`` is an int64 ndarray of packed
+    logical times; ``node_ids`` a list aligned with it).
+
+    Semantics match :func:`decode` minus the ``modified`` stamp, which
+    is the MERGING store's concern (winners are re-stamped with the
+    post-absorption canonical anyway, crdt.dart:86-87; ``modified`` is
+    never itself on the wire, record.dart:28-31).
+    """
+    import numpy as np
+
+    raw = json.loads(json_str)
+    items = list(raw.items())
+    m = len(items)
+    hlc_strs = [v["hlc"] for _, v in items]
+    codec = native.load()
+    millis_l = counter_l = node_l = None
+    if codec is not None and m:
+        millis_l, counter_l, node_l = codec.parse_hlc_batch(hlc_strs)
+    from .hlc import SHIFT
+    if millis_l is not None and None not in millis_l:
+        lt = ((np.array(millis_l, np.int64) << SHIFT)
+              + np.array(counter_l, np.int64))
+        nodes = node_l
+    else:
+        # Per-item fallback for non-canonical shapes (or no C codec).
+        lt = np.empty(m, np.int64)
+        nodes = [None] * m
+        for i, s in enumerate(hlc_strs):
+            if millis_l is not None and millis_l[i] is not None:
+                ms, c, n = millis_l[i], counter_l[i], node_l[i]
+            else:
+                h = Hlc.parse(s)
+                ms, c, n = h.millis, h.counter, h.node_id
+            lt[i] = (ms << SHIFT) + c
+            nodes[i] = n
+    if node_id_decoder is not None:
+        nodes = [node_id_decoder(n) for n in nodes]
+    keys = ([k for k, _ in items] if key_decoder is None
+            else [key_decoder(k) for k, _ in items])
+    if value_decoder is None:
+        values = [v.get("value") for _, v in items]
+    else:
+        values = [None if (raw_v := v.get("value")) is None
+                  else value_decoder(k, raw_v) for k, v in items]
+    return keys, lt, nodes, values
+
+
 class CrdtJson:
     """Namespace mirroring the reference's static class (crdt_json.dart:5)."""
 

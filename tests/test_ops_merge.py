@@ -1,0 +1,94 @@
+"""Direct coverage of the generic slot-indexed device join
+(`ops.merge.merge_step` / `scatter_put`) — the public device-side op
+for `Store`-layout workflows (e.g. a `TpuMapCrdt.store` mirror).
+Previously exercised only transitively; the backend now decides
+small merges host-side, so the op is pinned here directly."""
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from crdt_tpu import Hlc, MapCrdt, Record
+from crdt_tpu.ops.merge import (Changeset, empty_store, max_logical_time,
+                                merge_step, scatter_put, delta_mask)
+from crdt_tpu.testing import FakeClock
+
+BASE = 1_700_000_000_000
+
+
+def _changeset(slots, lts, nodes, tombs):
+    m = len(slots)
+    return Changeset(
+        slot=jnp.asarray(np.array(slots, np.int32)),
+        lt=jnp.asarray(np.array(lts, np.int64)),
+        node=jnp.asarray(np.array(nodes, np.int32)),
+        tomb=jnp.asarray(np.array(tombs, bool)),
+        valid=jnp.ones(m, bool))
+
+
+class TestMergeStep:
+    def test_matches_oracle_merge(self):
+        """merge_step's win set and stamps equal MapCrdt.merge for the
+        same records (node ordinals: 0='aaa' local, 1='nbb', 2='ncc')."""
+        oracle = MapCrdt("aaa", wall_clock=FakeClock(start=BASE + 50))
+        h_old = Hlc(BASE + 1, 0, "nbb")
+        h_new = Hlc(BASE + 9, 2, "ncc")
+        oracle.put_record("k0", Record(h_old, 10, h_old))
+        remote = {"k0": Record(h_new, 20, h_new),
+                  "k1": Record(h_old, 30, h_old)}
+        oracle.merge(dict(remote))
+
+        store = empty_store(8)
+        # seed slot 0 with the local record (ordinal 1 = 'nbb')
+        store = scatter_put(
+            store, _changeset([0], [h_old.logical_time], [1], [False]),
+            jnp.asarray([h_old.logical_time]), jnp.asarray([1], jnp.int32))
+        cs = _changeset([0, 1],
+                        [h_new.logical_time, h_old.logical_time],
+                        [2, 1], [False, False])
+        new_store, res = merge_step(
+            store, cs, jnp.int64(0), jnp.int32(0),
+            jnp.int64(BASE + 50))
+        assert not bool(res.any_bad)
+        assert list(np.asarray(res.win)) == [True, True]
+        assert int(res.new_canonical) == h_new.logical_time
+        # Winner lanes carry the remote hlc; modified = final canonical
+        # (crdt.dart:86-87) — same as the oracle's stored records.
+        rec0 = oracle.get_record("k0")
+        assert int(new_store.lt[0]) == rec0.hlc.logical_time
+        assert int(new_store.mod_lt[0]) == res.new_canonical
+
+    def test_local_wins_exact_tie(self):
+        h = Hlc(BASE, 0, "nbb")
+        store = scatter_put(
+            empty_store(8),
+            _changeset([3], [h.logical_time], [1], [False]),
+            jnp.asarray([h.logical_time]), jnp.asarray([1], jnp.int32))
+        cs = _changeset([3], [h.logical_time], [1], [True])
+        _, res = merge_step(store, cs, jnp.int64(h.logical_time),
+                            jnp.int32(0), jnp.int64(BASE))
+        assert list(np.asarray(res.win)) == [False]
+
+    def test_guards_flag_duplicate_and_drift(self):
+        lt_ahead = (BASE + 100) << 16
+        cs = _changeset([0], [lt_ahead], [0], [False])  # local ordinal
+        _, res = merge_step(empty_store(8), cs, jnp.int64(0),
+                            jnp.int32(0), jnp.int64(BASE))
+        assert bool(res.any_bad) and bool(res.first_is_dup)
+
+        lt_far = (BASE + 100_000) << 16
+        cs = _changeset([0], [lt_far], [2], [False])
+        _, res = merge_step(empty_store(8), cs, jnp.int64(0),
+                            jnp.int32(0), jnp.int64(BASE))
+        assert bool(res.any_bad) and not bool(res.first_is_dup)
+
+    def test_reductions(self):
+        h = Hlc(BASE + 5, 3, "nbb")
+        store = scatter_put(
+            empty_store(8),
+            _changeset([2], [h.logical_time], [1], [False]),
+            jnp.asarray([(BASE + 7) << 16]), jnp.asarray([1], jnp.int32))
+        assert int(max_logical_time(store)) == h.logical_time
+        mask = np.asarray(delta_mask(store, jnp.int64((BASE + 7) << 16)))
+        assert mask[2] and mask.sum() == 1  # inclusive bound

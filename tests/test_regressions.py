@@ -109,3 +109,17 @@ def test_dense_pallas_executor_rejects_unaligned_capacity_eagerly():
         DenseCrdt("abc", TILE + 1, executor="pallas")
     with pytest.raises(ValueError, match="executor"):
         DenseCrdt("abc", TILE, executor="warp")
+
+
+def test_empty_merge_json_clock_parity():
+    """merge_json('{}') must consume the same number of wall-clock
+    ticks on both backends (decode read + merge read + final send) so
+    differential parity survives the no-changes sync case."""
+    oracle = MapCrdt("abc", wall_clock=FakeClock())
+    tpu = TpuMapCrdt("abc", wall_clock=FakeClock())
+    for c in (oracle, tpu):
+        c.put("x", 1)
+        c.merge_json("{}")
+        c.put("y", 2)
+    assert oracle.canonical_time == tpu.canonical_time
+    assert oracle.to_json() == tpu.to_json()
