@@ -91,8 +91,30 @@ def decode(json_str: str, canonical_time: Hlc,
     """
     now = Hlc.now(canonical_time.node_id, millis=now_millis)
     modified = canonical_time if canonical_time >= now else now
-    raw = json.loads(json_str)
     codec = native.load()
+    if codec is not None and node_id_decoder is None:
+        scanned = codec.parse_wire(json_str)
+        if scanned is not None:
+            import numpy as np
+            keys, lt_buf, nodes, values, bad = scanned
+            lt = np.frombuffer(lt_buf, np.int64)
+            raw_hlc = Hlc._raw
+            from .hlc import MAX_COUNTER, SHIFT
+            out = {}
+            bad_set = set(bad)
+            for i, key in enumerate(keys):
+                if i in bad_set:
+                    h = Hlc.parse(nodes[i])
+                else:
+                    ltv = int(lt[i])
+                    h = raw_hlc(ltv >> SHIFT, ltv & MAX_COUNTER, nodes[i])
+                v = values[i]
+                if value_decoder is not None and v is not None:
+                    v = value_decoder(key, v)
+                out[key if key_decoder is None else key_decoder(key)] = \
+                    Record(h, v, modified)
+            return out
+    raw = json.loads(json_str)
     if codec is not None and node_id_decoder is None and raw:
         # Batch-parse the canonical-shape HLC strings natively; None
         # entries (non-canonical shapes) fall back to the full Python
@@ -138,18 +160,46 @@ def decode_columns(json_str: str,
     """
     import numpy as np
 
+    from .hlc import SHIFT
+    codec = native.load()
+    if codec is not None:
+        scanned = codec.parse_wire(json_str)
+        if scanned is not None:
+            keys, lt_buf, nodes, values, bad = scanned
+            # bytearray buffer -> writable int64 view, zero copies
+            lt = np.frombuffer(lt_buf, np.int64)
+            for i in bad:
+                h = Hlc.parse(nodes[i])
+                lt[i] = (h.millis << SHIFT) + h.counter
+                nodes[i] = h.node_id
+            if node_id_decoder is not None:
+                nodes = [node_id_decoder(n) for n in nodes]
+            if value_decoder is not None:
+                # decoder sees the RAW wire key, like the generic path
+                values = [None if v is None else value_decoder(k, v)
+                          for k, v in zip(keys, values)]
+            if key_decoder is not None:
+                keys = [key_decoder(k) for k in keys]
+            return keys, lt, nodes, values
     raw = json.loads(json_str)
     items = list(raw.items())
     m = len(items)
     hlc_strs = [v["hlc"] for _, v in items]
-    codec = native.load()
     millis_l = counter_l = node_l = None
     if codec is not None and m:
         millis_l, counter_l, node_l = codec.parse_hlc_batch(hlc_strs)
-    from .hlc import SHIFT
     if millis_l is not None and None not in millis_l:
-        lt = ((np.array(millis_l, np.int64) << SHIFT)
-              + np.array(counter_l, np.int64))
+        ms_arr = np.array(millis_l, np.int64)
+        if ms_arr.size and (int(ms_arr.max()) > 0x7FFF_FFFF_FFFF
+                            or int(ms_arr.min()) < -0x8000_0000_0000):
+            # (millis << 16) would wrap int64 — outside the lane
+            # packing's range (years beyond ~6429). The scalar oracle
+            # handles these; the columnar path refuses loudly. The C
+            # scanner defers such items here for the same treatment.
+            raise OverflowError(
+                "HLC millis outside the int64 lane range (|millis| "
+                ">= 2^47); use the scalar MapCrdt for such timestamps")
+        lt = (ms_arr << SHIFT) + np.array(counter_l, np.int64)
         nodes = node_l
     else:
         # Per-item fallback for non-canonical shapes (or no C codec).

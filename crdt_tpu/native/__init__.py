@@ -35,10 +35,17 @@ def load() -> Optional[object]:
     here = os.path.dirname(os.path.abspath(__file__))
     src = os.path.join(here, "hlccodec.c")
     suffix = sysconfig.get_config_var("EXT_SUFFIX") or ".so"
-    so = os.path.join(here, "_hlccodec" + suffix)
     try:
-        if (not os.path.exists(so)
-                or os.path.getmtime(so) < os.path.getmtime(src)):
+        # The cache key is the SOURCE CONTENT, not mtimes: archive
+        # extraction (sdist/wheel upgrades) preserves timestamps, so a
+        # stale .so compiled from an older source could otherwise load
+        # and miss newer symbols (AttributeError instead of the
+        # documented silent degradation).
+        import hashlib
+        with open(src, "rb") as f:
+            tag = hashlib.sha256(f.read()).hexdigest()[:12]
+        so = os.path.join(here, f"_hlccodec_{tag}{suffix}")
+        if not os.path.exists(so):
             cc = (os.environ.get("CC") or sysconfig.get_config_var("CC")
                   or "cc").split()[0]
             include = sysconfig.get_paths()["include"]

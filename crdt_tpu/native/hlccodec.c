@@ -207,11 +207,598 @@ static PyObject *format_hlc_batch(PyObject *self, PyObject *args) {
     return out;
 }
 
+/* ================== wire JSON scanner ==================
+ *
+ * parse_wire(json_str) scans the full wire payload
+ * `{"key":{"hlc":"...","value":V},...}` (crdt_json.dart:8-17) in one
+ * pass, returning the columnar shape the vectorized backends consume
+ * without materializing the intermediate dict-of-dicts `json.loads`
+ * builds:
+ *
+ *   (keys: list[str],
+ *    lt:   bytearray of native int64 — packed (millis<<16)|counter,
+ *    nodes: list[str]   (for fallback items: the raw hlc string),
+ *    values: list,
+ *    bad:  list[int]    (indices whose hlc was not canonical-shaped —
+ *                        the caller re-parses those via Hlc.parse))
+ *
+ * or None when the payload deviates from the expected structure in any
+ * way this scanner does not model exactly (then the caller runs the
+ * plain `json.loads` path, which either handles it or raises the
+ * error the user would have seen anyway). Exactness rules:
+ *  - duplicate keys keep the FIRST position with the LAST value, like
+ *    a Python dict build;
+ *  - inner members may come in any order; unknown members are parsed
+ *    (validated) and discarded; a missing "value" member decodes as
+ *    None (`v.get("value")`);
+ *  - number grammar is validated strictly (leading zeros etc. fall
+ *    back so json.loads raises); NaN/Infinity literals are accepted
+ *    exactly as Python's json does;
+ *  - strings with escapes are unescaped per RFC 8259; lone surrogates
+ *    (which json.loads tolerates) trigger whole-payload fallback;
+ *  - nested objects/arrays are span-matched and delegated to
+ *    json.loads on the substring.
+ */
+
+typedef struct {
+    const char *s;
+    Py_ssize_t len, pos;
+    int fallback;  /* set when the payload needs the Python path */
+} Scan;
+
+static PyObject *g_json_loads = NULL;
+
+static int ensure_json_loads(void) {
+    if (g_json_loads) return 1;
+    PyObject *m = PyImport_ImportModule("json");
+    if (!m) return 0;
+    g_json_loads = PyObject_GetAttrString(m, "loads");
+    Py_DECREF(m);
+    return g_json_loads != NULL;
+}
+
+static void skip_ws(Scan *sc) {
+    const char *s = sc->s;
+    Py_ssize_t p = sc->pos, n = sc->len;
+    while (p < n) {
+        char c = s[p];
+        if (c != ' ' && c != '\t' && c != '\n' && c != '\r') break;
+        p++;
+    }
+    sc->pos = p;
+}
+
+/* Content span of the JSON string starting at sc->pos (which must be
+ * '"'); advances past the closing quote. Returns 0 (with sc->fallback
+ * set) on malformed input. */
+static int string_span(Scan *sc, Py_ssize_t *start, Py_ssize_t *end,
+                       int *has_escape) {
+    const char *s = sc->s;
+    Py_ssize_t p = sc->pos, n = sc->len;
+    if (p >= n || s[p] != '"') { sc->fallback = 1; return 0; }
+    p++;
+    *start = p;
+    *has_escape = 0;
+    while (p < n) {
+        unsigned char c = (unsigned char)s[p];
+        if (c == '"') {
+            *end = p;
+            sc->pos = p + 1;
+            return 1;
+        }
+        if (c == '\\') {
+            *has_escape = 1;
+            p += 2;
+            continue;
+        }
+        if (c < 0x20) { sc->fallback = 1; return 0; }  /* json raises */
+        p++;
+    }
+    sc->fallback = 1;
+    return 0;
+}
+
+static int hexval(char c) {
+    if (c >= '0' && c <= '9') return c - '0';
+    if (c >= 'a' && c <= 'f') return c - 'a' + 10;
+    if (c >= 'A' && c <= 'F') return c - 'A' + 10;
+    return -1;
+}
+
+/* RFC 8259 unescape of a string span into a new str object. NULL with
+ * fallback=1 for anything json.loads models differently (lone
+ * surrogates), NULL with an exception set on allocation failure. */
+static PyObject *unescape_span(const char *p, Py_ssize_t n,
+                               int *fallback) {
+    char *buf = (char *)PyMem_Malloc(n > 0 ? (size_t)n : 1);
+    if (!buf) { PyErr_NoMemory(); return NULL; }
+    Py_ssize_t o = 0, i = 0;
+    while (i < n) {
+        char c = p[i];
+        if (c != '\\') { buf[o++] = c; i++; continue; }
+        if (i + 1 >= n) goto bad;
+        char e = p[i + 1];
+        i += 2;
+        switch (e) {
+        case '"': buf[o++] = '"'; break;
+        case '\\': buf[o++] = '\\'; break;
+        case '/': buf[o++] = '/'; break;
+        case 'b': buf[o++] = '\b'; break;
+        case 'f': buf[o++] = '\f'; break;
+        case 'n': buf[o++] = '\n'; break;
+        case 'r': buf[o++] = '\r'; break;
+        case 't': buf[o++] = '\t'; break;
+        case 'u': {
+            if (i + 4 > n) goto bad;
+            int h0 = hexval(p[i]), h1 = hexval(p[i + 1]);
+            int h2 = hexval(p[i + 2]), h3 = hexval(p[i + 3]);
+            if ((h0 | h1 | h2 | h3) < 0) goto bad;
+            unsigned int cp =
+                (unsigned)(h0 << 12 | h1 << 8 | h2 << 4 | h3);
+            i += 4;
+            if (cp >= 0xD800 && cp <= 0xDBFF) {
+                /* high surrogate: need a \uDC00-\uDFFF mate */
+                if (i + 6 <= n && p[i] == '\\' && p[i + 1] == 'u') {
+                    int g0 = hexval(p[i + 2]), g1 = hexval(p[i + 3]);
+                    int g2 = hexval(p[i + 4]), g3 = hexval(p[i + 5]);
+                    unsigned int lo = (g0 | g1 | g2 | g3) < 0 ? 0 :
+                        (unsigned)(g0 << 12 | g1 << 8 | g2 << 4 | g3);
+                    if (lo >= 0xDC00 && lo <= 0xDFFF) {
+                        cp = 0x10000 + ((cp - 0xD800) << 10)
+                             + (lo - 0xDC00);
+                        i += 6;
+                    } else goto bad;  /* lone surrogate: json tolerates */
+                } else goto bad;
+            } else if (cp >= 0xDC00 && cp <= 0xDFFF) {
+                goto bad;  /* unpaired low surrogate */
+            }
+            /* UTF-8 encode; output never exceeds input span length */
+            if (cp < 0x80) buf[o++] = (char)cp;
+            else if (cp < 0x800) {
+                buf[o++] = (char)(0xC0 | (cp >> 6));
+                buf[o++] = (char)(0x80 | (cp & 0x3F));
+            } else if (cp < 0x10000) {
+                buf[o++] = (char)(0xE0 | (cp >> 12));
+                buf[o++] = (char)(0x80 | ((cp >> 6) & 0x3F));
+                buf[o++] = (char)(0x80 | (cp & 0x3F));
+            } else {
+                buf[o++] = (char)(0xF0 | (cp >> 18));
+                buf[o++] = (char)(0x80 | ((cp >> 12) & 0x3F));
+                buf[o++] = (char)(0x80 | ((cp >> 6) & 0x3F));
+                buf[o++] = (char)(0x80 | (cp & 0x3F));
+            }
+            break;
+        }
+        default: goto bad;  /* invalid escape: json raises */
+        }
+    }
+    {
+        PyObject *out = PyUnicode_DecodeUTF8(buf, o, NULL);
+        PyMem_Free(buf);
+        return out;
+    }
+bad:
+    PyMem_Free(buf);
+    *fallback = 1;
+    return NULL;
+}
+
+/* Skip a complete JSON value span (used for bracket matching of nested
+ * containers). String-aware; does NOT validate leaf grammar. */
+static int value_span(Scan *sc, Py_ssize_t *start, Py_ssize_t *end) {
+    const char *s = sc->s;
+    Py_ssize_t p = sc->pos, n = sc->len;
+    *start = p;
+    int depth = 0;
+    while (p < n) {
+        char c = s[p];
+        if (c == '"') {
+            p++;
+            while (p < n) {
+                if (s[p] == '\\') { p += 2; continue; }
+                if (s[p] == '"') break;
+                p++;
+            }
+            if (p >= n) { sc->fallback = 1; return 0; }
+            p++;
+        } else if (c == '{' || c == '[') {
+            depth++; p++;
+        } else if (c == '}' || c == ']') {
+            depth--; p++;
+            if (depth == 0) { *end = p; sc->pos = p; return 1; }
+            if (depth < 0) { sc->fallback = 1; return 0; }
+        } else {
+            p++;
+        }
+        if (depth == 0 && *start != p) {
+            /* scalar value: ends at , } ] or ws */
+            while (p < n) {
+                char d = s[p];
+                if (d == ',' || d == '}' || d == ']' || d == ' ' ||
+                    d == '\t' || d == '\n' || d == '\r') break;
+                p++;
+            }
+            *end = p; sc->pos = p; return 1;
+        }
+    }
+    sc->fallback = 1;
+    return 0;
+}
+
+/* Strict JSON number at sc->pos -> int or float object, matching
+ * json.loads leaf semantics. NULL + fallback on grammar violations. */
+static PyObject *parse_number(Scan *sc) {
+    const char *s = sc->s;
+    Py_ssize_t p = sc->pos, n = sc->len, b = p;
+    int isfloat = 0;
+    if (p < n && s[p] == '-') p++;
+    if (p >= n) { sc->fallback = 1; return NULL; }
+    if (s[p] == '0') p++;
+    else if (s[p] >= '1' && s[p] <= '9') {
+        while (p < n && s[p] >= '0' && s[p] <= '9') p++;
+    } else { sc->fallback = 1; return NULL; }
+    if (p < n && s[p] == '.') {
+        isfloat = 1; p++;
+        if (p >= n || s[p] < '0' || s[p] > '9') {
+            sc->fallback = 1; return NULL;
+        }
+        while (p < n && s[p] >= '0' && s[p] <= '9') p++;
+    }
+    if (p < n && (s[p] == 'e' || s[p] == 'E')) {
+        isfloat = 1; p++;
+        if (p < n && (s[p] == '+' || s[p] == '-')) p++;
+        if (p >= n || s[p] < '0' || s[p] > '9') {
+            sc->fallback = 1; return NULL;
+        }
+        while (p < n && s[p] >= '0' && s[p] <= '9') p++;
+    }
+    sc->pos = p;
+    if (isfloat) {
+        PyObject *sub = PyUnicode_FromStringAndSize(s + b, p - b);
+        if (!sub) return NULL;
+        PyObject *f = PyFloat_FromString(sub);
+        Py_DECREF(sub);
+        return f;
+    }
+    if (p - b < 63) {
+        char buf[64];
+        memcpy(buf, s + b, p - b);
+        buf[p - b] = 0;
+        return PyLong_FromString(buf, NULL, 10);
+    }
+    {
+        char *hbuf = (char *)PyMem_Malloc((size_t)(p - b) + 1);
+        if (!hbuf) { PyErr_NoMemory(); return NULL; }
+        memcpy(hbuf, s + b, p - b);
+        hbuf[p - b] = 0;
+        PyObject *v = PyLong_FromString(hbuf, NULL, 10);
+        PyMem_Free(hbuf);
+        return v;
+    }
+}
+
+static int lit(Scan *sc, const char *word, Py_ssize_t wl) {
+    if (sc->pos + wl <= sc->len &&
+        memcmp(sc->s + sc->pos, word, wl) == 0) {
+        sc->pos += wl;
+        return 1;
+    }
+    return 0;
+}
+
+/* Generic JSON value -> Python object (json.loads leaf semantics).
+ * NULL + sc->fallback for anything deferred; NULL + exception on real
+ * errors. */
+static PyObject *parse_json_value(Scan *sc) {
+    const char *s = sc->s;
+    Py_ssize_t n = sc->len;
+    if (sc->pos >= n) { sc->fallback = 1; return NULL; }
+    char c = s[sc->pos];
+    if (c == '"') {
+        Py_ssize_t b, e; int esc;
+        if (!string_span(sc, &b, &e, &esc)) return NULL;
+        if (!esc) return PyUnicode_FromStringAndSize(s + b, e - b);
+        return unescape_span(s + b, e - b, &sc->fallback);
+    }
+    if (c == '{' || c == '[') {
+        Py_ssize_t b, e;
+        if (!value_span(sc, &b, &e)) return NULL;
+        if (!ensure_json_loads()) return NULL;
+        return PyObject_CallFunction(g_json_loads, "s#", s + b,
+                                     (Py_ssize_t)(e - b));
+    }
+    if (c == '-' || (c >= '0' && c <= '9')) {
+        if (c == '-' && sc->pos + 1 < n && s[sc->pos + 1] == 'I') {
+            if (lit(sc, "-Infinity", 9))
+                return PyFloat_FromDouble(-Py_HUGE_VAL);
+            sc->fallback = 1; return NULL;
+        }
+        return parse_number(sc);
+    }
+    if (c == 't') {
+        if (lit(sc, "true", 4)) Py_RETURN_TRUE;
+    } else if (c == 'f') {
+        if (lit(sc, "false", 5)) Py_RETURN_FALSE;
+    } else if (c == 'n') {
+        if (lit(sc, "null", 4)) Py_RETURN_NONE;
+    } else if (c == 'N') {
+        if (lit(sc, "NaN", 3)) return PyFloat_FromDouble(Py_NAN);
+    } else if (c == 'I') {
+        if (lit(sc, "Infinity", 8))
+            return PyFloat_FromDouble(Py_HUGE_VAL);
+    }
+    sc->fallback = 1;
+    return NULL;
+}
+
+/* Tiny node-string dedup cache: changesets carry few distinct node
+ * ids, and returning the SAME str object makes every downstream hash
+ * (intern set, ordinal dict) hit its cached-hash fast path. */
+#define NCACHE 64
+typedef struct {
+    const char *p;
+    Py_ssize_t n;
+    PyObject *obj;
+} NodeEnt;
+
+static PyObject *cached_node(NodeEnt *cache, const char *p,
+                             Py_ssize_t n) {
+    unsigned long long h = 1469598103934665603ULL;
+    for (Py_ssize_t i = 0; i < n; i++)
+        h = (h ^ (unsigned char)p[i]) * 1099511628211ULL;
+    NodeEnt *e = NULL;
+    for (int j = 0; j < 4; j++) {   /* 4-probe: no thrash on collisions */
+        NodeEnt *c = &cache[(h + (unsigned)j) & (NCACHE - 1)];
+        if (!c->obj) { if (!e) e = c; continue; }
+        if (c->n == n && memcmp(c->p, p, (size_t)n) == 0) {
+            Py_INCREF(c->obj);
+            return c->obj;
+        }
+    }
+    if (!e) e = &cache[h & (NCACHE - 1)];
+    PyObject *s = PyUnicode_FromStringAndSize(p, n);
+    if (!s) return NULL;
+    Py_XDECREF(e->obj);
+    e->p = p; e->n = n; e->obj = s;
+    Py_INCREF(s);
+    return s;
+}
+
+static PyObject *parse_wire(PyObject *self, PyObject *arg) {
+    Py_ssize_t len;
+    const char *s = PyUnicode_AsUTF8AndSize(arg, &len);
+    if (!s) {
+        /* e.g. raw lone surrogates in the payload str: not UTF-8
+         * encodable. json.loads handles those — defer, like
+         * parse_hlc_batch does. */
+        PyErr_Clear();
+        Py_RETURN_NONE;
+    }
+
+    Scan sc = {s, len, 0, 0};
+    PyObject *keys = NULL, *nodes = NULL, *values = NULL;
+    PyObject *pos_map = NULL, *result = NULL;
+    long long *lt = NULL;
+    unsigned char *badf = NULL;
+    Py_ssize_t cap = 0, count = 0;
+    NodeEnt cache[NCACHE];
+    memset(cache, 0, sizeof cache);
+
+    keys = PyList_New(0);
+    nodes = PyList_New(0);
+    values = PyList_New(0);
+    pos_map = PyDict_New();
+    if (!keys || !nodes || !values || !pos_map) goto done;
+
+    skip_ws(&sc);
+    if (sc.pos >= len || s[sc.pos] != '{') { sc.fallback = 1; goto done; }
+    sc.pos++;
+    skip_ws(&sc);
+    if (sc.pos < len && s[sc.pos] == '}') {
+        sc.pos++;
+        goto finish;
+    }
+
+    for (;;) {
+        /* ---- top-level key ---- */
+        skip_ws(&sc);
+        Py_ssize_t kb, ke; int kesc;
+        if (!string_span(&sc, &kb, &ke, &kesc)) goto done;
+        PyObject *key = kesc
+            ? unescape_span(s + kb, ke - kb, &sc.fallback)
+            : PyUnicode_FromStringAndSize(s + kb, ke - kb);
+        if (!key) goto done;
+        skip_ws(&sc);
+        if (sc.pos >= len || s[sc.pos] != ':') {
+            Py_DECREF(key); sc.fallback = 1; goto done;
+        }
+        sc.pos++;
+        skip_ws(&sc);
+
+        /* ---- inner record object ---- */
+        if (sc.pos >= len || s[sc.pos] != '{') {
+            Py_DECREF(key); sc.fallback = 1; goto done;
+        }
+        sc.pos++;
+        long long item_lt = 0;
+        PyObject *node_obj = NULL;   /* node id, or raw hlc when bad */
+        PyObject *value_obj = NULL;
+        int bad = 0, have_hlc = 0;
+        skip_ws(&sc);
+        if (sc.pos < len && s[sc.pos] == '}') sc.pos++;
+        else for (;;) {
+            skip_ws(&sc);
+            Py_ssize_t mb, me; int mesc;
+            if (!string_span(&sc, &mb, &me, &mesc)) goto item_fail;
+            if (mesc) { sc.fallback = 1; goto item_fail; }
+            skip_ws(&sc);
+            if (sc.pos >= len || s[sc.pos] != ':') {
+                sc.fallback = 1; goto item_fail;
+            }
+            sc.pos++;
+            skip_ws(&sc);
+            if (me - mb == 3 && memcmp(s + mb, "hlc", 3) == 0) {
+                Py_ssize_t hb, he; int hesc;
+                if (sc.pos >= len || s[sc.pos] != '"') {
+                    sc.fallback = 1; goto item_fail;
+                }
+                if (!string_span(&sc, &hb, &he, &hesc)) goto item_fail;
+                Py_XDECREF(node_obj);
+                node_obj = NULL;
+                have_hlc = 1;
+                long long ms, counter;
+                if (!hesc && he - hb >= 31 && s[hb + 24] == '-' &&
+                    s[hb + 29] == '-' &&
+                    parse_canonical_iso(s + hb, &ms) &&
+                    /* (ms<<16) must fit int64: the lane packing's
+                     * range. Beyond it (years > ~6429) defer to the
+                     * Python path, which raises OverflowError on the
+                     * int64 lane instead of silently wrapping. */
+                    ms <= 0x7FFFFFFFFFFFLL && ms >= -0x800000000000LL &&
+                    hex4(s + hb + 25, &counter)) {
+                    bad = 0;
+                    item_lt = (ms << 16) | counter;
+                    node_obj = cached_node(cache, s + hb + 30,
+                                           he - hb - 30);
+                } else {
+                    bad = 1;
+                    item_lt = 0;
+                    node_obj = hesc
+                        ? unescape_span(s + hb, he - hb, &sc.fallback)
+                        : PyUnicode_FromStringAndSize(s + hb, he - hb);
+                }
+                if (!node_obj) goto item_fail;
+            } else if (me - mb == 5 &&
+                       memcmp(s + mb, "value", 5) == 0) {
+                PyObject *v = parse_json_value(&sc);
+                if (!v) goto item_fail;
+                Py_XDECREF(value_obj);
+                value_obj = v;
+            } else {
+                PyObject *v = parse_json_value(&sc);
+                if (!v) goto item_fail;
+                Py_DECREF(v);
+            }
+            skip_ws(&sc);
+            if (sc.pos < len && s[sc.pos] == ',') { sc.pos++; continue; }
+            if (sc.pos < len && s[sc.pos] == '}') { sc.pos++; break; }
+            sc.fallback = 1;
+            goto item_fail;
+        }
+        if (!have_hlc) { sc.fallback = 1; goto item_fail; }
+        if (!value_obj) { value_obj = Py_None; Py_INCREF(Py_None); }
+
+        /* ---- store (duplicate keys: first position, last value) ---- */
+        {
+            /* SetDefault = one hash probe for both lookup and insert */
+            PyObject *idx = PyLong_FromSsize_t(count);
+            if (!idx) goto item_fail;
+            PyObject *prev = PyDict_SetDefault(pos_map, key, idx);
+            if (!prev) { Py_DECREF(idx); goto item_fail; }
+            if (prev != idx) {
+                Py_ssize_t i = PyLong_AsSsize_t(prev);
+                Py_DECREF(idx);
+                lt[i] = item_lt;
+                badf[i] = (unsigned char)bad;
+                if (PyList_SetItem(nodes, i, node_obj) < 0 ||
+                    PyList_SetItem(values, i, value_obj) < 0) {
+                    /* refs stolen even on failure path bookkeeping */
+                    Py_DECREF(key);
+                    goto done;
+                }
+                Py_DECREF(key);
+            } else {
+                Py_DECREF(idx);
+                if (count == cap) {
+                    Py_ssize_t ncap = cap ? cap * 2 : 1024;
+                    long long *nlt = (long long *)PyMem_Realloc(
+                        lt, (size_t)ncap * sizeof(long long));
+                    unsigned char *nb = NULL;
+                    if (nlt) {
+                        lt = nlt;
+                        nb = (unsigned char *)PyMem_Realloc(
+                            badf, (size_t)ncap);
+                    }
+                    if (!nlt || !nb) {
+                        Py_DECREF(key); Py_DECREF(node_obj);
+                        Py_DECREF(value_obj);
+                        PyErr_NoMemory();
+                        goto done;
+                    }
+                    badf = nb;
+                    cap = ncap;
+                }
+                lt[count] = item_lt;
+                badf[count] = (unsigned char)bad;
+                int ok =
+                    PyList_Append(keys, key) == 0 &&
+                    PyList_Append(nodes, node_obj) == 0 &&
+                    PyList_Append(values, value_obj) == 0;
+                Py_DECREF(key);
+                Py_DECREF(node_obj);
+                Py_DECREF(value_obj);
+                if (!ok) goto done;
+                count++;
+            }
+        }
+        skip_ws(&sc);
+        if (sc.pos < len && s[sc.pos] == ',') { sc.pos++; continue; }
+        if (sc.pos < len && s[sc.pos] == '}') { sc.pos++; break; }
+        sc.fallback = 1;
+        goto done;
+
+    item_fail:
+        Py_DECREF(key);
+        Py_XDECREF(node_obj);
+        Py_XDECREF(value_obj);
+        goto done;
+    }
+
+finish:
+    skip_ws(&sc);
+    if (sc.pos != len) { sc.fallback = 1; goto done; }
+    {
+        PyObject *lt_buf = PyByteArray_FromStringAndSize(
+            (const char *)lt, count * (Py_ssize_t)sizeof(long long));
+        PyObject *badl = PyList_New(0);
+        if (!lt_buf || !badl) {
+            Py_XDECREF(lt_buf); Py_XDECREF(badl);
+            goto done;
+        }
+        for (Py_ssize_t i = 0; i < count; i++) {
+            if (badf[i]) {
+                PyObject *ix = PyLong_FromSsize_t(i);
+                if (!ix || PyList_Append(badl, ix) < 0) {
+                    Py_XDECREF(ix); Py_DECREF(lt_buf);
+                    Py_DECREF(badl);
+                    goto done;
+                }
+                Py_DECREF(ix);
+            }
+        }
+        result = PyTuple_Pack(5, keys, lt_buf, nodes, values, badl);
+        Py_DECREF(lt_buf);
+        Py_DECREF(badl);
+    }
+
+done:
+    for (int i = 0; i < NCACHE; i++) Py_XDECREF(cache[i].obj);
+    PyMem_Free(lt);
+    PyMem_Free(badf);
+    Py_XDECREF(keys); Py_XDECREF(nodes); Py_XDECREF(values);
+    Py_XDECREF(pos_map);
+    if (result) return result;
+    if (sc.fallback && !PyErr_Occurred()) Py_RETURN_NONE;
+    return NULL;
+}
+
 static PyMethodDef methods[] = {
     {"parse_hlc_batch", parse_hlc_batch, METH_O,
      "Batch-parse canonical HLC wire strings."},
     {"format_hlc_batch", format_hlc_batch, METH_VARARGS,
      "Batch-format HLC components to wire strings."},
+    {"parse_wire", parse_wire, METH_O,
+     "One-pass columnar scan of a wire JSON payload."},
     {NULL, NULL, 0, NULL}};
 
 static struct PyModuleDef module = {

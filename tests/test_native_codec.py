@@ -97,6 +97,170 @@ def test_out_of_range_year_fails_fast():
         _iso8601(-63_000_000_000_000)  # before year 1
 
 
+class TestWireScanner:
+    """`parse_wire` one-pass columnar scan: exactness vs `json.loads`.
+
+    The scanner must either produce EXACTLY what the json.loads-based
+    column build produces, or return None (whole-payload fallback) so
+    the Python path decides — including deciding to raise.
+    """
+
+    def _differential(self, monkeypatch, payload, **kw):
+        import numpy as np
+        fast = crdt_json.decode_columns(payload, **kw)
+        monkeypatch.setattr(crdt_json.native, "load", lambda: None)
+        slow = crdt_json.decode_columns(payload, **kw)
+        monkeypatch.undo()
+        def veq(a, b):   # NaN-tolerant value equality
+            if isinstance(a, float) and isinstance(b, float):
+                return a == b or (a != a and b != b)
+            return a == b
+
+        assert fast[0] == slow[0]                    # keys
+        assert np.array_equal(fast[1], slow[1])      # lt lanes
+        assert list(fast[2]) == list(slow[2])        # node ids
+        assert len(fast[3]) == len(slow[3])          # values
+        assert all(veq(a, b) for a, b in zip(fast[3], slow[3]))
+        return fast
+
+    def test_value_shapes(self, codec, monkeypatch):
+        h = "2026-01-01T00:00:01.123Z-004D-nodeid"
+        payload = ('{"int":{"hlc":"%s","value":42},'
+                   '"neg":{"hlc":"%s","value":-7},'
+                   '"float":{"hlc":"%s","value":3.14e2},'
+                   '"str":{"hlc":"%s","value":"plain"},'
+                   '"esc":{"hlc":"%s","value":"a\\"b\\\\c\\n\\u00e9"},'
+                   '"emoji":{"hlc":"%s","value":"\\ud83d\\ude00"},'
+                   '"true":{"hlc":"%s","value":true},'
+                   '"false":{"hlc":"%s","value":false},'
+                   '"null":{"hlc":"%s","value":null},'
+                   '"miss":{"hlc":"%s"},'
+                   '"obj":{"hlc":"%s","value":{"a":[1,{"b":null}]}},'
+                   '"arr":{"hlc":"%s","value":[1,"two",3.0]}}'
+                   % ((h,) * 12))
+        keys, lt, nodes, values = self._differential(monkeypatch, payload)
+        assert values[0] == 42 and values[3] == "plain"
+        assert values[4] == 'a"b\\c\né'
+        assert values[5] == "\U0001F600"
+        assert values[9] is None
+        assert values[10] == {"a": [1, {"b": None}]}
+
+    def test_member_order_extras_and_duplicates(self, codec,
+                                                monkeypatch):
+        h1 = "2026-01-01T00:00:01.123Z-004D-na"
+        h2 = "2026-01-01T00:00:02.000Z-0000-nb"
+        payload = ('{"swap":{"value":1,"hlc":"%s"},'
+                   '"extra":{"hlc":"%s","value":2,"x":[1,2],"y":"z"},'
+                   '"dup":{"hlc":"%s","value":3},'
+                   '"dup":{"hlc":"%s","value":4}}' % (h1, h1, h1, h2))
+        keys, lt, nodes, values = self._differential(monkeypatch, payload)
+        # duplicate key: first position, LAST value — dict semantics
+        assert keys == ["swap", "extra", "dup"]
+        assert values == [1, 2, 4]
+        assert nodes[2] == "nb"
+
+    def test_escaped_keys_and_nodes(self, codec, monkeypatch):
+        h_esc = "2026-01-01T00:00:01.123Z-004D-n\\u00e9\\\\x"
+        payload = ('{"k\\u00e9y\\t1":{"hlc":"%s","value":1}}' % h_esc)
+        keys, lt, nodes, values = self._differential(monkeypatch, payload)
+        assert keys == ["kéy\t1"]
+        assert nodes[0] == "né\\x"   # escaped hlc -> Hlc.parse path
+
+    def test_non_canonical_hlc_per_item(self, codec, monkeypatch):
+        # Space separator parses via the Python Hlc.parse fallback.
+        payload = ('{"a":{"hlc":"2026-01-01 00:00:01.123Z-004D-n",'
+                   '"value":1}}')
+        keys, lt, nodes, values = self._differential(monkeypatch, payload)
+        assert nodes == ["n"]
+
+    def test_whitespace_and_nan_infinity(self, codec, monkeypatch):
+        h = "2026-01-01T00:00:01.123Z-004D-n"
+        payload = (' {\n "a" :\t{ "hlc" : "%s" , "value" : Infinity },'
+                   '"b":{"hlc":"%s","value":-Infinity},'
+                   '"c":{"hlc":"%s","value":NaN} } ' % (h, h, h))
+        keys, lt, nodes, values = self._differential(monkeypatch, payload)
+        assert values[0] == float("inf") and values[1] == float("-inf")
+        assert values[2] != values[2]  # NaN
+
+    def test_malformed_payloads_raise_identically(self, codec,
+                                                  monkeypatch):
+        h = "2026-01-01T00:00:01.123Z-004D-n"
+        bad = ['{"a":{"hlc":"%s","value":01}}' % h,    # leading zero
+               '{"a":{"hlc":"%s","value":1.}}' % h,    # bare frac
+               '{"a":{"hlc":"%s","value":+1}}' % h,    # plus sign
+               '{"a":{"hlc":"%s","value":1}} x' % h,   # trailing junk
+               '{"a":{"hlc":"%s","value":1}',          # truncated
+               '{"a":{"hlc":"%s","value":tru}}' % h,   # bad literal
+               '[1,2]', '42', '']                      # not an object
+        for payload in bad:
+            with pytest.raises(Exception) as fast_err:
+                crdt_json.decode_columns(payload)
+            monkeypatch.setattr(crdt_json.native, "load", lambda: None)
+            with pytest.raises(Exception) as slow_err:
+                crdt_json.decode_columns(payload)
+            monkeypatch.undo()
+            assert type(fast_err.value) is type(slow_err.value), payload
+
+    def test_missing_hlc_member_raises_identically(self, codec,
+                                                   monkeypatch):
+        payload = '{"a":{"value":1}}'
+        with pytest.raises(KeyError):
+            crdt_json.decode_columns(payload)
+        monkeypatch.setattr(crdt_json.native, "load", lambda: None)
+        with pytest.raises(KeyError):
+            crdt_json.decode_columns(payload)
+
+    def test_lone_surrogate_falls_back(self, codec, monkeypatch):
+        # json.loads tolerates lone surrogates; the scanner defers.
+        h = "2026-01-01T00:00:01.123Z-004D-n"
+        payload = '{"a":{"hlc":"%s","value":"\\ud800"}}' % h
+        assert codec.parse_wire(payload) is None
+        keys, lt, nodes, values = self._differential(monkeypatch, payload)
+        assert values == ["\ud800"]
+
+    def test_year_zero_hlc_parses_identically(self, codec, monkeypatch):
+        # The wire FORMATTER refuses years < 1 but the parser accepts
+        # them (proleptic civil-date math, no datetime) — both paths
+        # must produce the same pre-epoch lt lane.
+        payload = ('{"a":{"hlc":"0000-01-01T00:00:01.123Z-004D-n",'
+                   '"value":1}}')
+        keys, lt, nodes, values = self._differential(monkeypatch, payload)
+        assert int(lt[0]) < 0 and nodes == ["n"]
+
+    def test_decoders_applied_like_generic_path(self, codec,
+                                                monkeypatch):
+        h = "2026-01-01T00:00:01.123Z-004D-n"
+        payload = ('{"1":{"hlc":"%s","value":10},'
+                   '"2":{"hlc":"%s","value":null}}' % (h, h))
+        kw = dict(key_decoder=int,
+                  value_decoder=lambda k, v: (k, v * 2))
+        keys, lt, nodes, values = self._differential(monkeypatch,
+                                                     payload, **kw)
+        assert keys == [1, 2]
+        # decoder sees the RAW wire key; None skips the decoder
+        assert values == [("1", 20), None]
+
+    def test_decode_fast_path_matches_generic(self, codec, monkeypatch):
+        src = MapCrdt("remote", wall_clock=FakeClock())
+        src.put_all({f"k{i}": i for i in range(50)})
+        src.delete("k7")
+        payload = src.to_json()
+        canonical = Hlc(1, 0, "local")
+        fast = crdt_json.decode(payload, canonical, now_millis=5)
+        monkeypatch.setattr(crdt_json.native, "load", lambda: None)
+        slow = crdt_json.decode(payload, canonical, now_millis=5)
+        monkeypatch.undo()
+        assert fast == slow
+
+    def test_node_string_dedup(self, codec):
+        h = "2026-01-01T00:00:01.123Z-004D-samenode"
+        payload = "{%s}" % ",".join(
+            '"k%d":{"hlc":"%s","value":%d}' % (i, h, i)
+            for i in range(100))
+        keys, lt_buf, nodes, values, bad = codec.parse_wire(payload)
+        assert len({id(n) for n in nodes}) == 1
+
+
 def test_wire_roundtrip_native_vs_python(monkeypatch):
     src = MapCrdt("remote", wall_clock=FakeClock())
     src.put_all({f"k{i}": {"v": i, "s": "x" * (i % 23)}
@@ -115,3 +279,43 @@ def test_wire_roundtrip_native_vs_python(monkeypatch):
     dst_nat.merge_json(native_json)
     assert dst_py.record_map() == dst_nat.record_map()
     assert dst_py.to_json() == dst_nat.to_json()
+
+
+def test_raw_lone_surrogate_payload_falls_back(codec, monkeypatch):
+    """A payload str holding a RAW unpaired surrogate (not the \\ud800
+    escape — e.g. os.fsdecode data round-tripped through the codec's
+    own ensure_ascii=False encoder) is not UTF-8 encodable, so the C
+    scanner must defer the whole payload instead of raising
+    UnicodeEncodeError; json.loads tolerates it."""
+    h = "2026-01-01T00:00:01.123Z-004D-n"
+    payload = '{"a":{"hlc":"%s","value":"x\ud800y"}}' % h
+    assert codec.parse_wire(payload) is None
+    keys, lt, nodes, values = crdt_json.decode_columns(payload)
+    assert values == ["x\ud800y"]
+    monkeypatch.setattr(crdt_json.native, "load", lambda: None)
+    slow = crdt_json.decode_columns(payload)
+    monkeypatch.undo()
+    assert slow[3] == values
+
+
+def test_stale_so_cannot_load():
+    """The build cache is keyed by SOURCE CONTENT (hash in the .so
+    filename), so a .so compiled from an older hlccodec.c — e.g. after
+    an sdist upgrade where archive mtimes defeat an mtime check — can
+    never be picked up and miss newer symbols."""
+    import hashlib
+    import os
+    import sysconfig
+
+    import crdt_tpu.native as native_pkg
+    here = os.path.dirname(os.path.abspath(native_pkg.__file__))
+    src = os.path.join(here, "hlccodec.c")
+    with open(src, "rb") as f:
+        tag = hashlib.sha256(f.read()).hexdigest()[:12]
+    suffix = sysconfig.get_config_var("EXT_SUFFIX") or ".so"
+    mod = load()
+    assert mod is not None
+    assert mod.__spec__.origin.endswith(f"_hlccodec_{tag}{suffix}")
+    # every symbol the Python side calls exists on the loaded module
+    for sym in ("parse_hlc_batch", "format_hlc_batch", "parse_wire"):
+        assert hasattr(mod, sym)
