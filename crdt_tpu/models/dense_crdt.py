@@ -37,9 +37,10 @@ from .. import crdt_json
 from ..hlc import (ClockDriftException, DuplicateNodeException, Hlc,
                    wall_clock_millis)
 from ..ops.dense import (DenseChangeset, DenseStore, FaninResult, _NEG,
-                         dense_delta_mask, dense_max_logical_time,
+                         delete_scatter, dense_delta_mask,
+                         dense_max_logical_time,
                          empty_dense_store, fanin_step, fanin_stream,
-                         pad_replica_rows, sparse_fanin_step,
+                         pad_replica_rows, put_scatter, sparse_fanin_step,
                          store_to_changeset)
 from ..ops.merge import recv_guards
 from ..ops.packing import NodeTable
@@ -76,6 +77,9 @@ class DenseCrdt:
         # the lanes if the new id sorts into the middle (a resume under
         # a fresh node id must not shift attribution).
         self._table = NodeTable(node_ids or [])
+        # A caller-supplied store counts as escaped: the caller may
+        # still hold it, so write scatters must not donate its buffers.
+        self._store_escaped = store is not None
         self._store = store if store is not None else empty_dense_store(
             n_slots)
         if self._store.n_slots != n_slots:  # must survive `python -O`
@@ -104,6 +108,11 @@ class DenseCrdt:
 
     @property
     def store(self) -> DenseStore:
+        """Live store lanes. Reading this marks the snapshot as
+        escaped, which disables buffer donation on subsequent
+        `put_batch`/`delete_batch` calls until the store is next
+        replaced — a snapshot you hold stays readable."""
+        self._store_escaped = True
         return self._store
 
     def refresh_canonical_time(self) -> None:
@@ -120,6 +129,19 @@ class DenseCrdt:
                 f"slot indices must be within [0, {self.n_slots}); got "
                 f"range [{slots.min()}, {slots.max()}]")
 
+    def _donate_writes(self) -> bool:
+        """Donate old store buffers to write scatters only when (a) the
+        backend honors donation (CPU ignores it with a warning) and
+        (b) the current store snapshot has never been handed out via
+        the public ``store`` property — a caller-held snapshot must
+        stay readable, so an escaped store is never donated."""
+        if self._store_escaped:
+            return False
+        try:
+            return next(iter(self._store.lt.devices())).platform != "cpu"
+        except Exception:
+            return False
+
     def put_batch(self, slots, values) -> None:
         """Write values at slot indices; the whole batch shares ONE
         freshly-sent HLC (putAll semantics, crdt.dart:46-54)."""
@@ -131,16 +153,12 @@ class DenseCrdt:
                                         millis=self._wall_clock())
         t = jnp.int64(self._canonical_time.logical_time)
         me = jnp.int32(self._table.ordinal(self._node_id))
-        s = self._store
-        self._store = DenseStore(
-            lt=s.lt.at[slots].set(t),
-            node=s.node.at[slots].set(me),
-            val=s.val.at[slots].set(values),
-            mod_lt=s.mod_lt.at[slots].set(t),
-            mod_node=s.mod_node.at[slots].set(me),
-            occupied=s.occupied.at[slots].set(True),
-            tomb=s.tomb.at[slots].set(False),
-        )
+        # One fused jit (not 7 eager scatters); donate the old lanes on
+        # backends that support it so an O(k) write never copies the
+        # O(n_slots) store.
+        self._store = put_scatter(self._store, slots, values, t, me,
+                                  donate=self._donate_writes())
+        self._store_escaped = False
         self.stats.puts += 1
         self.stats.records_put += int(slots.shape[0])
         self._emit_put(slots, values)
@@ -154,15 +172,9 @@ class DenseCrdt:
                                         millis=self._wall_clock())
         t = jnp.int64(self._canonical_time.logical_time)
         me = jnp.int32(self._table.ordinal(self._node_id))
-        s = self._store
-        self._store = s._replace(
-            lt=s.lt.at[slots].set(t),
-            node=s.node.at[slots].set(me),
-            mod_lt=s.mod_lt.at[slots].set(t),
-            mod_node=s.mod_node.at[slots].set(me),
-            occupied=s.occupied.at[slots].set(True),
-            tomb=s.tomb.at[slots].set(True),
-        )
+        self._store = delete_scatter(self._store, slots, t, me,
+                                     donate=self._donate_writes())
+        self._store_escaped = False
         self.stats.puts += 1
         self.stats.records_put += int(slots.shape[0])
         self._emit_delete(slots)

@@ -223,20 +223,25 @@ class TpuMapCrdt(Crdt[K, V]):
             if emit:
                 self._hub.add(key, record.value)
 
-    def record_map(self, modified_since: Optional[Hlc] = None
-                   ) -> Dict[K, Record[V]]:
+    def _delta_slots(self, modified_since: Optional[Hlc]) -> np.ndarray:
+        """Occupied slot indices passing the INCLUSIVE ``modified``
+        delta bound (map_crdt.dart:44-45) — the one delta-selection
+        shared by ``record_map`` and the lane-direct ``to_json``."""
         n = len(self._slot_keys)
         if n == 0:
-            return {}
+            return np.empty(0, np.int64)
         l = self._lanes
-        if modified_since is None:
-            mask = l.occupied[:n]
-        else:
-            mask = l.occupied[:n] & (
-                l.mod_lt[:n] >= modified_since.logical_time)
-        idx = np.nonzero(mask)[0]
+        mask = l.occupied[:n]
+        if modified_since is not None:
+            mask = mask & (l.mod_lt[:n] >= modified_since.logical_time)
+        return np.nonzero(mask)[0]
+
+    def record_map(self, modified_since: Optional[Hlc] = None
+                   ) -> Dict[K, Record[V]]:
+        idx = self._delta_slots(modified_since)
         if idx.size == 0:
             return {}
+        l = self._lanes
         ids = np.array(self._table.ids(), object)
         keys = self._slot_keys
         payload = self._payload
@@ -253,6 +258,51 @@ class TpuMapCrdt(Crdt[K, V]):
                                raw(mms, mc, mnd))
             for slot, ms, c, nd, mms, mc, mnd in zip(*cols)
         }
+
+    def to_json(self, modified_since: Optional[Hlc] = None,
+                key_encoder=None, value_encoder=None) -> str:
+        """Wire export (crdt.dart:124-135) straight from the shadow
+        lanes: numpy delta mask, C-codec batch HLC formatting, one
+        `json.dumps` — no Record/Hlc materialization. Byte-identical
+        to the generic `record_map()` + `crdt_json.encode` path
+        (same key stringification, same separators, same insertion
+        order), which remains the fallback when the native codec is
+        unavailable or a year falls outside the 1-9999 wire window."""
+        from .. import native
+        import json as json_mod
+        codec = native.load()
+        if codec is None:
+            return super().to_json(modified_since,
+                                   key_encoder=key_encoder,
+                                   value_encoder=value_encoder)
+        l = self._lanes
+        idx = self._delta_slots(modified_since)
+        if idx.size == 0:
+            return "{}"
+        id_strs = np.array([str(i) for i in self._table.ids()], object)
+        hlcs = codec.format_hlc_batch(
+            (l.lt[idx] >> SHIFT).tolist(),
+            (l.lt[idx] & MAX_COUNTER).tolist(),
+            id_strs[l.node[idx]].tolist())
+        if None in hlcs:
+            # out-of-window year: the generic encoder raises with the
+            # reference's fail-fast message
+            return super().to_json(modified_since,
+                                   key_encoder=key_encoder,
+                                   value_encoder=value_encoder)
+        keys = self._slot_keys
+        payload = self._payload
+        kenc = crdt_json.dart_str if key_encoder is None else key_encoder
+        if value_encoder is None:
+            obj = {kenc(keys[s]): {"hlc": h, "value": payload[s]}
+                   for s, h in zip(idx.tolist(), hlcs)}
+        else:
+            obj = {kenc(keys[s]):
+                   {"hlc": h, "value": value_encoder(keys[s], payload[s])}
+                   for s, h in zip(idx.tolist(), hlcs)}
+        return json_mod.dumps(obj, separators=(",", ":"),
+                              ensure_ascii=False,
+                              default=crdt_json._default)
 
     def watch(self, key: Optional[K] = None) -> ChangeStream:
         return self._hub.stream(key)

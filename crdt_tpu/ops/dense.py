@@ -342,3 +342,55 @@ def store_to_changeset(store: DenseStore,
     return DenseChangeset(lt=store.lt[None], node=store.node[None],
                           val=store.val[None], tomb=store.tomb[None],
                           valid=valid[None])
+
+
+# --- local-write scatters (putAll/delete, crdt.dart:46-58) ---
+#
+# One fused jit per batch shape instead of seven eager `.at[].set`
+# dispatches, with store-buffer donation where the backend supports it
+# (TPU; CPU ignores donation with a warning, so the caller picks) —
+# a local write into an n-slot store must not copy n-wide lanes.
+
+import functools as _functools
+
+
+@_functools.lru_cache(maxsize=None)
+def _put_scatter(donate: bool):
+    def step(store: DenseStore, slots, values, t, me) -> DenseStore:
+        return DenseStore(
+            lt=store.lt.at[slots].set(t),
+            node=store.node.at[slots].set(me),
+            val=store.val.at[slots].set(values),
+            mod_lt=store.mod_lt.at[slots].set(t),
+            mod_node=store.mod_node.at[slots].set(me),
+            occupied=store.occupied.at[slots].set(True),
+            tomb=store.tomb.at[slots].set(False),
+        )
+    return jax.jit(step, donate_argnums=(0,) if donate else ())
+
+
+@_functools.lru_cache(maxsize=None)
+def _delete_scatter(donate: bool):
+    def step(store: DenseStore, slots, t, me) -> DenseStore:
+        return DenseStore(
+            lt=store.lt.at[slots].set(t),
+            node=store.node.at[slots].set(me),
+            val=store.val,
+            mod_lt=store.mod_lt.at[slots].set(t),
+            mod_node=store.mod_node.at[slots].set(me),
+            occupied=store.occupied.at[slots].set(True),
+            tomb=store.tomb.at[slots].set(True),
+        )
+    return jax.jit(step, donate_argnums=(0,) if donate else ())
+
+
+def put_scatter(store: DenseStore, slots, values, t, me,
+                donate: bool = False) -> DenseStore:
+    """Batch put: scatter one shared HLC + values at ``slots``."""
+    return _put_scatter(donate)(store, slots, values, t, me)
+
+
+def delete_scatter(store: DenseStore, slots, t, me,
+                   donate: bool = False) -> DenseStore:
+    """Batch tombstone: scatter one shared HLC at ``slots``."""
+    return _delete_scatter(donate)(store, slots, t, me)

@@ -1008,3 +1008,42 @@ class TestFastJsonExport:
         c = make("nc", BASE + 50)
         c.merge_json(a.to_json())
         assert c.record_map() == a.record_map()
+
+
+class TestWriteDonationSafety:
+    """Write scatters may donate store buffers only while the current
+    snapshot never escaped via the public `store` property (a held
+    snapshot must stay readable). Donation itself is backend-gated
+    (off on CPU); the ownership tracking is what's tested here."""
+
+    def test_escape_tracking(self):
+        c = DenseCrdt("n", 256, wall_clock=FakeClock())
+        assert c._store_escaped is False
+        _ = c.store
+        assert c._store_escaped is True
+        assert c._donate_writes() is False   # escaped -> never donate
+        c.put_batch([1], [10])
+        assert c._store_escaped is False     # fresh post-write snapshot
+        c.delete_batch([1])
+        assert c._store_escaped is False
+
+    def test_caller_supplied_store_counts_as_escaped(self):
+        a = DenseCrdt("n", 256, wall_clock=FakeClock())
+        a.put_batch([0, 1], [5, 6])
+        held = a.store
+        b = DenseCrdt("n", 256, wall_clock=FakeClock(), store=held,
+                      node_ids=["n"])
+        assert b._store_escaped is True
+        assert b._donate_writes() is False
+        b.put_batch([2], [7])
+        # the caller's snapshot must still be readable afterwards
+        assert int(held.val[0]) == 5
+
+    def test_held_snapshot_survives_writes(self):
+        c = DenseCrdt("n", 256, wall_clock=FakeClock())
+        c.put_batch([0], [1])
+        snap = c.store
+        for i in range(3):
+            c.put_batch([i + 1], [i])
+        assert int(snap.val[0]) == 1         # old snapshot intact
+        assert int(c.store.val[3]) == 2

@@ -175,3 +175,60 @@ def test_differential_replica_convergence(seed):
     jsons = [json.loads(r.to_json()) for r in replicas]
     # record-level state (hlc+value) identical everywhere
     assert jsons[0] == jsons[1] == jsons[2]
+
+
+class TestLaneDirectExport:
+    """TpuMapCrdt.to_json streams from the shadow lanes; it must stay
+    byte-identical to the generic record_map()+encode path."""
+
+    def _mixed(self):
+        from crdt_tpu.testing import FakeClock
+        from datetime import datetime, timezone
+        c = TpuMapCrdt("nodeA", wall_clock=FakeClock())
+        c.put_all({f"k{i}": {"x": i, "s": "é" * (i % 5)}
+                   for i in range(50)})
+        c.put(3, "int-key")
+        c.put(datetime(2026, 1, 2, 3, 4, 5, 600000,
+                       tzinfo=timezone.utc), "dt-key")
+        c.put("tomb", 1)
+        c.delete("tomb")
+        return c
+
+    def test_byte_identity_full(self):
+        c = self._mixed()
+        assert c.to_json() == super(TpuMapCrdt, c).to_json()
+
+    def test_byte_identity_delta_and_coders(self):
+        c = self._mixed()
+        since = c.canonical_time
+        c.put("late", {"v": [1, None, "x"]})
+        for kw in (dict(modified_since=since),
+                   dict(key_encoder=lambda k: f"K|{k}"),
+                   dict(value_encoder=lambda k, v: {"w": v}),
+                   dict(modified_since=since,
+                        key_encoder=lambda k: f"K|{k}",
+                        value_encoder=lambda k, v: [v])):
+            assert c.to_json(**kw) == super(TpuMapCrdt, c).to_json(**kw), kw
+
+    def test_empty_and_no_match_delta(self):
+        from crdt_tpu.hlc import Hlc
+        from crdt_tpu.testing import FakeClock
+        c = TpuMapCrdt("nodeA", wall_clock=FakeClock())
+        assert c.to_json() == "{}"
+        c.put("a", 1)
+        far = Hlc(c.canonical_time.millis + 10_000, 0, "nodeA")
+        assert c.to_json(modified_since=far) == "{}"
+
+    def test_wire_roundtrip_through_oracle(self):
+        from crdt_tpu import MapCrdt
+        from crdt_tpu.testing import FakeClock
+        src = self._mixed()
+        dst = MapCrdt("nodeB", wall_clock=FakeClock())
+        dst.merge_json(src.to_json())
+        back = TpuMapCrdt("nodeC", wall_clock=FakeClock())
+        back.merge_json(dst.to_json())
+        from crdt_tpu.crdt_json import dart_str
+        assert {dart_str(k): r.value
+                for k, r in back.record_map().items()} \
+            == {dart_str(k): r.value
+                for k, r in src.record_map().items()}
