@@ -119,6 +119,23 @@ def bench_dense_to_json(n_slots=1 << 20, repeats=3):
         best, path="lane-direct-c-codec")
 
 
+def bench_tpu_map_to_json(n_keys=1 << 20, repeats=3):
+    """1M-key full wire export on the drop-in general-key backend:
+    lane-direct shadow-lane formatting (crdt.dart:124-135 interop at
+    the scale the round-2 review called effectively unusable)."""
+    c = TpuMapCrdt("na", wall_clock=FakeClock(start=_MILLIS))
+    c.put_all({f"k{i}": i for i in range(n_keys)})
+    best = float("inf")
+    for _ in range(repeats):
+        t0 = time.perf_counter()
+        out = c.to_json()
+        best = min(best, time.perf_counter() - t0)
+    assert out.startswith('{"k0":')
+    return result_dict(
+        f"tpu_map_to_json_{n_keys // 1000}k_records_per_sec", n_keys,
+        best, path="lane-direct-c-codec")
+
+
 def bench_payload_wire_oracle(n_keys=10_000, repeats=5):
     """Config 5 on the host-only oracle — isolates the wire codec
     (native batch HLC parse + merge loop) from device round-trip
@@ -168,6 +185,7 @@ def main():
     emit(lambda: bench_payload_wire(n_keys=1 << 20, repeats=1))
     emit(lambda: bench_payload_wire_oracle(n_keys=1 << 20, repeats=1))
     emit(bench_dense_to_json)
+    emit(bench_tpu_map_to_json)
 
 
 if __name__ == "__main__":

@@ -187,7 +187,10 @@ class DenseCrdt:
 
     @property
     def values(self) -> jax.Array:
-        """int64[n_slots]; only positions with ``live_mask`` are live."""
+        """int64[n_slots]; only positions with ``live_mask`` are live.
+        Hands out the live lane, so (like ``store``) it marks the
+        snapshot escaped — later writes won't donate its buffer."""
+        self._store_escaped = True
         return self._store.val
 
     def _check_slot(self, slot: int) -> None:
@@ -536,6 +539,10 @@ class DenseCrdt:
         ``modified >= since`` (inclusive, map_crdt.dart:44-45), plus the
         node-id list its ordinals index into."""
         since_lt = None if since is None else jnp.int64(since.logical_time)
+        # store_to_changeset reshapes lanes; whether jax aliases the
+        # underlying buffers is backend-dependent, so treat the export
+        # as an escape — later writes must not donate those buffers.
+        self._store_escaped = True
         cs = store_to_changeset(self._store, since_lt)
         return cs, self._table.ids()
 

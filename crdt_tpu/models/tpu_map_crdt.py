@@ -151,16 +151,28 @@ class TpuMapCrdt(Crdt[K, V]):
             self._device = None
 
     def _ensure_slots(self, keys: Sequence[K]) -> np.ndarray:
-        slots = np.empty(len(keys), dtype=np.int64)
-        get = self._key_to_slot.get
-        for i, key in enumerate(keys):
-            slot = get(key)
-            if slot is None:
-                slot = len(self._slot_keys)
-                self._key_to_slot[key] = slot
-                self._slot_keys.append(key)
-                self._payload.append(None)
-            slots[i] = slot
+        from .. import native
+        codec = native.load()
+        if codec is not None and isinstance(keys, list):
+            # C batch get-or-insert: same dict, same slot assignment
+            # order, minus ~1.8 s/1M of interpreter dispatch.
+            buf, new_keys = codec.ensure_slots(
+                self._key_to_slot, keys, len(self._slot_keys))
+            slots = np.frombuffer(buf, np.int64)
+            if new_keys:
+                self._slot_keys.extend(new_keys)
+                self._payload.extend([None] * len(new_keys))
+        else:
+            slots = np.empty(len(keys), dtype=np.int64)
+            get = self._key_to_slot.get
+            for i, key in enumerate(keys):
+                slot = get(key)
+                if slot is None:
+                    slot = len(self._slot_keys)
+                    self._key_to_slot[key] = slot
+                    self._slot_keys.append(key)
+                    self._payload.append(None)
+                slots[i] = slot
         if len(self._slot_keys) > self._lanes.capacity:
             self._lanes.grow(_next_pow2(len(self._slot_keys)))
             self._device = None
@@ -416,26 +428,35 @@ class TpuMapCrdt(Crdt[K, V]):
             win = ~l_occ | (lt > l_lt) | ((lt == l_lt) & (node > l_node))
 
             # --- stage 3: re-stamp winners, scatter into the shadow.
+            from .. import native
+            codec = native.load()
             widx = slots[win]
+            winners = np.nonzero(win)[0]
             l.lt[widx] = lt[win]
             l.node[widx] = node[win]
             l.mod_lt[widx] = new_canonical
             l.mod_node[widx] = my_ord
             l.occupied[widx] = True
-            l.tomb[widx] = np.fromiter(
-                (values[i] is None for i in np.nonzero(win)[0]),
-                bool, count=int(win.sum()))
+            if codec is not None:
+                l.tomb[widx] = np.frombuffer(
+                    codec.none_mask(values), bool)[winners]
+            else:
+                l.tomb[widx] = np.fromiter(
+                    (values[i] is None for i in winners),
+                    bool, count=winners.size)
             self._device = None
 
-        winners = np.nonzero(win)[0].tolist()
-        self.stats.records_adopted += len(winners)
+        self.stats.records_adopted += int(winners.size)
         payload = self._payload
         emit = self._hub.active
-        for i in winners:
-            value = values[i]
-            payload[slots[i]] = value
-            if emit:
-                self._hub.add(keys[i], value)
+        if codec is not None and not emit:
+            codec.scatter_payload(payload, slots, winners, values)
+        else:
+            for i in winners.tolist():
+                value = values[i]
+                payload[slots[i]] = value
+                if emit:
+                    self._hub.add(keys[i], value)
 
         self._canonical_time = Hlc.send(
             Hlc.from_logical_time(new_canonical, self._node_id),

@@ -792,6 +792,133 @@ done:
     return NULL;
 }
 
+/* ================== host-runtime batch helpers ==================
+ *
+ * The vectorized backends keep key->slot maps and payload tables as
+ * Python dict/list (keys and values are arbitrary Python objects);
+ * these helpers run their per-record bookkeeping loops in C. Same
+ * semantics as the straightforward Python loops, minus the
+ * interpreter dispatch — at 1M records the ensure-slots loop alone
+ * is ~1.8 s of a 3.2 s wire merge. */
+
+/* ensure_slots(key_to_slot: dict, keys: list, start: int)
+ * -> (bytearray of int64 slots, new_keys: list)
+ * Get-or-insert each key; fresh keys take consecutive slots from
+ * `start` in list order and are returned so the caller can extend its
+ * slot->key / payload tables. */
+static PyObject *ensure_slots(PyObject *self, PyObject *args) {
+    PyObject *map, *keys;
+    Py_ssize_t start;
+    if (!PyArg_ParseTuple(args, "O!O!n", &PyDict_Type, &map,
+                          &PyList_Type, &keys, &start))
+        return NULL;
+    Py_ssize_t n = PyList_GET_SIZE(keys);
+    PyObject *buf = PyByteArray_FromStringAndSize(
+        NULL, n * (Py_ssize_t)sizeof(long long));
+    PyObject *new_keys = PyList_New(0);
+    if (!buf || !new_keys) goto fail;
+    long long *slots = (long long *)PyByteArray_AS_STRING(buf);
+    Py_ssize_t next = start;
+    for (Py_ssize_t i = 0; i < n; i++) {
+        PyObject *key = PyList_GET_ITEM(keys, i);
+        PyObject *idx = PyLong_FromSsize_t(next);
+        if (!idx) goto fail;
+        PyObject *prev = PyDict_SetDefault(map, key, idx);
+        if (!prev) { Py_DECREF(idx); goto fail; }
+        if (prev == idx) {
+            if (PyList_Append(new_keys, key) < 0) {
+                Py_DECREF(idx); goto fail;
+            }
+            slots[i] = (long long)next;
+            next++;
+        } else {
+            slots[i] = PyLong_AsLongLong(prev);
+            if (slots[i] == -1 && PyErr_Occurred()) {
+                Py_DECREF(idx); goto fail;
+            }
+        }
+        Py_DECREF(idx);
+    }
+    {
+        PyObject *out = PyTuple_Pack(2, buf, new_keys);
+        Py_DECREF(buf); Py_DECREF(new_keys);
+        return out;
+    }
+fail:
+    /* Exception safety: the caller extends its slot->key/payload
+     * tables only on success, so any keys this batch already inserted
+     * into the shared dict must be rolled back — otherwise the next
+     * batch re-issues their slot numbers and two keys silently share
+     * one lane slot. */
+    if (new_keys) {
+        PyObject *etype, *eval, *etb;
+        PyErr_Fetch(&etype, &eval, &etb);
+        for (Py_ssize_t i = 0; i < PyList_GET_SIZE(new_keys); i++) {
+            if (PyDict_DelItem(map, PyList_GET_ITEM(new_keys, i)) < 0)
+                PyErr_Clear();
+        }
+        PyErr_Restore(etype, eval, etb);
+    }
+    Py_XDECREF(buf); Py_XDECREF(new_keys);
+    return NULL;
+}
+
+/* none_mask(values: list) -> bytearray of uint8 (1 where item is None)
+ * — the tombstone lane build (value == null, record.dart:17). */
+static PyObject *none_mask(PyObject *self, PyObject *arg) {
+    if (!PyList_Check(arg)) {
+        PyErr_SetString(PyExc_TypeError, "expected a list");
+        return NULL;
+    }
+    Py_ssize_t n = PyList_GET_SIZE(arg);
+    PyObject *buf = PyByteArray_FromStringAndSize(NULL, n);
+    if (!buf) return NULL;
+    char *m = PyByteArray_AS_STRING(buf);
+    for (Py_ssize_t i = 0; i < n; i++)
+        m[i] = PyList_GET_ITEM(arg, i) == Py_None;
+    return buf;
+}
+
+/* scatter_payload(payload: list, slots: int64 buffer,
+ *                 winners: int64 buffer, values: list) -> None
+ * payload[slots[w]] = values[w] for each winner index w. */
+static PyObject *scatter_payload(PyObject *self, PyObject *args) {
+    PyObject *payload, *slots_o, *win_o, *values;
+    if (!PyArg_ParseTuple(args, "O!OOO!", &PyList_Type, &payload,
+                          &slots_o, &win_o, &PyList_Type, &values))
+        return NULL;
+    Py_buffer slots_b, win_b;
+    if (PyObject_GetBuffer(slots_o, &slots_b, PyBUF_CONTIG_RO) < 0)
+        return NULL;
+    if (PyObject_GetBuffer(win_o, &win_b, PyBUF_CONTIG_RO) < 0) {
+        PyBuffer_Release(&slots_b);
+        return NULL;
+    }
+    const long long *slots = (const long long *)slots_b.buf;
+    const long long *win = (const long long *)win_b.buf;
+    Py_ssize_t n_slots_arr = slots_b.len / (Py_ssize_t)sizeof(long long);
+    Py_ssize_t n_win = win_b.len / (Py_ssize_t)sizeof(long long);
+    Py_ssize_t n_pay = PyList_GET_SIZE(payload);
+    Py_ssize_t n_val = PyList_GET_SIZE(values);
+    for (Py_ssize_t i = 0; i < n_win; i++) {
+        long long w = win[i];
+        if (w < 0 || w >= n_slots_arr || w >= n_val ||
+            slots[w] < 0 || slots[w] >= n_pay) {
+            PyBuffer_Release(&slots_b); PyBuffer_Release(&win_b);
+            PyErr_SetString(PyExc_IndexError,
+                            "scatter_payload index out of range");
+            return NULL;
+        }
+        PyObject *v = PyList_GET_ITEM(values, w);
+        Py_INCREF(v);
+        PyObject *old = PyList_GET_ITEM(payload, slots[w]);
+        PyList_SET_ITEM(payload, slots[w], v);
+        Py_XDECREF(old);
+    }
+    PyBuffer_Release(&slots_b); PyBuffer_Release(&win_b);
+    Py_RETURN_NONE;
+}
+
 static PyMethodDef methods[] = {
     {"parse_hlc_batch", parse_hlc_batch, METH_O,
      "Batch-parse canonical HLC wire strings."},
@@ -799,6 +926,12 @@ static PyMethodDef methods[] = {
      "Batch-format HLC components to wire strings."},
     {"parse_wire", parse_wire, METH_O,
      "One-pass columnar scan of a wire JSON payload."},
+    {"ensure_slots", ensure_slots, METH_VARARGS,
+     "Batch get-or-insert of keys into a key->slot dict."},
+    {"none_mask", none_mask, METH_O,
+     "uint8 mask of None entries in a list."},
+    {"scatter_payload", scatter_payload, METH_VARARGS,
+     "payload[slots[w]] = values[w] for winner indices."},
     {NULL, NULL, 0, NULL}};
 
 static struct PyModuleDef module = {

@@ -1047,3 +1047,13 @@ class TestWriteDonationSafety:
             c.put_batch([i + 1], [i])
         assert int(snap.val[0]) == 1         # old snapshot intact
         assert int(c.store.val[3]) == 2
+
+    def test_values_and_export_escape_tracking(self):
+        c = DenseCrdt("n", 256, wall_clock=FakeClock())
+        c.put_batch([0], [1])
+        _ = c.values           # raw lane handed out
+        assert c._store_escaped is True
+        c.put_batch([1], [2])  # resets after the write
+        assert c._store_escaped is False
+        c.export_delta()
+        assert c._store_escaped is True

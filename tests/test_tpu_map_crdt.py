@@ -232,3 +232,69 @@ class TestLaneDirectExport:
                 for k, r in back.record_map().items()} \
             == {dart_str(k): r.value
                 for k, r in src.record_map().items()}
+
+
+class TestNativeHostHelpers:
+    """The C batch bookkeeping helpers (ensure_slots / none_mask /
+    scatter_payload) must be behaviorally identical to the Python
+    loops they replace."""
+
+    def _payload(self, n=300):
+        import random
+        rng = random.Random(3)
+        return {f"k{i}": Record(
+            Hlc(1_700_000_000_000 + rng.randrange(50), rng.randrange(4),
+                f"n{rng.randrange(3)}"),
+            None if i % 5 == 0 else f"v{i}",
+            Hlc(1_700_000_000_000, 0, "n0")) for i in range(n)}
+
+    def test_merge_matches_pure_python_path(self, monkeypatch):
+        from crdt_tpu import native as native_pkg
+        recs = self._payload()
+        fast = TpuMapCrdt("local", wall_clock=FakeClock(
+            start=1_700_000_000_100))
+        fast.merge(dict(recs))
+        monkeypatch.setattr(native_pkg, "_mod", None)
+        monkeypatch.setattr(native_pkg, "_tried", True)
+        slow = TpuMapCrdt("local", wall_clock=FakeClock(
+            start=1_700_000_000_100))
+        slow.merge(dict(recs))
+        monkeypatch.undo()
+        assert fast.record_map() == slow.record_map()
+        assert fast.canonical_time == slow.canonical_time
+        assert fast._slot_keys == slow._slot_keys
+        assert fast._payload == slow._payload
+
+    def test_watch_subscriber_sees_same_winners(self):
+        # with a subscriber the python emit loop runs instead of the C
+        # scatter; store state must be identical either way
+        recs = self._payload(100)
+        a = TpuMapCrdt("local", wall_clock=FakeClock(
+            start=1_700_000_000_100))
+        events = []
+        stream = a.watch()
+        stream.listen(lambda e: events.append((e.key, e.value)))
+        a.merge(dict(recs))
+        b = TpuMapCrdt("local", wall_clock=FakeClock(
+            start=1_700_000_000_100))
+        b.merge(dict(recs))
+        assert a.record_map() == b.record_map()
+        assert len(events) == 100   # all fresh keys win
+        assert dict(events) == {k: r.value for k, r in recs.items()}
+
+    def test_ensure_slots_rolls_back_on_mid_batch_failure(self):
+        """An unhashable key mid-batch must leave the key->slot dict
+        and the slot tables consistent (C path parity with the
+        per-key Python loop)."""
+        c = TpuMapCrdt("local", wall_clock=FakeClock(
+            start=1_700_000_000_100))
+        c.put("pre", 0)
+        before = dict(c._key_to_slot)
+        bad_keys = ["a", "b", ["unhashable"], "c"]
+        with pytest.raises(TypeError):
+            c._ensure_slots(bad_keys)
+        assert c._key_to_slot == before
+        assert len(c._slot_keys) == len(c._key_to_slot)
+        # and the store still works
+        c.put_all({"a": 1, "b": 2})
+        assert c.get("a") == 1 and c.get("pre") == 0
