@@ -165,14 +165,26 @@ class TpuMapCrdt(Crdt[K, V]):
         else:
             slots = np.empty(len(keys), dtype=np.int64)
             get = self._key_to_slot.get
-            for i, key in enumerate(keys):
-                slot = get(key)
-                if slot is None:
-                    slot = len(self._slot_keys)
-                    self._key_to_slot[key] = slot
-                    self._slot_keys.append(key)
-                    self._payload.append(None)
-                slots[i] = slot
+            added = 0
+            try:
+                for i, key in enumerate(keys):
+                    slot = get(key)
+                    if slot is None:
+                        slot = len(self._slot_keys)
+                        self._key_to_slot[key] = slot
+                        self._slot_keys.append(key)
+                        self._payload.append(None)
+                        added += 1
+                    slots[i] = slot
+            except BaseException:
+                # mid-batch failure (e.g. unhashable key): roll back
+                # this batch's inserts so dict and slot tables stay
+                # consistent — the C path's contract.
+                for key in self._slot_keys[len(self._slot_keys) - added:]:
+                    del self._key_to_slot[key]
+                del self._slot_keys[len(self._slot_keys) - added:]
+                del self._payload[len(self._payload) - added:]
+                raise
         if len(self._slot_keys) > self._lanes.capacity:
             self._lanes.grow(_next_pow2(len(self._slot_keys)))
             self._device = None
@@ -247,6 +259,49 @@ class TpuMapCrdt(Crdt[K, V]):
         if modified_since is not None:
             mask = mask & (l.mod_lt[:n] >= modified_since.logical_time)
         return np.nonzero(mask)[0]
+
+    def put_all(self, values: Dict[K, Optional[V]]) -> None:
+        """Batch put, ONE shared send-stamped HLC (crdt.dart:46-54) —
+        written straight to the lanes: every record in the batch
+        carries the identical (t, t) stamp pair, so there is nothing
+        per-record to extract and no Record objects to build."""
+        if not values:
+            return  # no clock touch on an empty batch (crdt.dart:47-48)
+        self._canonical_time = Hlc.send(self._canonical_time,
+                                        millis=self._wall_clock())
+        t = self._canonical_time.logical_time
+        self.stats.puts += 1
+        self.stats.records_put += len(values)
+        keys = list(values.keys())
+        vals = list(values.values())
+        self._intern_nodes([self._node_id])
+        my_ord = self._my_ordinal()
+        slots = self._ensure_slots(keys)
+        from .. import native
+        codec = native.load()
+        l = self._lanes
+        l.lt[slots] = t
+        l.node[slots] = my_ord
+        l.mod_lt[slots] = t
+        l.mod_node[slots] = my_ord
+        l.occupied[slots] = True
+        if codec is not None:
+            l.tomb[slots] = np.frombuffer(codec.none_mask(vals), bool)
+        else:
+            l.tomb[slots] = np.fromiter((v is None for v in vals),
+                                        bool, count=len(vals))
+        self._device = None
+        payload = self._payload
+        emit = self._hub.active
+        if codec is not None and not emit:
+            codec.scatter_payload(payload, slots,
+                                  np.arange(len(keys), dtype=np.int64),
+                                  vals)
+        else:
+            for i, key in enumerate(keys):
+                payload[slots[i]] = vals[i]
+                if emit:
+                    self._hub.add(key, vals[i])
 
     def record_map(self, modified_since: Optional[Hlc] = None
                    ) -> Dict[K, Record[V]]:

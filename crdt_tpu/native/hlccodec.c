@@ -827,7 +827,15 @@ static PyObject *ensure_slots(PyObject *self, PyObject *args) {
         if (!prev) { Py_DECREF(idx); goto fail; }
         if (prev == idx) {
             if (PyList_Append(new_keys, key) < 0) {
-                Py_DECREF(idx); goto fail;
+                /* the key IS in the dict but won't make new_keys, so
+                 * the rollback loop below would miss it — undo the
+                 * insert here (preserving the append's exception). */
+                PyObject *et, *ev, *tb;
+                PyErr_Fetch(&et, &ev, &tb);
+                if (PyDict_DelItem(map, key) < 0) PyErr_Clear();
+                PyErr_Restore(et, ev, tb);
+                Py_DECREF(idx);
+                goto fail;
             }
             slots[i] = (long long)next;
             next++;

@@ -298,3 +298,39 @@ class TestNativeHostHelpers:
         # and the store still works
         c.put_all({"a": 1, "b": 2})
         assert c.get("a") == 1 and c.get("pre") == 0
+
+    def test_put_all_lane_direct_matches_oracle(self, monkeypatch):
+        """put_all writes lanes directly (one shared HLC, no Record
+        objects); state and wire output must match the oracle and the
+        pure-Python fallback, including tombstones via None values
+        and overwrites of existing keys."""
+        from crdt_tpu import native as native_pkg
+        batch = {f"k{i}": (None if i % 4 == 0 else i) for i in range(60)}
+        o = MapCrdt("n", wall_clock=FakeClock())
+        fast = TpuMapCrdt("n", wall_clock=FakeClock())
+        for c in (o, fast):
+            c.put("k3", "pre")       # overwrite target
+            c.put_all(batch)
+            c.put_all({})            # no clock touch
+        assert fast.to_json() == o.to_json()
+        assert fast.canonical_time == o.canonical_time
+        # all records in the batch share ONE hlc (crdt.dart:50-52)
+        hlcs = {str(r.hlc) for k, r in fast.record_map().items()
+                if k in batch}
+        assert len(hlcs) == 1
+        # pure-python fallback identical
+        monkeypatch.setattr(native_pkg, "_mod", None)
+        monkeypatch.setattr(native_pkg, "_tried", True)
+        slow = TpuMapCrdt("n", wall_clock=FakeClock())
+        slow.put("k3", "pre")
+        slow.put_all(batch)
+        slow.put_all({})
+        monkeypatch.undo()
+        assert slow.record_map() == fast.record_map()
+
+    def test_put_all_watch_events(self):
+        a = TpuMapCrdt("n", wall_clock=FakeClock())
+        seen = []
+        a.watch().listen(lambda e: seen.append((e.key, e.value)))
+        a.put_all({"x": 1, "y": None})
+        assert sorted(seen) == [("x", 1), ("y", None)]
