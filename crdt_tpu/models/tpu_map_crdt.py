@@ -165,25 +165,36 @@ class TpuMapCrdt(Crdt[K, V]):
         else:
             slots = np.empty(len(keys), dtype=np.int64)
             get = self._key_to_slot.get
-            added = 0
+            start = len(self._slot_keys)   # dict/lists in lockstep here
+            pending = None   # key dict-inserted but not yet in the lists
             try:
                 for i, key in enumerate(keys):
                     slot = get(key)
                     if slot is None:
                         slot = len(self._slot_keys)
+                        pending = key
                         self._key_to_slot[key] = slot
                         self._slot_keys.append(key)
                         self._payload.append(None)
-                        added += 1
+                        pending = None
                     slots[i] = slot
             except BaseException:
                 # mid-batch failure (e.g. unhashable key): roll back
-                # this batch's inserts so dict and slot tables stay
-                # consistent — the C path's contract.
-                for key in self._slot_keys[len(self._slot_keys) - added:]:
-                    del self._key_to_slot[key]
-                del self._slot_keys[len(self._slot_keys) - added:]
-                del self._payload[len(self._payload) - added:]
+                # to the pre-batch state so dict and slot tables stay
+                # consistent — the C path's contract. `pending` covers
+                # the window where the dict holds a key the list tail
+                # doesn't (yet).
+                if pending is not None:
+                    try:
+                        del self._key_to_slot[pending]
+                    except Exception:
+                        pass  # the insert itself failed (unhashable)
+                for key in self._slot_keys[start:]:
+                    # pop (not del): the pending key may sit in both
+                    # the list tail and the pending-cleanup above
+                    self._key_to_slot.pop(key, None)
+                del self._slot_keys[start:]
+                del self._payload[start:]
                 raise
         if len(self._slot_keys) > self._lanes.capacity:
             self._lanes.grow(_next_pow2(len(self._slot_keys)))

@@ -486,10 +486,50 @@ static int lit(Scan *sc, const char *word, Py_ssize_t wl) {
     return 0;
 }
 
-/* Generic JSON value -> Python object (json.loads leaf semantics).
- * NULL + sc->fallback for anything deferred; NULL + exception on real
- * errors. */
-static PyObject *parse_json_value(Scan *sc) {
+/* Tiny string dedup cache (shared shape with the node cache below;
+ * also used for object MEMBER KEYS, which repeat across records the
+ * way json.loads' memo exploits). Declared ahead of the recursive
+ * value parser. */
+#define NCACHE 64
+typedef struct {
+    const char *p;
+    Py_ssize_t n;
+    PyObject *obj;
+} NodeEnt;
+
+static PyObject *cached_str(NodeEnt *cache, const char *p,
+                            Py_ssize_t n) {
+    unsigned long long h = 1469598103934665603ULL;
+    for (Py_ssize_t i = 0; i < n; i++)
+        h = (h ^ (unsigned char)p[i]) * 1099511628211ULL;
+    NodeEnt *e = NULL;
+    for (int j = 0; j < 4; j++) {   /* 4-probe: no thrash on collisions */
+        NodeEnt *c = &cache[(h + (unsigned)j) & (NCACHE - 1)];
+        if (!c->obj) { if (!e) e = c; continue; }
+        if (c->n == n && memcmp(c->p, p, (size_t)n) == 0) {
+            Py_INCREF(c->obj);
+            return c->obj;
+        }
+    }
+    if (!e) e = &cache[h & (NCACHE - 1)];
+    PyObject *s = PyUnicode_FromStringAndSize(p, n);
+    if (!s) return NULL;
+    Py_XDECREF(e->obj);
+    e->p = p; e->n = n; e->obj = s;
+    Py_INCREF(s);
+    return s;
+}
+
+/* Containers nested deeper than this go to json.loads on the matched
+ * span (bounded C recursion; json.loads enforces Python's own limits
+ * beyond it). */
+#define MAX_VALUE_DEPTH 48
+
+/* Generic JSON value -> Python object (json.loads leaf semantics),
+ * recursive for flat-ish containers. NULL + sc->fallback for anything
+ * deferred; NULL + exception on real errors. */
+static PyObject *parse_json_value(Scan *sc, NodeEnt *kcache,
+                                  int depth) {
     const char *s = sc->s;
     Py_ssize_t n = sc->len;
     if (sc->pos >= n) { sc->fallback = 1; return NULL; }
@@ -500,12 +540,77 @@ static PyObject *parse_json_value(Scan *sc) {
         if (!esc) return PyUnicode_FromStringAndSize(s + b, e - b);
         return unescape_span(s + b, e - b, &sc->fallback);
     }
-    if (c == '{' || c == '[') {
-        Py_ssize_t b, e;
-        if (!value_span(sc, &b, &e)) return NULL;
-        if (!ensure_json_loads()) return NULL;
-        return PyObject_CallFunction(g_json_loads, "s#", s + b,
-                                     (Py_ssize_t)(e - b));
+    if (c == '{') {
+        if (depth >= MAX_VALUE_DEPTH) {
+            Py_ssize_t b, e;
+            if (!value_span(sc, &b, &e)) return NULL;
+            if (!ensure_json_loads()) return NULL;
+            return PyObject_CallFunction(g_json_loads, "s#", s + b,
+                                         (Py_ssize_t)(e - b));
+        }
+        sc->pos++;
+        PyObject *d = PyDict_New();
+        if (!d) return NULL;
+        skip_ws(sc);
+        if (sc->pos < n && s[sc->pos] == '}') { sc->pos++; return d; }
+        for (;;) {
+            skip_ws(sc);
+            Py_ssize_t kb, ke; int kesc;
+            if (!string_span(sc, &kb, &ke, &kesc)) goto obj_fail;
+            PyObject *key = kesc
+                ? unescape_span(s + kb, ke - kb, &sc->fallback)
+                : (ke - kb <= 48
+                   ? cached_str(kcache, s + kb, ke - kb)
+                   : PyUnicode_FromStringAndSize(s + kb, ke - kb));
+            if (!key) goto obj_fail;
+            skip_ws(sc);
+            if (sc->pos >= n || s[sc->pos] != ':') {
+                Py_DECREF(key); sc->fallback = 1; goto obj_fail;
+            }
+            sc->pos++;
+            skip_ws(sc);
+            PyObject *v = parse_json_value(sc, kcache, depth + 1);
+            if (!v) { Py_DECREF(key); goto obj_fail; }
+            int rc = PyDict_SetItem(d, key, v);
+            Py_DECREF(key); Py_DECREF(v);
+            if (rc < 0) goto obj_fail;
+            skip_ws(sc);
+            if (sc->pos < n && s[sc->pos] == ',') { sc->pos++; continue; }
+            if (sc->pos < n && s[sc->pos] == '}') { sc->pos++; return d; }
+            sc->fallback = 1;
+            goto obj_fail;
+        }
+    obj_fail:
+        Py_DECREF(d);
+        return NULL;
+    }
+    if (c == '[') {
+        if (depth >= MAX_VALUE_DEPTH) {
+            Py_ssize_t b, e;
+            if (!value_span(sc, &b, &e)) return NULL;
+            if (!ensure_json_loads()) return NULL;
+            return PyObject_CallFunction(g_json_loads, "s#", s + b,
+                                         (Py_ssize_t)(e - b));
+        }
+        sc->pos++;
+        PyObject *l = PyList_New(0);
+        if (!l) return NULL;
+        skip_ws(sc);
+        if (sc->pos < n && s[sc->pos] == ']') { sc->pos++; return l; }
+        for (;;) {
+            skip_ws(sc);
+            PyObject *v = parse_json_value(sc, kcache, depth + 1);
+            if (!v) { Py_DECREF(l); return NULL; }
+            int rc = PyList_Append(l, v);
+            Py_DECREF(v);
+            if (rc < 0) { Py_DECREF(l); return NULL; }
+            skip_ws(sc);
+            if (sc->pos < n && s[sc->pos] == ',') { sc->pos++; continue; }
+            if (sc->pos < n && s[sc->pos] == ']') { sc->pos++; return l; }
+            sc->fallback = 1;
+            Py_DECREF(l);
+            return NULL;
+        }
     }
     if (c == '-' || (c >= '0' && c <= '9')) {
         if (c == '-' && sc->pos + 1 < n && s[sc->pos + 1] == 'I') {
@@ -529,39 +634,6 @@ static PyObject *parse_json_value(Scan *sc) {
     }
     sc->fallback = 1;
     return NULL;
-}
-
-/* Tiny node-string dedup cache: changesets carry few distinct node
- * ids, and returning the SAME str object makes every downstream hash
- * (intern set, ordinal dict) hit its cached-hash fast path. */
-#define NCACHE 64
-typedef struct {
-    const char *p;
-    Py_ssize_t n;
-    PyObject *obj;
-} NodeEnt;
-
-static PyObject *cached_node(NodeEnt *cache, const char *p,
-                             Py_ssize_t n) {
-    unsigned long long h = 1469598103934665603ULL;
-    for (Py_ssize_t i = 0; i < n; i++)
-        h = (h ^ (unsigned char)p[i]) * 1099511628211ULL;
-    NodeEnt *e = NULL;
-    for (int j = 0; j < 4; j++) {   /* 4-probe: no thrash on collisions */
-        NodeEnt *c = &cache[(h + (unsigned)j) & (NCACHE - 1)];
-        if (!c->obj) { if (!e) e = c; continue; }
-        if (c->n == n && memcmp(c->p, p, (size_t)n) == 0) {
-            Py_INCREF(c->obj);
-            return c->obj;
-        }
-    }
-    if (!e) e = &cache[h & (NCACHE - 1)];
-    PyObject *s = PyUnicode_FromStringAndSize(p, n);
-    if (!s) return NULL;
-    Py_XDECREF(e->obj);
-    e->p = p; e->n = n; e->obj = s;
-    Py_INCREF(s);
-    return s;
 }
 
 static PyObject *parse_wire(PyObject *self, PyObject *arg) {
@@ -658,7 +730,7 @@ static PyObject *parse_wire(PyObject *self, PyObject *arg) {
                     hex4(s + hb + 25, &counter)) {
                     bad = 0;
                     item_lt = (ms << 16) | counter;
-                    node_obj = cached_node(cache, s + hb + 30,
+                    node_obj = cached_str(cache, s + hb + 30,
                                            he - hb - 30);
                 } else {
                     bad = 1;
@@ -670,12 +742,12 @@ static PyObject *parse_wire(PyObject *self, PyObject *arg) {
                 if (!node_obj) goto item_fail;
             } else if (me - mb == 5 &&
                        memcmp(s + mb, "value", 5) == 0) {
-                PyObject *v = parse_json_value(&sc);
+                PyObject *v = parse_json_value(&sc, cache, 0);
                 if (!v) goto item_fail;
                 Py_XDECREF(value_obj);
                 value_obj = v;
             } else {
-                PyObject *v = parse_json_value(&sc);
+                PyObject *v = parse_json_value(&sc, cache, 0);
                 if (!v) goto item_fail;
                 Py_DECREF(v);
             }

@@ -319,3 +319,34 @@ def test_stale_so_cannot_load():
     # every symbol the Python side calls exists on the loaded module
     for sym in ("parse_hlc_batch", "format_hlc_batch", "parse_wire"):
         assert hasattr(mod, sym)
+
+
+def test_deeply_nested_value_falls_back_to_json_loads(codec,
+                                                      monkeypatch):
+    """Containers nested past the C recursion bound parse via
+    json.loads on the matched span — same object out."""
+    h = "2026-01-01T00:00:01.123Z-004D-n"
+    depth = 80   # beyond MAX_VALUE_DEPTH=48
+    v = "[" * depth + "1" + "]" * depth
+    payload = '{"a":{"hlc":"%s","value":%s}}' % (h, v)
+    keys, lt_buf, nodes, values, bad = codec.parse_wire(payload)
+    import json as json_mod
+    expect = json_mod.loads(v)
+    assert values[0] == expect
+    fast = crdt_json.decode_columns(payload)
+    monkeypatch.setattr(crdt_json.native, "load", lambda: None)
+    slow = crdt_json.decode_columns(payload)
+    monkeypatch.undo()
+    assert fast[3] == slow[3]
+
+
+def test_member_key_dedup_in_nested_values(codec):
+    h = "2026-01-01T00:00:01.123Z-004D-n"
+    # multi-char key: 1-char strings are interned by CPython anyway,
+    # which would make this assertion vacuous
+    payload = "{%s}" % ",".join(
+        '"k%d":{"hlc":"%s","value":{"shared_key":"x","i":%d}}' % (i, h, i)
+        for i in range(50))
+    keys, lt_buf, nodes, values, bad = codec.parse_wire(payload)
+    s_ids = {id(k) for v in values for k in v.keys() if k == "shared_key"}
+    assert len(s_ids) == 1   # member keys shared, json.loads-memo style
