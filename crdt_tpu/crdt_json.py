@@ -48,6 +48,16 @@ def _default(obj: Any) -> Any:
                     f"is not JSON serializable")
 
 
+# The wire format's one dumps configuration — compact separators,
+# raw UTF-8, to_json-hook default — shared by every export path
+# (including as the container/custom-object fallback handed to the C
+# `format_wire` assembler).
+import functools  # noqa: E402
+
+compact_dumps = functools.partial(json.dumps, separators=(",", ":"),
+                                  ensure_ascii=False, default=_default)
+
+
 def encode(record_map: Dict[Any, Record],
            key_encoder: Optional[KeyEncoder] = None,
            value_encoder: Optional[ValueEncoder] = None) -> str:
@@ -61,6 +71,26 @@ def encode(record_map: Dict[Any, Record],
         hlcs = codec.format_hlc_batch(
             [r.hlc.millis for r in recs], [r.hlc.counter for r in recs],
             [str(r.hlc.node_id) for r in recs])
+        if None not in hlcs:
+            # One-pass C assembly, byte-identical to the json.dumps
+            # of the dict below (scalar values serialize in C;
+            # containers/custom objects go through `dumps`). Colliding
+            # stringified keys must collapse dict-style, so those fall
+            # back to the dict build.
+            keys = ([dart_str(k) for k in record_map]
+                    if key_encoder is None
+                    else [key_encoder(k) for k in record_map])
+            if len(set(keys)) != len(keys):
+                keys = None
+            if keys is not None:
+                values = ([r.value for r in recs]
+                          if value_encoder is None
+                          else [value_encoder(k, r.value)
+                                for k, r in zip(record_map, recs)])
+                out = codec.format_wire(keys, hlcs, values,
+                                        compact_dumps)
+                if out is not None:
+                    return out
         obj = {}
         for (key, record), hlc_str in zip(record_map.items(), hlcs):
             k = dart_str(key) if key_encoder is None else key_encoder(key)

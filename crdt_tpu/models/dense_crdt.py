@@ -371,16 +371,14 @@ class DenseCrdt:
 
     def _to_json_fast(self, modified_since: Optional[Hlc]) -> Optional[str]:
         """Lane-direct wire export, or None to defer to the generic
-        path (no native codec; a node id that needs JSON escaping; an
-        out-of-range year)."""
+        path (no native codec; an out-of-range year; a node id that is
+        not UTF-8 encodable). Escape-needing node ids are handled by
+        the C assembler's JSON escaping."""
         from .. import native
         codec = native.load()
         if codec is None:
             return None
         id_strs = [str(n) for n in self._table.ids()]
-        if any('"' in s or "\\" in s or any(ord(c) < 0x20 for c in s)
-               for s in id_strs):
-            return None  # embedded hlc strings would need escaping
         mask = self._delta_mask(modified_since)
         # `modified` is local-only and never serialized
         # (record.dart:28-31) — the wire fetch skips those lanes.
@@ -396,12 +394,13 @@ class DenseCrdt:
             np.array(id_strs, object)[node[idx]].tolist())
         if None in hlcs:
             return None  # year outside 0001-9999: generic path raises
-        parts = [
-            f'"{slot}":{{"hlc":"{h}","value":{"null" if tb else v}}}'
-            for slot, h, v, tb in zip(idx.tolist(), hlcs,
-                                      val[idx].tolist(), tomb[idx].tolist())
-        ]
-        return "{" + ",".join(parts) + "}"
+        # C one-pass assembly (int slot keys; escape-safe for any node
+        # id). Values: int, or None for tombstones — all scalars, so
+        # the dumps fallback never fires, but pass the real one anyway.
+        values = [None if tb else v
+                  for v, tb in zip(val[idx].tolist(), tomb[idx].tolist())]
+        return codec.format_wire(idx.tolist(), hlcs, values,
+                                 crdt_json.compact_dumps)
 
     def merge_records(self, record_map: Dict[int, Record]) -> None:
         """Fan-in a record dict (from a MapCrdt/TpuMapCrdt peer or a

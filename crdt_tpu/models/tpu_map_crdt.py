@@ -371,16 +371,23 @@ class TpuMapCrdt(Crdt[K, V]):
         keys = self._slot_keys
         payload = self._payload
         kenc = crdt_json.dart_str if key_encoder is None else key_encoder
+        slot_list = idx.tolist()
+        key_strs = [kenc(keys[s]) for s in slot_list]
         if value_encoder is None:
-            obj = {kenc(keys[s]): {"hlc": h, "value": payload[s]}
-                   for s, h in zip(idx.tolist(), hlcs)}
+            values = [payload[s] for s in slot_list]
         else:
-            obj = {kenc(keys[s]):
-                   {"hlc": h, "value": value_encoder(keys[s], payload[s])}
-                   for s, h in zip(idx.tolist(), hlcs)}
-        return json_mod.dumps(obj, separators=(",", ":"),
-                              ensure_ascii=False,
-                              default=crdt_json._default)
+            values = [value_encoder(keys[s], payload[s])
+                      for s in slot_list]
+        dumps = crdt_json.compact_dumps
+        if len(set(key_strs)) == len(key_strs):
+            out = codec.format_wire(key_strs, hlcs, values, dumps)
+            if out is not None:
+                return out
+        # colliding stringified keys collapse dict-style (last value,
+        # first position) — same as the generic path
+        obj = {k: {"hlc": h, "value": v}
+               for k, h, v in zip(key_strs, hlcs, values)}
+        return dumps(obj)
 
     def watch(self, key: Optional[K] = None) -> ChangeStream:
         return self._hub.stream(key)

@@ -167,7 +167,18 @@ static PyObject *format_hlc_batch(PyObject *self, PyObject *args) {
         PyObject *node_o = PyList_GET_ITEM(node_l, i);
         Py_ssize_t nlen;
         const char *node = PyUnicode_AsUTF8AndSize(node_o, &nlen);
-        if (!node) { Py_DECREF(out); return NULL; }
+        if (!node) {
+            if (PyErr_ExceptionMatches(PyExc_UnicodeEncodeError)) {
+                /* lone-surrogate node id: not UTF-8 encodable, but the
+                 * pure-Python formatter handles it — defer the item. */
+                PyErr_Clear();
+                Py_INCREF(Py_None);
+                PyList_SET_ITEM(out, i, Py_None);
+                continue;
+            }
+            Py_DECREF(out);
+            return NULL;
+        }
 
         long long secs = ms >= 0 ? ms / 1000 : (ms - 999) / 1000;
         int frac = (int)(ms - secs * 1000);
@@ -999,6 +1010,222 @@ static PyObject *scatter_payload(PyObject *self, PyObject *args) {
     Py_RETURN_NONE;
 }
 
+/* ================== wire JSON assembler ==================
+ *
+ * format_wire(keys, hlcs, values, dumps) -> str | None
+ * Assembles `{"key":{"hlc":"...","value":V},...}` from parallel lists
+ * in one pass, byte-identical to
+ *   json.dumps(obj, separators=(",",":"), ensure_ascii=False, ...)
+ * over the dict the Python paths would build. Keys are str (already
+ * stringified by the caller) or int (dense slot exports); hlc strings
+ * come from format_hlc_batch; scalar values (None/bool/int/float/str)
+ * serialize natively, anything else goes through the `dumps` callable
+ * (so custom to_json hooks keep working). Returns None only for
+ * argument shapes it does not model (caller falls back). */
+
+typedef struct {
+    char *p;
+    size_t len, cap;
+} WBuf;
+
+/* UTF-8 view of a str, or NULL. Lone surrogates are not UTF-8
+ * encodable but json.dumps(ensure_ascii=False) still serializes
+ * them — so on UnicodeEncodeError set *defer (caller returns None
+ * for the whole payload and the Python path takes over), matching
+ * parse_wire's precedent. Other errors propagate. */
+static const char *wire_utf8(PyObject *o, Py_ssize_t *n, int *defer) {
+    const char *u = PyUnicode_AsUTF8AndSize(o, n);
+    if (!u && PyErr_ExceptionMatches(PyExc_UnicodeEncodeError)) {
+        PyErr_Clear();
+        *defer = 1;
+    }
+    return u;
+}
+
+static int wbuf_grow(WBuf *b, size_t need) {
+    if (b->len + need <= b->cap) return 1;
+    size_t ncap = b->cap ? b->cap : 4096;
+    while (b->len + need > ncap) ncap *= 2;
+    char *np = (char *)PyMem_Realloc(b->p, ncap);
+    if (!np) { PyErr_NoMemory(); return 0; }
+    b->p = np; b->cap = ncap;
+    return 1;
+}
+
+static int wbuf_put(WBuf *b, const char *s, size_t n) {
+    if (!wbuf_grow(b, n)) return 0;
+    memcpy(b->p + b->len, s, n);
+    b->len += n;
+    return 1;
+}
+
+/* JSON string-escape (ensure_ascii=False rules: escape ", backslash,
+ * and control chars — \b \t \n \f \r short forms, \u00XX otherwise;
+ * non-ASCII passes through as raw UTF-8). */
+static int wbuf_put_escaped(WBuf *b, const char *s, Py_ssize_t n) {
+    if (!wbuf_grow(b, (size_t)n + 2)) return 0;
+    for (Py_ssize_t i = 0; i < n; i++) {
+        unsigned char c = (unsigned char)s[i];
+        if (c == '"' || c == '\\') {
+            char e[2] = {'\\', (char)c};
+            if (!wbuf_put(b, e, 2)) return 0;
+        } else if (c >= 0x20) {
+            if (!wbuf_grow(b, 1)) return 0;
+            b->p[b->len++] = (char)c;
+        } else {
+            char e[8];
+            int w;
+            switch (c) {
+            case '\b': memcpy(e, "\\b", 2); w = 2; break;
+            case '\t': memcpy(e, "\\t", 2); w = 2; break;
+            case '\n': memcpy(e, "\\n", 2); w = 2; break;
+            case '\f': memcpy(e, "\\f", 2); w = 2; break;
+            case '\r': memcpy(e, "\\r", 2); w = 2; break;
+            default:
+                w = snprintf(e, sizeof e, "\\u%04x", c);
+            }
+            if (!wbuf_put(b, e, (size_t)w)) return 0;
+        }
+    }
+    return 1;
+}
+
+/* One JSON value; 1 on success, 0 on error, -1 when the caller must
+ * use the dumps fallback for this value. */
+static int wbuf_put_scalar(WBuf *b, PyObject *v) {
+    if (v == Py_None) return wbuf_put(b, "null", 4);
+    if (v == Py_True) return wbuf_put(b, "true", 4);
+    if (v == Py_False) return wbuf_put(b, "false", 5);
+    if (PyLong_CheckExact(v)) {
+        int overflow = 0;
+        long long x = PyLong_AsLongLongAndOverflow(v, &overflow);
+        if (!overflow) {
+            if (x == -1 && PyErr_Occurred()) return 0;
+            char d[32];
+            return wbuf_put(b, d, (size_t)snprintf(d, sizeof d,
+                                                   "%lld", x));
+        }
+        PyObject *s = PyObject_Str(v);   /* big int */
+        if (!s) return 0;
+        Py_ssize_t n;
+        const char *u = PyUnicode_AsUTF8AndSize(s, &n);
+        int ok = u && wbuf_put(b, u, (size_t)n);
+        Py_DECREF(s);
+        return ok;
+    }
+    if (PyFloat_CheckExact(v)) {
+        double x = PyFloat_AS_DOUBLE(v);
+        /* json.dumps default: allow_nan=True emits these literals */
+        if (x != x) return wbuf_put(b, "NaN", 3);
+        if (x > 1.7976931348623157e308)
+            return wbuf_put(b, "Infinity", 8);
+        if (x < -1.7976931348623157e308)
+            return wbuf_put(b, "-Infinity", 9);
+        char *r = PyOS_double_to_string(x, 'r', 0, Py_DTSF_ADD_DOT_0,
+                                        NULL);
+        if (!r) return 0;
+        int ok = wbuf_put(b, r, strlen(r));
+        PyMem_Free(r);
+        return ok;
+    }
+    if (PyUnicode_CheckExact(v)) {
+        Py_ssize_t n;
+        int defer = 0;
+        const char *u = wire_utf8(v, &n, &defer);
+        if (!u) return defer ? -2 : 0;
+        return (wbuf_put(b, "\"", 1) && wbuf_put_escaped(b, u, n)
+                && wbuf_put(b, "\"", 1));
+    }
+    return -1;   /* container / custom object: dumps fallback */
+}
+
+static PyObject *format_wire(PyObject *self, PyObject *args) {
+    PyObject *keys, *hlcs, *values, *dumps;
+    if (!PyArg_ParseTuple(args, "O!O!O!O", &PyList_Type, &keys,
+                          &PyList_Type, &hlcs, &PyList_Type, &values,
+                          &dumps))
+        return NULL;
+    Py_ssize_t n = PyList_GET_SIZE(keys);
+    if (PyList_GET_SIZE(hlcs) != n || PyList_GET_SIZE(values) != n) {
+        PyErr_SetString(PyExc_ValueError, "length mismatch");
+        return NULL;
+    }
+    WBuf b = {NULL, 0, 0};
+    if (!wbuf_put(&b, "{", 1)) goto fail;
+    for (Py_ssize_t i = 0; i < n; i++) {
+        if (i && !wbuf_put(&b, ",", 1)) goto fail;
+        PyObject *key = PyList_GET_ITEM(keys, i);
+        if (PyUnicode_CheckExact(key)) {
+            Py_ssize_t kn;
+            int kdefer = 0;
+            const char *ku = wire_utf8(key, &kn, &kdefer);
+            if (!ku) {
+                if (kdefer) { PyMem_Free(b.p); Py_RETURN_NONE; }
+                goto fail;
+            }
+            if (!wbuf_put(&b, "\"", 1) ||
+                !wbuf_put_escaped(&b, ku, kn) ||
+                !wbuf_put(&b, "\"", 1)) goto fail;
+        } else if (PyLong_CheckExact(key)) {
+            int overflow = 0;
+            long long x = PyLong_AsLongLongAndOverflow(key, &overflow);
+            if (overflow || (x == -1 && PyErr_Occurred())) {
+                PyErr_Clear();
+                PyMem_Free(b.p);
+                Py_RETURN_NONE;   /* exotic key: caller falls back */
+            }
+            char d[36];
+            int w = snprintf(d, sizeof d, "\"%lld\"", x);
+            if (!wbuf_put(&b, d, (size_t)w)) goto fail;
+        } else {
+            PyMem_Free(b.p);
+            Py_RETURN_NONE;       /* caller stringifies, then retries */
+        }
+        if (!wbuf_put(&b, ":{\"hlc\":\"", 9)) goto fail;
+        PyObject *h = PyList_GET_ITEM(hlcs, i);
+        Py_ssize_t hn;
+        int hdefer = 0;
+        const char *hu = PyUnicode_CheckExact(h)
+            ? wire_utf8(h, &hn, &hdefer) : NULL;
+        if (!hu) {
+            if (hdefer) { PyMem_Free(b.p); Py_RETURN_NONE; }
+            if (!PyErr_Occurred())
+                PyErr_SetString(PyExc_TypeError, "hlc must be str");
+            goto fail;
+        }
+        if (!wbuf_put_escaped(&b, hu, hn)) goto fail;
+        if (!wbuf_put(&b, "\",\"value\":", 10)) goto fail;
+        PyObject *v = PyList_GET_ITEM(values, i);
+        int rc = wbuf_put_scalar(&b, v);
+        if (rc == 0) goto fail;
+        if (rc == -2) { PyMem_Free(b.p); Py_RETURN_NONE; }
+        if (rc < 0) {
+            PyObject *s = PyObject_CallFunctionObjArgs(dumps, v, NULL);
+            if (!s) goto fail;
+            Py_ssize_t sn;
+            int sdefer = 0;
+            const char *su = wire_utf8(s, &sn, &sdefer);
+            int ok = su && wbuf_put(&b, su, (size_t)sn);
+            Py_DECREF(s);
+            if (!ok) {
+                if (sdefer) { PyMem_Free(b.p); Py_RETURN_NONE; }
+                goto fail;
+            }
+        }
+        if (!wbuf_put(&b, "}", 1)) goto fail;
+    }
+    if (!wbuf_put(&b, "}", 1)) goto fail;
+    {
+        PyObject *out = PyUnicode_DecodeUTF8(b.p, (Py_ssize_t)b.len,
+                                             NULL);
+        PyMem_Free(b.p);
+        return out;
+    }
+fail:
+    PyMem_Free(b.p);
+    return NULL;
+}
+
 static PyMethodDef methods[] = {
     {"parse_hlc_batch", parse_hlc_batch, METH_O,
      "Batch-parse canonical HLC wire strings."},
@@ -1006,6 +1233,8 @@ static PyMethodDef methods[] = {
      "Batch-format HLC components to wire strings."},
     {"parse_wire", parse_wire, METH_O,
      "One-pass columnar scan of a wire JSON payload."},
+    {"format_wire", format_wire, METH_VARARGS,
+     "Assemble a wire JSON payload from parallel columns."},
     {"ensure_slots", ensure_slots, METH_VARARGS,
      "Batch get-or-insert of keys into a key->slot dict."},
     {"none_mask", none_mask, METH_O,

@@ -350,3 +350,80 @@ def test_member_key_dedup_in_nested_values(codec):
     keys, lt_buf, nodes, values, bad = codec.parse_wire(payload)
     s_ids = {id(k) for v in values for k in v.keys() if k == "shared_key"}
     assert len(s_ids) == 1   # member keys shared, json.loads-memo style
+
+
+class TestWireAssembler:
+    """`format_wire` one-pass JSON assembly: byte-identity with the
+    json.dumps dict build across the value/key space."""
+
+    def _dumps(self):
+        import functools
+        import json as json_mod
+        return functools.partial(json_mod.dumps, separators=(",", ":"),
+                                 ensure_ascii=False)
+
+    def test_scalar_space_byte_identity(self, codec):
+        import json as json_mod
+        h = "2026-01-01T00:00:01.123Z-004D-n"
+        values = [None, True, False, 0, -7, 10 ** 30, -(10 ** 30),
+                  1.5, -0.0, 2.5e-10, float("nan"), float("inf"),
+                  float("-inf"), "plain", 'q"uo\\te', "tab\there",
+                  "ctrl\x01\x1f", "émoji😀", "", {"n": [1, None]},
+                  [1, "two", 3.5]]
+        keys = [f"key-{i}é" for i in range(len(values))]
+        out = codec.format_wire(keys, [h] * len(values), values,
+                                self._dumps())
+        expect = json_mod.dumps(
+            {k: {"hlc": h, "value": v} for k, v in zip(keys, values)},
+            separators=(",", ":"), ensure_ascii=False)
+        assert out == expect
+
+    def test_int_keys_and_escaped_hlc(self, codec):
+        import json as json_mod
+        h = '2026-01-01T00:00:01.123Z-004D-n"quote\\x'
+        out = codec.format_wire([0, 42, -3], [h] * 3, [1, None, 2],
+                                self._dumps())
+        expect = json_mod.dumps(
+            {"0": {"hlc": h, "value": 1}, "42": {"hlc": h, "value": None},
+             "-3": {"hlc": h, "value": 2}},
+            separators=(",", ":"), ensure_ascii=False)
+        assert out == expect
+
+    def test_exotic_key_defers(self, codec):
+        h = "2026-01-01T00:00:01.123Z-004D-n"
+        assert codec.format_wire([("tuple",)], [h], [1],
+                                 self._dumps()) is None
+        assert codec.format_wire([1 << 80], [h], [1],
+                                 self._dumps()) is None
+
+    def test_encode_collision_falls_back_to_dict_semantics(self):
+        # dart_str(3) == dart_str("3"): colliding stringified keys
+        # must collapse dict-style, exactly like the generic path.
+        from crdt_tpu import Hlc, Record
+        h = Hlc(1_700_000_000_000, 0, "n")
+        rm = {3: Record(h, "int3", h), "3": Record(h, "str3", h)}
+        out = crdt_json.encode(rm)
+        import json as json_mod
+        parsed = json_mod.loads(out)
+        assert parsed == {"3": {"hlc": str(h), "value": "str3"}}
+
+    def test_empty(self, codec):
+        assert codec.format_wire([], [], [], self._dumps()) == "{}"
+
+
+def test_surrogate_values_defer_to_python_encode(codec):
+    """Lone surrogates (not UTF-8 encodable) anywhere in the payload —
+    value, key, node id — must defer the C paths, never raise; the
+    Python encoder serializes them like json.dumps does."""
+    import json as json_mod
+    from crdt_tpu import Record
+    h = Hlc(1_700_000_000_000, 0, "n")
+    cases = [
+        {"k": Record(h, "x\ud800y", h)},                     # value
+        {"k\ud800": Record(h, 1, h)},                        # key
+        {"k": Record(Hlc(1_700_000_000_000, 0, "n\ud800"),   # node id
+                     1, Hlc(1_700_000_000_000, 0, "n\ud800"))},
+    ]
+    for rm in cases:
+        out = crdt_json.encode(rm)
+        assert json_mod.loads(out)  # round-trips through json.loads
