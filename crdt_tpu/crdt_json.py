@@ -64,40 +64,36 @@ def encode(record_map: Dict[Any, Record],
     """Map of records -> wire JSON string (crdt_json.dart:8-17)."""
     codec = native.load()
     if codec is not None and record_map:
-        # Batch-format the HLC strings natively; None entries (years
-        # outside 0001-9999) fall back to the Python formatter (which
-        # raises, keeping native and pure codecs behaviorally equal).
+        # Batch-format the HLC strings natively. None entries defer to
+        # the Python formatter per record: out-of-window years (which
+        # raise there) and non-UTF-8 node ids (which serialize fine).
         recs = list(record_map.values())
         hlcs = codec.format_hlc_batch(
             [r.hlc.millis for r in recs], [r.hlc.counter for r in recs],
             [str(r.hlc.node_id) for r in recs])
-        if None not in hlcs:
-            # One-pass C assembly, byte-identical to the json.dumps
-            # of the dict below (scalar values serialize in C;
-            # containers/custom objects go through `dumps`). Colliding
-            # stringified keys must collapse dict-style, so those fall
-            # back to the dict build.
-            keys = ([dart_str(k) for k in record_map]
-                    if key_encoder is None
-                    else [key_encoder(k) for k in record_map])
-            if len(set(keys)) != len(keys):
-                keys = None
-            if keys is not None:
-                values = ([r.value for r in recs]
-                          if value_encoder is None
-                          else [value_encoder(k, r.value)
-                                for k, r in zip(record_map, recs)])
-                out = codec.format_wire(keys, hlcs, values,
-                                        compact_dumps)
-                if out is not None:
-                    return out
+        # Keys/values are computed ONCE and shared with the dict
+        # fallback below — user encoders must not be double-called
+        # when format_wire defers (surrogates, key collisions).
+        keys = ([dart_str(k) for k in record_map]
+                if key_encoder is None
+                else [key_encoder(k) for k in record_map])
+        values = ([r.value for r in recs] if value_encoder is None
+                  else [value_encoder(k, r.value)
+                        for k, r in zip(record_map, recs)])
+        if None not in hlcs and len(set(keys)) == len(keys):
+            # One-pass C assembly, byte-identical to the json.dumps of
+            # the dict below (scalar values serialize in C; containers
+            # and custom objects go through `compact_dumps`). Colliding
+            # stringified keys must collapse dict-style, so those use
+            # the dict build instead.
+            out = codec.format_wire(keys, hlcs, values, compact_dumps)
+            if out is not None:
+                return out
         obj = {}
-        for (key, record), hlc_str in zip(record_map.items(), hlcs):
-            k = dart_str(key) if key_encoder is None else key_encoder(key)
+        for k, record, hlc_str, v in zip(keys, recs, hlcs, values):
             obj[k] = {
                 "hlc": record.hlc.to_json() if hlc_str is None else hlc_str,
-                "value": (record.value if value_encoder is None
-                          else value_encoder(key, record.value)),
+                "value": v,
             }
     else:
         obj = {

@@ -393,7 +393,9 @@ class DenseCrdt:
             (lt[idx] >> SHIFT).tolist(), (lt[idx] & MAX_COUNTER).tolist(),
             np.array(id_strs, object)[node[idx]].tolist())
         if None in hlcs:
-            return None  # year outside 0001-9999: generic path raises
+            # deferred item: out-of-window year (generic path raises)
+            # or a non-UTF-8 node id (generic path serializes it)
+            return None
         # C one-pass assembly (int slot keys; escape-safe for any node
         # id). Values: int, or None for tombstones — all scalars, so
         # the dumps fallback never fires, but pass the real one anyway.

@@ -347,7 +347,6 @@ class TpuMapCrdt(Crdt[K, V]):
         order), which remains the fallback when the native codec is
         unavailable or a year falls outside the 1-9999 wire window."""
         from .. import native
-        import json as json_mod
         codec = native.load()
         if codec is None:
             return super().to_json(modified_since,
@@ -363,8 +362,9 @@ class TpuMapCrdt(Crdt[K, V]):
             (l.lt[idx] & MAX_COUNTER).tolist(),
             id_strs[l.node[idx]].tolist())
         if None in hlcs:
-            # out-of-window year: the generic encoder raises with the
-            # reference's fail-fast message
+            # deferred item: an out-of-window year (the generic encoder
+            # raises the reference's fail-fast message) or a non-UTF-8
+            # node id (the generic encoder serializes it)
             return super().to_json(modified_since,
                                    key_encoder=key_encoder,
                                    value_encoder=value_encoder)
