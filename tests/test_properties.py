@@ -253,6 +253,22 @@ lane_hlcs = st.builds(
     counters, nodes)
 
 
+
+
+def _veq(a, b):
+    """Strict-type value equality: True != 1, 1 != 1.0 — a codec that
+    coerces types must fail; NaN equals NaN."""
+    if type(a) is not type(b):
+        return False
+    if isinstance(a, float):
+        return a == b or (a != a and b != b)
+    if isinstance(a, list):
+        return len(a) == len(b) and all(
+            _veq(x, y) for x, y in zip(a, b))
+    if isinstance(a, dict):
+        return a.keys() == b.keys() and all(_veq(a[k], b[k]) for k in a)
+    return a == b
+
 class TestWireScannerProperties:
     @given(st.dictionaries(st.text(max_size=8),
                            st.tuples(lane_hlcs, json_values),
@@ -324,3 +340,61 @@ class TestWireScannerProperties:
         rec = crdt_json_mod.decode(payload, Hlc(0, 0, "local"),
                                    now_millis=0)
         assert rec["k"].hlc == h
+
+    @staticmethod
+    def _assert_fast_matches_pure(junk):
+        """Differential harness: the native scan of ``junk`` must have
+        the same outcome as the pure path — same exception type, or
+        equal columns (keys, lt, nodes, values) — never a crash or a
+        silent wrong answer."""
+        from unittest import mock
+
+        import numpy as np
+
+        import crdt_tpu.crdt_json as cj
+
+        def run():
+            try:
+                return cj.decode_columns(junk), None
+            except Exception as e:
+                return None, type(e)
+
+        fast, fast_exc = run()
+        with mock.patch.object(cj.native, "load", lambda: None):
+            slow, slow_exc = run()
+        assert fast_exc == slow_exc
+        if fast is not None:
+            assert fast[0] == slow[0]
+            assert np.array_equal(fast[1], slow[1])
+            assert list(fast[2]) == list(slow[2])
+            assert len(fast[3]) == len(slow[3])
+            assert all(_veq(a, b) for a, b in zip(fast[3], slow[3]))
+
+    @given(st.text(max_size=200))
+    def test_scanner_never_crashes_on_junk(self, junk):
+        self._assert_fast_matches_pure(junk)
+
+    @given(st.text(alphabet='{}[]",:\\ \t\n0123456789.eE+-truefalsn'
+                            'hlcvalue\ud800é',
+                   max_size=120))
+    def test_scanner_never_crashes_on_jsonish_junk(self, junk):
+        """Biased toward JSON-structural characters (braces, quotes,
+        escapes, literals, surrogates) so valid-payload fragments are
+        actually reachable."""
+        self._assert_fast_matches_pure(junk)
+
+    @given(st.dictionaries(st.text(max_size=6), json_values,
+                           max_size=10))
+    def test_assembler_roundtrips_arbitrary_values(self, kv):
+        """encode -> decode round trip over the full JSON value space
+        (C assembly on the way out, C scan on the way back)."""
+        from crdt_tpu import MapCrdt
+        from crdt_tpu.testing import FakeClock
+        src = MapCrdt("src", wall_clock=FakeClock(
+            start=1_700_000_000_000))
+        src.put_all(kv)
+        dst = MapCrdt("dst", wall_clock=FakeClock(
+            start=1_700_000_000_500))
+        dst.merge_json(src.to_json())
+        assert dst.map.keys() == src.map.keys()
+        assert all(_veq(dst.map[k], src.map[k]) for k in src.map)
