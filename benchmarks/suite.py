@@ -100,6 +100,17 @@ def bench_payload_wire(n_keys=10_000, repeats=3):
         "wire-json-columnar", n_keys, repeats, sync_key="key-0")
 
 
+def bench_payload_wire_sqlite(n_keys=10_000, repeats=3):
+    """Config 5 on the durable backend — what persistence costs: the
+    same decode feeds per-record SQL upserts (plugin-pattern backend,
+    README.md:39)."""
+    from crdt_tpu import SqliteCrdt
+    return _bench_wire(
+        lambda: SqliteCrdt("local", wall_clock=FakeClock(start=_MILLIS + 10)),
+        f"wire_json_sqlite_{n_keys}key_varlen_payload_merges_per_sec",
+        "wire-json-sqlite-durable", n_keys, repeats)
+
+
 def bench_dense_to_json(n_slots=1 << 20, repeats=3):
     """1M-slot full wire export on the dense model (the interop contract
     crdt.dart:124-135 at dense scale): lane-direct C-codec formatting."""
@@ -180,6 +191,7 @@ def main():
     emit(lambda: bench(1 << 20, 1024, 8, config="tiebreak", repeats=64))
     emit(bench_payload_wire)
     emit(bench_payload_wire_oracle)
+    emit(bench_payload_wire_sqlite)
     # 1M-key wire ingest: the drop-in backend vs the oracle at the
     # scale DenseCrdt stores actually run at.
     emit(lambda: bench_payload_wire(n_keys=1 << 20, repeats=1))
