@@ -31,9 +31,10 @@ from .models.tpu_map_crdt import TpuMapCrdt
 from .models.dense_crdt import DenseCrdt, ShardedDenseCrdt, sync_dense
 from .models.sqlite_crdt import SqliteCrdt
 from .sync import sync, sync_json
+from .net import SyncServer, sync_over_tcp
 from .checkpoint import load_dense, load_json, save_dense, save_json
 
-__version__ = "0.3.0"
+__version__ = "0.4.6"
 
 __all__ = [
     "Hlc", "ClockDriftException", "DuplicateNodeException",
@@ -42,6 +43,6 @@ __all__ = [
     "ValueEncoder", "Crdt", "CrdtJson", "dart_str", "ChangeEvent",
     "ChangeStream", "MapCrdt", "TpuMapCrdt", "DenseCrdt",
     "ShardedDenseCrdt", "sync_dense", "SqliteCrdt",
-    "sync", "sync_json",
+    "sync", "sync_json", "SyncServer", "sync_over_tcp",
     "load_dense", "load_json", "save_dense", "save_json",
 ]

@@ -75,9 +75,15 @@ class SqliteCrdt(Crdt[K, V], Generic[K, V]):
                  key_decoder: Optional[Callable[[str], K]] = None,
                  value_encoder: Optional[Callable[[V], Any]] = None,
                  value_decoder: Optional[Callable[[Any], V]] = None,
-                 node_decoder: Optional[Callable[[str], Any]] = None):
+                 node_decoder: Optional[Callable[[str], Any]] = None,
+                 check_same_thread: bool = True):
         self._node_id = node_id
-        self._conn = sqlite3.connect(path)
+        # check_same_thread=False is required to serve this replica
+        # from another thread (e.g. `crdt_tpu.net.SyncServer`); the
+        # single-threaded-replica contract still applies — ALL access
+        # must be externally serialized (the server's lock does this).
+        self._conn = sqlite3.connect(
+            path, check_same_thread=check_same_thread)
         self._conn.executescript(_SCHEMA)
         self._key_enc = key_encoder or str
         self._key_dec = key_decoder or (lambda s: s)

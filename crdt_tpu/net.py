@@ -1,0 +1,252 @@
+"""TCP replication endpoints (stdlib sockets, no dependencies).
+
+The reference deliberately leaves transport to the application — its
+example mocks the remote with a function returning a JSON string
+(example/crdt_example.dart:21-25). This module is that boundary made
+concrete: a :class:`SyncServer` exposes any `Crdt` over one TCP
+listener, and :func:`sync_over_tcp` runs the reference's anti-entropy
+round against it (full push + inclusive delta pull,
+test/map_crdt_test.dart:273-279). Nothing crosses the wire but the
+JSON format (crdt_json.dart:8-37), length-prefixed.
+
+Frames (4-byte big-endian length + UTF-8 JSON):
+
+    client -> server  {"op": "push",  "payload": <wire json>}
+    server -> client  {"ok": true}
+    client -> server  {"op": "delta", "since": <hlc str> | null}
+    server -> client  {"payload": <wire json>}
+    client -> server  {"op": "bye"}
+
+Threading model: replicas are single-threaded state machines (same
+contract as the reference's isolate model — see SqliteCrdt's notes).
+The server serializes ALL replica access through :attr:`SyncServer.lock`;
+an application that also writes locally from another thread must take
+the same lock around its own operations. To serve a `SqliteCrdt`,
+construct it with ``check_same_thread=False`` (sqlite3's own thread
+guard; the server's lock provides the actual serialization).
+"""
+
+from __future__ import annotations
+
+import json
+import socket
+import struct
+import threading
+from typing import Any, Optional
+
+from .crdt import Crdt
+from .hlc import Hlc
+
+
+# A 1M-record full-state payload is ~100 MB; anything near this cap
+# is a corrupt stream or a peer speaking another protocol — reject
+# before allocating, never trust a 4-byte prefix with 4 GiB.
+MAX_FRAME_BYTES = 1 << 30
+
+
+def send_frame(sock: socket.socket, obj: Any) -> None:
+    data = json.dumps(obj).encode()
+    if len(data) > MAX_FRAME_BYTES:
+        raise ValueError(f"frame of {len(data)} bytes exceeds "
+                         f"MAX_FRAME_BYTES ({MAX_FRAME_BYTES})")
+    sock.sendall(struct.pack(">I", len(data)) + data)
+
+
+def recv_frame(sock: socket.socket) -> Optional[Any]:
+    head = _recv_exact(sock, 4)
+    if head is None:
+        return None
+    (n,) = struct.unpack(">I", head)
+    if n > MAX_FRAME_BYTES:
+        raise ValueError(f"peer announced a {n}-byte frame (cap "
+                         f"{MAX_FRAME_BYTES}); corrupt stream?")
+    body = _recv_exact(sock, n)
+    return None if body is None else json.loads(body)
+
+
+def _recv_exact(sock: socket.socket, n: int) -> Optional[bytes]:
+    buf = bytearray()
+    while len(buf) < n:
+        chunk = sock.recv(min(n - len(buf), 1 << 20))
+        if not chunk:
+            return None
+        buf += chunk
+    return bytes(buf)
+
+
+class SyncServer:
+    """Serve a replica's merge/delta surface over TCP.
+
+    One connection is handled at a time (replication rounds are short
+    and the replica is single-threaded anyway); each request holds
+    :attr:`lock` while it touches the replica.
+
+    >>> server = SyncServer(crdt)          # port 0 = ephemeral
+    >>> server.start()
+    >>> ... sync_over_tcp(other, "host", server.port) ...
+    >>> server.stop()
+    """
+
+    def __init__(self, crdt: Crdt, host: str = "127.0.0.1",
+                 port: int = 0,
+                 key_encoder=None, value_encoder=None,
+                 key_decoder=None, value_decoder=None):
+        self.crdt = crdt
+        self.lock = threading.Lock()
+        # codec passthrough, mirroring sync.sync_json: replicas with
+        # custom-typed keys/values need the same coders over TCP
+        self._kenc, self._venc = key_encoder, value_encoder
+        self._kdec, self._vdec = key_decoder, value_decoder
+        self._active: Optional[socket.socket] = None
+        self._lsock = socket.create_server((host, port))
+        self._lsock.settimeout(0.2)  # poll the stop flag
+        self.host, self.port = self._lsock.getsockname()[:2]
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    def start(self) -> "SyncServer":
+        self._thread = threading.Thread(target=self._serve, daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        """Stop serving and wait for quiescence: the active
+        connection (a handler may be blocked in a 30 s recv) is shut
+        down so the serve thread exits promptly — after stop()
+        returns, no server-side thread touches the replica again."""
+        self._stop.set()
+        active = self._active
+        if active is not None:
+            try:
+                active.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass
+        if self._thread is not None:
+            self._thread.join(timeout=60)
+            if self._thread.is_alive():   # must not silently leak
+                raise RuntimeError(
+                    "SyncServer thread failed to stop; the replica "
+                    "may still be accessed — do not reuse it")
+        self._lsock.close()
+
+    def __enter__(self) -> "SyncServer":
+        return self.start()
+
+    def __exit__(self, *exc) -> None:
+        self.stop()
+
+    def _serve(self) -> None:
+        while not self._stop.is_set():
+            try:
+                conn, _ = self._lsock.accept()
+            except socket.timeout:
+                continue
+            except OSError:
+                if self._stop.is_set():
+                    return
+                # transient accept failure (e.g. EMFILE): the
+                # listener is still bound — keep serving
+                self._stop.wait(0.05)
+                continue
+            with conn:
+                self._active = conn
+                try:
+                    self._handle(conn)
+                except Exception:
+                    # one misbehaving peer must never take the server
+                    # down for everyone else
+                    pass
+                finally:
+                    self._active = None
+
+    def _handle(self, conn: socket.socket) -> None:
+        conn.settimeout(30)
+        while not self._stop.is_set():
+            try:
+                msg = recv_frame(conn)
+            except (socket.timeout, OSError, ValueError):
+                return
+            if msg is None or not isinstance(msg, dict) \
+                    or msg.get("op") == "bye":
+                return
+            op = msg.get("op")
+            if op == "push":
+                try:
+                    with self.lock:
+                        self.crdt.merge_json(msg["payload"],
+                                             key_decoder=self._kdec,
+                                             value_decoder=self._vdec)
+                except Exception as e:
+                    # clock guards (duplicate node, drift) reject the
+                    # push; the server survives and tells the client
+                    self._reply(conn, {"ok": False,
+                                       "error": type(e).__name__,
+                                       "detail": str(e)})
+                    return
+                if not self._reply(conn, {"ok": True}):
+                    return
+            elif op == "delta":
+                try:
+                    since = msg.get("since")
+                    with self.lock:
+                        payload = self.crdt.to_json(
+                            modified_since=None if since is None
+                            else Hlc.parse(since),
+                            key_encoder=self._kenc,
+                            value_encoder=self._venc)
+                except Exception as e:
+                    # e.g. an unparseable `since` watermark
+                    self._reply(conn, {"error": type(e).__name__,
+                                       "detail": str(e)})
+                    return
+                if not self._reply(conn, {"payload": payload}):
+                    return
+            else:
+                self._reply(conn, {"error": f"unknown op {op!r}"})
+                return
+
+    @staticmethod
+    def _reply(conn: socket.socket, obj: Any) -> bool:
+        """Send a reply; a peer that vanished mid-reply just ends the
+        connection, never the server."""
+        try:
+            send_frame(conn, obj)
+            return True
+        except (OSError, ValueError):
+            return False
+
+
+def sync_over_tcp(crdt: Crdt, host: str, port: int,
+                  since: Optional[Hlc] = None,
+                  timeout: float = 30.0,
+                  key_encoder=None, value_encoder=None,
+                  key_decoder=None, value_decoder=None) -> Hlc:
+    """One anti-entropy round against a :class:`SyncServer`.
+
+    ``since`` is this replica's delta watermark: pass None on first
+    contact with a peer (cold start — a fresh replica has seen
+    nothing, so the pull must be full) and the returned watermark on
+    later rounds. The watermark is captured BEFORE pushing, exactly
+    like the reference's `_sync` (test/map_crdt_test.dart:273-279);
+    the inclusive `modified >= since` bound (map_crdt.dart:44-45)
+    then guarantees nothing stamped after it is missed.
+    """
+    watermark = crdt.canonical_time
+    with socket.create_connection((host, port), timeout=timeout) as sock:
+        sock.settimeout(timeout)
+        send_frame(sock, {"op": "push",
+                          "payload": crdt.to_json(
+                              key_encoder=key_encoder,
+                              value_encoder=value_encoder)})
+        reply = recv_frame(sock)
+        if not (reply and reply.get("ok")):
+            raise ConnectionError(f"push rejected: {reply!r}")
+        send_frame(sock, {"op": "delta",
+                          "since": None if since is None else str(since)})
+        reply = recv_frame(sock)
+        if reply is None or "payload" not in reply:
+            raise ConnectionError(f"delta failed: {reply!r}")
+        crdt.merge_json(reply["payload"], key_decoder=key_decoder,
+                        value_decoder=value_decoder)
+        send_frame(sock, {"op": "bye"})
+    return watermark

@@ -18,7 +18,9 @@ Protocol (length-prefixed JSON frames over one TCP connection):
 
 Nothing here is framework magic — the transport is ~40 lines of
 stdlib socket code, which is the point: any channel that can carry a
-string can carry replication.
+string can carry replication. (`crdt_tpu.net` packages this same
+protocol as `SyncServer`/`sync_over_tcp` for applications that just
+want the endpoints; this example keeps the raw frames visible.)
 
 Run: python examples/network_sync_example.py
 """
