@@ -34,7 +34,7 @@ from .sync import sync, sync_json
 from .net import SyncServer, sync_over_tcp
 from .checkpoint import load_dense, load_json, save_dense, save_json
 
-__version__ = "0.4.6"
+__version__ = "0.4.7"
 
 __all__ = [
     "Hlc", "ClockDriftException", "DuplicateNodeException",

@@ -236,27 +236,45 @@ class TpuMapCrdt(Crdt[K, V]):
         keys = list(record_map.keys())
         records = list(record_map.values())
         m = len(records)
-        hlc_nodes = [r.hlc.node_id for r in records]
-        mod_nodes = [r.modified.node_id for r in records]
+        from .. import native
+        codec = native.load()
+        if codec is not None:
+            lt_buf, hlc_nodes, values, mlt_buf, mod_nodes = \
+                codec.records_to_columns(records, True)
+            lt = np.frombuffer(lt_buf, np.int64)
+            mod_lt = np.frombuffer(mlt_buf, np.int64)
+            tomb = np.frombuffer(codec.none_mask(values), bool)
+        else:
+            lt = np.fromiter((r.hlc.logical_time for r in records),
+                             np.int64, count=m)
+            mod_lt = np.fromiter(
+                (r.modified.logical_time for r in records),
+                np.int64, count=m)
+            hlc_nodes = [r.hlc.node_id for r in records]
+            mod_nodes = [r.modified.node_id for r in records]
+            values = [r.value for r in records]
+            tomb = np.fromiter((v is None for v in values), bool,
+                               count=m)
         self._intern_nodes(hlc_nodes + mod_nodes)
         slots = self._ensure_slots(keys)
         l = self._lanes
-        l.lt[slots] = np.fromiter(
-            (r.hlc.logical_time for r in records), np.int64, count=m)
+        l.lt[slots] = lt
         l.node[slots] = self._ordinals(hlc_nodes)
-        l.mod_lt[slots] = np.fromiter(
-            (r.modified.logical_time for r in records), np.int64, count=m)
+        l.mod_lt[slots] = mod_lt
         l.mod_node[slots] = self._ordinals(mod_nodes)
         l.occupied[slots] = True
-        l.tomb[slots] = np.fromiter(
-            (r.value is None for r in records), bool, count=m)
+        l.tomb[slots] = tomb
         self._device = None
         payload = self._payload
         emit = self._hub.active
-        for i, (key, record) in enumerate(record_map.items()):
-            payload[slots[i]] = record.value
-            if emit:
-                self._hub.add(key, record.value)
+        if codec is not None and not emit:
+            codec.scatter_payload(payload, slots,
+                                  np.arange(m, dtype=np.int64), values)
+        else:
+            for i, key in enumerate(keys):
+                payload[slots[i]] = values[i]
+                if emit:
+                    self._hub.add(key, values[i])
 
     def _delta_slots(self, modified_since: Optional[Hlc]) -> np.ndarray:
         """Occupied slot indices passing the INCLUSIVE ``modified``
@@ -424,13 +442,19 @@ class TpuMapCrdt(Crdt[K, V]):
             return
         records = list(remote_records.values())
         m = len(records)
-        self._merge_columns(
-            list(remote_records.keys()),
-            np.fromiter((r.hlc.logical_time for r in records),
-                        np.int64, count=m),
-            [r.hlc.node_id for r in records],
-            [r.value for r in records],
-            wall)
+        from .. import native
+        codec = native.load()
+        if codec is not None:
+            lt_buf, nodes, values = codec.records_to_columns(
+                records, False)
+            lt = np.frombuffer(lt_buf, np.int64)
+        else:
+            lt = np.fromiter((r.hlc.logical_time for r in records),
+                             np.int64, count=m)
+            nodes = [r.hlc.node_id for r in records]
+            values = [r.value for r in records]
+        self._merge_columns(list(remote_records.keys()), lt, nodes,
+                            values, wall)
 
     def merge_json(self, json_str: str,
                    key_decoder: Optional[KeyDecoder] = None,

@@ -1226,9 +1226,140 @@ fail:
     return NULL;
 }
 
+/* records_to_columns(records: list[Record], with_modified: bool)
+ * -> (lt: bytearray int64, nodes: list, values: list
+ *     [, mod_lt: bytearray int64, mod_nodes: list])
+ * Batch attribute extraction for the record-dict API surface: each
+ * Record carries (hlc, value, modified) with hlc = (millis, counter,
+ * node_id). lt packs (millis << 16) | counter; millis outside the
+ * int64 lane range raises OverflowError (the columnar contract —
+ * matching np.fromiter over .logical_time). */
+static PyObject *s_hlc, *s_millis, *s_counter, *s_node_id,
+                *s_value, *s_modified;
+
+static int ensure_attr_names(void) {
+    if (s_hlc) return 1;
+    s_hlc = PyUnicode_InternFromString("hlc");
+    s_millis = PyUnicode_InternFromString("millis");
+    s_counter = PyUnicode_InternFromString("counter");
+    s_node_id = PyUnicode_InternFromString("node_id");
+    s_value = PyUnicode_InternFromString("value");
+    s_modified = PyUnicode_InternFromString("modified");
+    return (s_hlc && s_millis && s_counter && s_node_id && s_value
+            && s_modified);
+}
+
+static PyObject *records_to_columns(PyObject *self, PyObject *args) {
+    PyObject *records;
+    if (!ensure_attr_names()) return NULL;
+    int with_modified = 0;
+    if (!PyArg_ParseTuple(args, "O!p", &PyList_Type, &records,
+                          &with_modified))
+        return NULL;
+    Py_ssize_t n = PyList_GET_SIZE(records);
+    PyObject *lt_buf = PyByteArray_FromStringAndSize(
+        NULL, n * (Py_ssize_t)sizeof(long long));
+    PyObject *nodes = PyList_New(n);
+    PyObject *values = PyList_New(n);
+    PyObject *mlt_buf = NULL, *mnodes = NULL, *result = NULL;
+    if (with_modified) {
+        mlt_buf = PyByteArray_FromStringAndSize(
+            NULL, n * (Py_ssize_t)sizeof(long long));
+        mnodes = PyList_New(n);
+        if (!mlt_buf || !mnodes) goto done;
+    }
+    if (!lt_buf || !nodes || !values) goto done;
+    long long *lt = (long long *)PyByteArray_AS_STRING(lt_buf);
+    long long *mlt = with_modified
+        ? (long long *)PyByteArray_AS_STRING(mlt_buf) : NULL;
+
+    for (Py_ssize_t i = 0; i < n; i++) {
+        PyObject *r = PyList_GET_ITEM(records, i);
+        PyObject *hlc = PyObject_GetAttr(r, s_hlc);
+        if (!hlc) goto done;
+        PyObject *ms_o = PyObject_GetAttr(hlc, s_millis);
+        PyObject *c_o = ms_o ? PyObject_GetAttr(hlc, s_counter)
+                             : NULL;
+        PyObject *node = c_o ? PyObject_GetAttr(hlc, s_node_id)
+                             : NULL;
+        Py_DECREF(hlc);
+        if (!node) {
+            Py_XDECREF(ms_o); Py_XDECREF(c_o);
+            goto done;
+        }
+        long long ms = PyLong_AsLongLong(ms_o);
+        Py_DECREF(ms_o);
+        if (ms == -1 && PyErr_Occurred()) {   /* no API call with an
+                                               * exception pending */
+            Py_DECREF(c_o); Py_DECREF(node); goto done;
+        }
+        long long counter = PyLong_AsLongLong(c_o);
+        Py_DECREF(c_o);
+        if (counter == -1 && PyErr_Occurred()) {
+            Py_DECREF(node); goto done;
+        }
+        if (ms > 0x7FFFFFFFFFFFLL || ms < -0x800000000000LL) {
+            Py_DECREF(node);
+            PyErr_SetString(PyExc_OverflowError,
+                            "HLC millis outside the int64 lane range "
+                            "(|millis| >= 2^47)");
+            goto done;
+        }
+        /* + not |: matches .logical_time exactly even for
+         * out-of-range counters on hand-built Hlcs */
+        lt[i] = (ms << 16) + counter;
+        PyList_SET_ITEM(nodes, i, node);
+        PyObject *v = PyObject_GetAttr(r, s_value);
+        if (!v) goto done;
+        PyList_SET_ITEM(values, i, v);
+        if (with_modified) {
+            PyObject *mod = PyObject_GetAttr(r, s_modified);
+            if (!mod) goto done;
+            PyObject *mms_o = PyObject_GetAttr(mod, s_millis);
+            PyObject *mc_o = mms_o
+                ? PyObject_GetAttr(mod, s_counter) : NULL;
+            PyObject *mnode = mc_o
+                ? PyObject_GetAttr(mod, s_node_id) : NULL;
+            Py_DECREF(mod);
+            if (!mnode) {
+                Py_XDECREF(mms_o); Py_XDECREF(mc_o);
+                goto done;
+            }
+            long long mms = PyLong_AsLongLong(mms_o);
+            Py_DECREF(mms_o);
+            if (mms == -1 && PyErr_Occurred()) {
+                Py_DECREF(mc_o); Py_DECREF(mnode); goto done;
+            }
+            long long mc = PyLong_AsLongLong(mc_o);
+            Py_DECREF(mc_o);
+            if (mc == -1 && PyErr_Occurred()) {
+                Py_DECREF(mnode); goto done;
+            }
+            if (mms > 0x7FFFFFFFFFFFLL || mms < -0x800000000000LL) {
+                Py_DECREF(mnode);
+                PyErr_SetString(PyExc_OverflowError,
+                                "HLC millis outside the int64 lane "
+                                "range (|millis| >= 2^47)");
+                goto done;
+            }
+            mlt[i] = (mms << 16) + mc;
+            PyList_SET_ITEM(mnodes, i, mnode);
+        }
+    }
+    result = with_modified
+        ? PyTuple_Pack(5, lt_buf, nodes, values, mlt_buf, mnodes)
+        : PyTuple_Pack(3, lt_buf, nodes, values);
+done:
+    Py_XDECREF(lt_buf); Py_XDECREF(nodes); Py_XDECREF(values);
+    Py_XDECREF(mlt_buf); Py_XDECREF(mnodes);
+    return result;
+}
+
 static PyMethodDef methods[] = {
     {"parse_hlc_batch", parse_hlc_batch, METH_O,
      "Batch-parse canonical HLC wire strings."},
+    {"records_to_columns", records_to_columns, METH_VARARGS,
+     "Batch attribute extraction from Record objects to lanes."},
     {"format_hlc_batch", format_hlc_batch, METH_VARARGS,
      "Batch-format HLC components to wire strings."},
     {"parse_wire", parse_wire, METH_O,

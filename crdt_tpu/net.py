@@ -49,7 +49,10 @@ def send_frame(sock: socket.socket, obj: Any) -> None:
     if len(data) > MAX_FRAME_BYTES:
         raise ValueError(f"frame of {len(data)} bytes exceeds "
                          f"MAX_FRAME_BYTES ({MAX_FRAME_BYTES})")
-    sock.sendall(struct.pack(">I", len(data)) + data)
+    # two sendalls, no prefix+payload concat: a 100 MB full-state
+    # push must not allocate a second 100 MB copy
+    sock.sendall(struct.pack(">I", len(data)))
+    sock.sendall(data)
 
 
 def recv_frame(sock: socket.socket) -> Optional[Any]:
@@ -115,18 +118,25 @@ class SyncServer:
         down so the serve thread exits promptly — after stop()
         returns, no server-side thread touches the replica again."""
         self._stop.set()
-        active = self._active
-        if active is not None:
-            try:
-                active.shutdown(socket.SHUT_RDWR)
-            except OSError:
-                pass
         if self._thread is not None:
-            self._thread.join(timeout=60)
-            if self._thread.is_alive():   # must not silently leak
-                raise RuntimeError(
-                    "SyncServer thread failed to stop; the replica "
-                    "may still be accessed — do not reuse it")
+            # repeatedly shut down whatever connection is active: a
+            # conn accepted concurrently with stop() would otherwise
+            # slip past a single _active read and idle out a 30 s recv
+            import time as _time
+            deadline = _time.monotonic() + 60
+            while self._thread.is_alive():
+                active = self._active
+                if active is not None:
+                    try:
+                        active.shutdown(socket.SHUT_RDWR)
+                    except OSError:
+                        pass
+                self._thread.join(timeout=0.2)
+                if _time.monotonic() > deadline:
+                    raise RuntimeError(
+                        "SyncServer thread failed to stop; the "
+                        "replica may still be accessed — do not "
+                        "reuse it")
         self._lsock.close()
 
     def __enter__(self) -> "SyncServer":

@@ -208,3 +208,19 @@ def test_stop_is_quiescent_with_idle_client():
     server.stop()   # must shut the idle conn down, not wait 30s
     assert time_mod.monotonic() - t0 < 10
     idle.close()
+
+
+def test_serves_dense_backend():
+    """All four backends are servable: a DenseCrdt hub (int slot keys
+    on the wire) replicates to a MapCrdt edge and back."""
+    from crdt_tpu import DenseCrdt
+    clk = FakeClock()
+    hub = DenseCrdt("hub", 64, wall_clock=clk)
+    hub.put_batch([0, 1, 2], [10, 11, 12])
+    hub.delete_batch([1])
+    edge = MapCrdt("edge", wall_clock=clk)
+    edge.put(5, 55)
+    with SyncServer(hub, key_decoder=int) as server:
+        sync_over_tcp(edge, server.host, server.port, key_decoder=int)
+    assert edge.map == {0: 10, 2: 12, 5: 55}
+    assert hub.get(5) == 55 and hub.is_deleted(1)

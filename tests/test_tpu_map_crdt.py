@@ -334,3 +334,21 @@ class TestNativeHostHelpers:
         a.watch().listen(lambda e: seen.append((e.key, e.value)))
         a.put_all({"x": 1, "y": None})
         assert sorted(seen) == [("x", 1), ("y", None)]
+
+    def test_put_records_matches_pure_python_path(self, monkeypatch):
+        from crdt_tpu import native as native_pkg
+        recs = self._payload(200)
+        fast = TpuMapCrdt("local", wall_clock=FakeClock(
+            start=1_700_000_000_100))
+        fast.put_records(dict(recs))
+        monkeypatch.setattr(native_pkg, "_mod", None)
+        monkeypatch.setattr(native_pkg, "_tried", True)
+        slow = TpuMapCrdt("local", wall_clock=FakeClock(
+            start=1_700_000_000_100))
+        slow.put_records(dict(recs))
+        monkeypatch.undo()
+        assert fast.record_map() == slow.record_map()
+        assert fast.to_json() == slow.to_json()
+        # modified lanes preserved exactly (put_records stores the
+        # records' own stamps, unlike merge's re-stamping)
+        assert fast.record_map() == recs
