@@ -170,6 +170,17 @@ def decode(json_str: str, canonical_time: Hlc,
     }
 
 
+def _check_lane_millis(millis: int) -> None:
+    """Refuse millis the int64 lane packing can't hold, with the same
+    curated message on every columnar path (batch or deferred item) —
+    numpy's generic OverflowError on assignment says nothing about the
+    remedy."""
+    if not -0x8000_0000_0000 <= millis <= 0x7FFF_FFFF_FFFF:
+        raise OverflowError(
+            "HLC millis outside the int64 lane range (|millis| "
+            ">= 2^47); use the scalar MapCrdt for such timestamps")
+
+
 def decode_columns(json_str: str,
                    key_decoder: Optional[KeyDecoder] = None,
                    value_decoder: Optional[ValueDecoder] = None,
@@ -196,6 +207,7 @@ def decode_columns(json_str: str,
             lt = np.frombuffer(lt_buf, np.int64)
             for i in bad:
                 h = Hlc.parse(nodes[i])
+                _check_lane_millis(h.millis)
                 lt[i] = (h.millis << SHIFT) + h.counter
                 nodes[i] = h.node_id
             if node_id_decoder is not None:
@@ -216,15 +228,12 @@ def decode_columns(json_str: str,
         millis_l, counter_l, node_l = codec.parse_hlc_batch(hlc_strs)
     if millis_l is not None and None not in millis_l:
         ms_arr = np.array(millis_l, np.int64)
-        if ms_arr.size and (int(ms_arr.max()) > 0x7FFF_FFFF_FFFF
-                            or int(ms_arr.min()) < -0x8000_0000_0000):
+        if ms_arr.size:
             # (millis << 16) would wrap int64 — outside the lane
             # packing's range (years beyond ~6429). The scalar oracle
-            # handles these; the columnar path refuses loudly. The C
-            # scanner defers such items here for the same treatment.
-            raise OverflowError(
-                "HLC millis outside the int64 lane range (|millis| "
-                ">= 2^47); use the scalar MapCrdt for such timestamps")
+            # handles these; the columnar path refuses loudly.
+            _check_lane_millis(int(ms_arr.max()))
+            _check_lane_millis(int(ms_arr.min()))
         lt = (ms_arr << SHIFT) + np.array(counter_l, np.int64)
         nodes = node_l
     else:
@@ -237,6 +246,7 @@ def decode_columns(json_str: str,
             else:
                 h = Hlc.parse(s)
                 ms, c, n = h.millis, h.counter, h.node_id
+            _check_lane_millis(ms)
             lt[i] = (ms << SHIFT) + c
             nodes[i] = n
     if node_id_decoder is not None:

@@ -59,6 +59,19 @@ def load() -> Optional[object]:
                  "-o", tmp],
                 check=True, capture_output=True, timeout=120)
             os.replace(tmp, so)
+            # Content-hash naming leaves one stale binary behind per
+            # source update; reap siblings with a different tag so
+            # upgrades don't accumulate .so files without bound.
+            # Unlinking a file another process has dlopen'd is safe on
+            # POSIX (the mapping holds the inode); best-effort only.
+            for name in os.listdir(here):
+                if (name.startswith("_hlccodec_")
+                        and name.endswith(suffix)
+                        and name != os.path.basename(so)):
+                    try:
+                        os.unlink(os.path.join(here, name))
+                    except OSError:
+                        pass
         spec = importlib.util.spec_from_file_location(
             "crdt_tpu.native._hlccodec", so)
         mod = importlib.util.module_from_spec(spec)

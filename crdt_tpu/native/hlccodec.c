@@ -970,6 +970,18 @@ static PyObject *none_mask(PyObject *self, PyObject *arg) {
     return buf;
 }
 
+/* 8-byte signed-integer buffer check: format 'q'/'l' (64-bit
+ * platforms), optionally '@'-prefixed (native order/size). */
+static int wire_is_i64_buffer(const Py_buffer *b) {
+    const char *f = b->format;
+    if (b->itemsize != (Py_ssize_t)sizeof(long long) ||
+        b->len % (Py_ssize_t)sizeof(long long))
+        return 0;
+    if (!f) return 0;
+    if (*f == '@') f++;
+    return (f[0] == 'q' || f[0] == 'l') && f[1] == '\0';
+}
+
 /* scatter_payload(payload: list, slots: int64 buffer,
  *                 winners: int64 buffer, values: list) -> None
  * payload[slots[w]] = values[w] for each winner index w. */
@@ -979,10 +991,21 @@ static PyObject *scatter_payload(PyObject *self, PyObject *args) {
                           &slots_o, &win_o, &PyList_Type, &values))
         return NULL;
     Py_buffer slots_b, win_b;
-    if (PyObject_GetBuffer(slots_o, &slots_b, PyBUF_CONTIG_RO) < 0)
+    if (PyObject_GetBuffer(slots_o, &slots_b,
+                           PyBUF_CONTIG_RO | PyBUF_FORMAT) < 0)
         return NULL;
-    if (PyObject_GetBuffer(win_o, &win_b, PyBUF_CONTIG_RO) < 0) {
+    if (PyObject_GetBuffer(win_o, &win_b,
+                           PyBUF_CONTIG_RO | PyBUF_FORMAT) < 0) {
         PyBuffer_Release(&slots_b);
+        return NULL;
+    }
+    /* The casts below assume int64 elements; any other item type (an
+     * int32 ndarray, a float64 ndarray — same width, different bits)
+     * would silently misindex the payload list instead of erroring. */
+    if (!wire_is_i64_buffer(&slots_b) || !wire_is_i64_buffer(&win_b)) {
+        PyBuffer_Release(&slots_b); PyBuffer_Release(&win_b);
+        PyErr_SetString(PyExc_TypeError,
+                        "scatter_payload needs int64 slot/winner buffers");
         return NULL;
     }
     const long long *slots = (const long long *)slots_b.buf;

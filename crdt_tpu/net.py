@@ -55,22 +55,40 @@ def send_frame(sock: socket.socket, obj: Any) -> None:
     sock.sendall(data)
 
 
-def recv_frame(sock: socket.socket) -> Optional[Any]:
-    head = _recv_exact(sock, 4)
+def recv_frame(sock: socket.socket,
+               deadline: Optional[float] = None) -> Optional[Any]:
+    """Receive one frame; ``deadline`` (a ``time.monotonic()`` value)
+    bounds the WHOLE frame, not just each chunk — a peer trickling
+    bytes inside the per-recv socket timeout cannot stretch past it."""
+    head = _recv_exact(sock, 4, deadline)
     if head is None:
         return None
     (n,) = struct.unpack(">I", head)
     if n > MAX_FRAME_BYTES:
         raise ValueError(f"peer announced a {n}-byte frame (cap "
                          f"{MAX_FRAME_BYTES}); corrupt stream?")
-    body = _recv_exact(sock, n)
+    body = _recv_exact(sock, n, deadline)
     return None if body is None else json.loads(body)
 
 
-def _recv_exact(sock: socket.socket, n: int) -> Optional[bytes]:
+def _recv_exact(sock: socket.socket, n: int,
+                deadline: Optional[float] = None) -> Optional[bytes]:
+    import time as _time
     buf = bytearray()
     while len(buf) < n:
-        chunk = sock.recv(min(n - len(buf), 1 << 20))
+        if deadline is not None:
+            remaining = deadline - _time.monotonic()
+            if remaining <= 0:
+                raise socket.timeout("connection deadline exceeded")
+            base = sock.gettimeout()
+            sock.settimeout(remaining if base is None
+                            else min(base, remaining))
+            try:
+                chunk = sock.recv(min(n - len(buf), 1 << 20))
+            finally:
+                sock.settimeout(base)
+        else:
+            chunk = sock.recv(min(n - len(buf), 1 << 20))
         if not chunk:
             return None
         buf += chunk
@@ -82,7 +100,14 @@ class SyncServer:
 
     One connection is handled at a time (replication rounds are short
     and the replica is single-threaded anyway); each request holds
-    :attr:`lock` while it touches the replica.
+    :attr:`lock` while it touches the replica. Because of the
+    single-connection design, a slow peer delays — and without bounds
+    would starve — every other replica, so each connection is capped:
+    at most ``max_ops`` framed requests and ``conn_deadline`` seconds,
+    after which it is dropped (a well-behaved anti-entropy round is 3
+    frames and well under a second). The endpoint still assumes a
+    trusted network: there is no authentication and a peer can push
+    arbitrary records.
 
     >>> server = SyncServer(crdt)          # port 0 = ephemeral
     >>> server.start()
@@ -93,9 +118,12 @@ class SyncServer:
     def __init__(self, crdt: Crdt, host: str = "127.0.0.1",
                  port: int = 0,
                  key_encoder=None, value_encoder=None,
-                 key_decoder=None, value_decoder=None):
+                 key_decoder=None, value_decoder=None,
+                 max_ops: int = 1000, conn_deadline: float = 300.0):
         self.crdt = crdt
         self.lock = threading.Lock()
+        self._max_ops = max_ops
+        self._conn_deadline = conn_deadline
         # codec passthrough, mirroring sync.sync_json: replicas with
         # custom-typed keys/values need the same coders over TCP
         self._kenc, self._venc = key_encoder, value_encoder
@@ -171,13 +199,23 @@ class SyncServer:
 
     def _handle(self, conn: socket.socket) -> None:
         conn.settimeout(30)
+        import time as _time
+        deadline = _time.monotonic() + self._conn_deadline
+        ops = 0
         while not self._stop.is_set():
             try:
-                msg = recv_frame(conn)
+                msg = recv_frame(conn, deadline=deadline)
             except (socket.timeout, OSError, ValueError):
                 return
             if msg is None or not isinstance(msg, dict) \
                     or msg.get("op") == "bye":
+                return
+            # Bound what one connection can monopolize (single-
+            # connection server: others queue behind this peer).
+            # Checked after recv so a frame landing past the deadline
+            # is dropped, not granted one more op.
+            ops += 1
+            if ops > self._max_ops or _time.monotonic() > deadline:
                 return
             op = msg.get("op")
             if op == "push":
@@ -230,7 +268,8 @@ def sync_over_tcp(crdt: Crdt, host: str, port: int,
                   since: Optional[Hlc] = None,
                   timeout: float = 30.0,
                   key_encoder=None, value_encoder=None,
-                  key_decoder=None, value_decoder=None) -> Hlc:
+                  key_decoder=None, value_decoder=None,
+                  lock: Optional[threading.Lock] = None) -> Hlc:
     """One anti-entropy round against a :class:`SyncServer`.
 
     ``since`` is this replica's delta watermark: pass None on first
@@ -240,23 +279,38 @@ def sync_over_tcp(crdt: Crdt, host: str, port: int,
     like the reference's `_sync` (test/map_crdt_test.dart:273-279);
     the inclusive `modified >= since` bound (map_crdt.dart:44-45)
     then guarantees nothing stamped after it is missed.
+
+    ``lock`` serializes access to the LOCAL replica: when ``crdt`` is
+    also served by its own `SyncServer` (the natural bidirectional
+    mesh), pass that server's :attr:`SyncServer.lock` here — without
+    it this round's reads/merges race the server thread. The lock is
+    held only around local replica calls, never across network waits,
+    so a gossiping mesh of self-served replicas cannot deadlock on
+    each other's rounds.
     """
-    watermark = crdt.canonical_time
+    if lock is None:
+        lock = threading.Lock()   # uncontended no-op
+    with lock:
+        watermark = crdt.canonical_time
+        payload = crdt.to_json(key_encoder=key_encoder,
+                               value_encoder=value_encoder)
+    import time as _time
     with socket.create_connection((host, port), timeout=timeout) as sock:
         sock.settimeout(timeout)
-        send_frame(sock, {"op": "push",
-                          "payload": crdt.to_json(
-                              key_encoder=key_encoder,
-                              value_encoder=value_encoder)})
-        reply = recv_frame(sock)
+        # Each reply frame is bounded WHOLE (not per recv chunk): a
+        # server trickling bytes can't hold the round open past
+        # ``timeout`` per frame.
+        send_frame(sock, {"op": "push", "payload": payload})
+        reply = recv_frame(sock, deadline=_time.monotonic() + timeout)
         if not (reply and reply.get("ok")):
             raise ConnectionError(f"push rejected: {reply!r}")
         send_frame(sock, {"op": "delta",
                           "since": None if since is None else str(since)})
-        reply = recv_frame(sock)
+        reply = recv_frame(sock, deadline=_time.monotonic() + timeout)
         if reply is None or "payload" not in reply:
             raise ConnectionError(f"delta failed: {reply!r}")
-        crdt.merge_json(reply["payload"], key_decoder=key_decoder,
-                        value_decoder=value_decoder)
+        with lock:
+            crdt.merge_json(reply["payload"], key_decoder=key_decoder,
+                            value_decoder=value_decoder)
         send_frame(sock, {"op": "bye"})
     return watermark
