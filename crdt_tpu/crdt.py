@@ -156,6 +156,20 @@ class Crdt(ABC, Generic[K, V]):
         self._canonical_time = Hlc.send(self._canonical_time,
                                         millis=self._wall_clock())
 
+    def _decode_wall_millis(self) -> int:
+        """The ONE wall-clock read ``merge_json`` consumes for the
+        decode-time ``modified`` stamp (crdt_json.dart:23-24).
+
+        Tick-accounting contract: any override of ``merge_json`` that
+        skips the generic decode (e.g. a columnar ingest) must consume
+        its decode-time tick through THIS method — then both paths
+        draw the same number of reads from an injected wall clock and
+        FakeClock differentials stay aligned by construction (the
+        conformance kit pins this with a counting clock). If the
+        generic path ever grows another read, it must go through here
+        too."""
+        return self._wall_clock()
+
     def merge_json(self, json_str: str,
                    key_decoder: Optional[KeyDecoder] = None,
                    value_decoder: Optional[ValueDecoder] = None) -> None:
@@ -164,7 +178,7 @@ class Crdt(ABC, Generic[K, V]):
             self._canonical_time,
             key_decoder=key_decoder,
             value_decoder=value_decoder,
-            now_millis=self._wall_clock(),
+            now_millis=self._decode_wall_millis(),
         )
         self.merge(records)
 

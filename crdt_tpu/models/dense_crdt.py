@@ -142,13 +142,18 @@ class DenseCrdt:
         except Exception:
             return False
 
-    def put_batch(self, slots, values) -> None:
+    def put_batch(self, slots, values, tombs=None) -> None:
         """Write values at slot indices; the whole batch shares ONE
-        freshly-sent HLC (putAll semantics, crdt.dart:46-54)."""
+        freshly-sent HLC (putAll semantics, crdt.dart:46-54).
+        ``tombs`` (bool per entry) tombstones those entries under the
+        same batch stamp — the mixed putAll shape (delete = put None,
+        crdt.dart:58) that `delete_batch` alone can't express without
+        spending a second stamp."""
         slots = np.asarray(slots, np.int32)
         self._check_slots(slots)
         slots = jnp.asarray(slots)
         values = jnp.asarray(values, jnp.int64)
+        tombs_h = None if tombs is None else np.asarray(tombs, bool)
         self._canonical_time = Hlc.send(self._canonical_time,
                                         millis=self._wall_clock())
         t = jnp.int64(self._canonical_time.logical_time)
@@ -156,12 +161,14 @@ class DenseCrdt:
         # One fused jit (not 7 eager scatters); donate the old lanes on
         # backends that support it so an O(k) write never copies the
         # O(n_slots) store.
-        self._store = put_scatter(self._store, slots, values, t, me,
-                                  donate=self._donate_writes())
+        self._store = put_scatter(
+            self._store, slots, values,
+            t, me, tombs=None if tombs_h is None else jnp.asarray(tombs_h),
+            donate=self._donate_writes())
         self._store_escaped = False
         self.stats.puts += 1
         self.stats.records_put += int(slots.shape[0])
-        self._emit_put(slots, values)
+        self._emit_put(slots, values, tombs_h)
 
     def delete_batch(self, slots) -> None:
         """Tombstone slots (delete = put None, crdt.dart:58)."""
@@ -208,6 +215,29 @@ class DenseCrdt:
             (self._store.occupied[slot], self._store.tomb[slot],
              self._store.val[slot]))
         return int(val) if bool(occ) and not bool(tomb) else None
+
+    def get_slot_record(self, slot: int) -> Optional[Record]:
+        """Single-slot `Record` fetch (getRecord semantics,
+        crdt.dart:146) — ONE batched device→host transfer of seven
+        scalars, never a full-store readback (`record_map` is the
+        bulk shape; a 1M-slot replica must answer a point read in
+        O(1))."""
+        self._check_slot(slot)
+        occ, lt, node, val, mod_lt, mod_node, tomb = jax.device_get(
+            (self._store.occupied[slot], self._store.lt[slot],
+             self._store.node[slot], self._store.val[slot],
+             self._store.mod_lt[slot], self._store.mod_node[slot],
+             self._store.tomb[slot]))
+        if not bool(occ):
+            return None
+        from ..hlc import MAX_COUNTER, SHIFT
+        ids = self._table.ids()
+        lt, mod_lt = int(lt), int(mod_lt)
+        return Record(
+            Hlc._raw(lt >> SHIFT, lt & MAX_COUNTER, ids[int(node)]),
+            None if bool(tomb) else int(val),
+            Hlc._raw(mod_lt >> SHIFT, mod_lt & MAX_COUNTER,
+                     ids[int(mod_node)]))
 
     def contains_slot(self, slot: int) -> bool:
         """True if the slot holds a record, live OR tombstoned
@@ -281,11 +311,13 @@ class DenseCrdt:
         the kernel — SURVEY.md §7 hard part 6)."""
         return self._hub.stream(slot)
 
-    def _emit_put(self, slots, values) -> None:
+    def _emit_put(self, slots, values, tombs=None) -> None:
         if not self._hub.active:
             return  # no subscribers: bulk path stays device-only
-        for s, v in zip(np.asarray(slots), np.asarray(values)):
-            self._hub.add(int(s), int(v))
+        for i, (s, v) in enumerate(zip(np.asarray(slots),
+                                       np.asarray(values))):
+            deleted = tombs is not None and bool(tombs[i])
+            self._hub.add(int(s), None if deleted else int(v))
 
     def _emit_delete(self, slots) -> None:
         if not self._hub.active:
@@ -306,6 +338,67 @@ class DenseCrdt:
     # format (crdt_json.dart:8-37; example/crdt_example.dart:12-16), so
     # a dense replica can sync with MapCrdt/TpuMapCrdt or external
     # JSON peers, not just other dense stores. ---
+
+    def _check_int_values(self, record_map: Dict[int, Record]) -> None:
+        """The payload lane is int64; any other type would be silently
+        truncated and (sharing the peer's hlc) diverge forever — fail
+        loudly, identically on every record ingest path."""
+        for slot, rec in record_map.items():
+            if rec.value is not None and not isinstance(
+                    rec.value, (int, np.integer)):
+                raise TypeError(
+                    f"DenseCrdt values must be ints; slot {slot} got "
+                    f"{type(rec.value).__name__}")
+
+    def put_slot_records(self, record_map: Dict[int, Record]) -> None:
+        """Raw record writes preserving each record's own ``hlc`` and
+        ``modified`` stamps — the putRecords storage primitive
+        (crdt.dart:151-155): records land verbatim, with NO LWW compare
+        and NO canonical-clock involvement (put_record's contract).
+        Values must be ints (or None tombstones). Bulk-import shape:
+        restoring a record dump, seeding a replica, or backing the
+        `Crdt` storage slots through `KeyedDenseCrdt`."""
+        if not record_map:
+            return
+        k = len(record_map)
+        slots = np.fromiter(record_map.keys(), np.int64, count=k)
+        self._check_slots(slots)
+        recs = list(record_map.values())
+        self._check_int_values(record_map)
+        self._intern_ids({r.hlc.node_id for r in recs}
+                         | {r.modified.node_id for r in recs})
+        ords = {nid: i for i, nid in enumerate(self._table.ids())}
+        # Pad k to a power of two (invalid rows scatter to the
+        # n_slots sentinel, mode="drop") so the jitted scatter compiles
+        # O(log k) distinct shapes — same trick as merge_records.
+        padded = 1 << max(k - 1, 1).bit_length()
+        slot_arr = np.full((padded,), self.n_slots, np.int64)
+        lt = np.zeros((padded,), np.int64)
+        node = np.zeros((padded,), np.int32)
+        val = np.zeros((padded,), np.int64)
+        mod_lt = np.zeros((padded,), np.int64)
+        mod_node = np.zeros((padded,), np.int32)
+        tomb = np.zeros((padded,), bool)
+        slot_arr[:k] = slots
+        lt[:k] = [r.hlc.logical_time for r in recs]
+        node[:k] = [ords[r.hlc.node_id] for r in recs]
+        val[:k] = [0 if r.value is None else int(r.value) for r in recs]
+        mod_lt[:k] = [r.modified.logical_time for r in recs]
+        mod_node[:k] = [ords[r.modified.node_id] for r in recs]
+        tomb[:k] = [r.is_deleted for r in recs]
+        from ..ops.dense import record_scatter
+        self._store = self._postprocess_store(record_scatter(
+            self._store, jnp.asarray(slot_arr), jnp.asarray(lt),
+            jnp.asarray(node), jnp.asarray(val), jnp.asarray(mod_lt),
+            jnp.asarray(mod_node), jnp.asarray(tomb),
+            donate=self._donate_writes()))
+        self._store_escaped = False
+        self.stats.puts += 1
+        self.stats.records_put += k
+        if self._hub.active:
+            for slot, rec in record_map.items():
+                self._hub.add(int(slot),
+                              None if rec.is_deleted else int(rec.value))
 
     def _delta_mask(self, modified_since: Optional[Hlc]) -> np.ndarray:
         if modified_since is None:
@@ -432,16 +525,11 @@ class DenseCrdt:
         # add_seen_lazy (host int here): `records_seen +=` would drain
         # any pending lazy device scalar with a blocking readback.
         self.stats.add_seen_lazy(len(record_map))
+        # Validate payloads BEFORE any clock mutation so a bad record
+        # rejects the merge with the replica untouched.
+        self._check_int_values(record_map)
         wall = self._wall_clock()
-        for slot, rec in record_map.items():
-            if rec.value is not None and not isinstance(
-                    rec.value, (int, np.integer)):
-                # A truncated float/str would share the peer's hlc and
-                # silently diverge forever (ties resolve local-wins on
-                # both sides) — fail loudly instead.
-                raise TypeError(
-                    f"DenseCrdt values must be ints; slot {slot} got "
-                    f"{type(rec.value).__name__}")
+        for rec in record_map.values():
             self._canonical_time = Hlc.recv(self._canonical_time, rec.hlc,
                                             millis=wall)
         k = len(record_map)
@@ -715,8 +803,13 @@ class DenseCrdt:
         sequential-merge order) and run ONE fused lattice join."""
         self.stats.merges += 1
         if not changesets:
-            # Merging nothing still ends with the final send bump
-            # (crdt.dart:93 runs unconditionally).
+            # Merging nothing still consumes the absorption-phase wall
+            # read AND the final send bump (crdt.dart:77-94 reads the
+            # clock before the record loop regardless, then sends) —
+            # the same two ticks every record-dict backend spends, so
+            # cross-backend differentials under an injected clock
+            # can't drift on empty anti-entropy rounds.
+            self._wall_clock()
             self._canonical_time = Hlc.send(self._canonical_time,
                                             millis=self._wall_clock())
             return
@@ -816,8 +909,8 @@ class ShardedDenseCrdt(DenseCrdt):
         # matches).
         return self._shard(store)
 
-    def put_batch(self, slots, values) -> None:
-        super().put_batch(slots, values)
+    def put_batch(self, slots, values, tombs=None) -> None:
+        super().put_batch(slots, values, tombs=tombs)
         self._store = self._shard(self._store)
 
     def delete_batch(self, slots) -> None:

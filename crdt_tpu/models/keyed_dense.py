@@ -1,0 +1,167 @@
+"""Keys-over-slots adapter: the full `Crdt` surface on a dense model.
+
+`DenseCrdt`/`ShardedDenseCrdt` deliberately expose an array surface
+(slots, int64 lanes) rather than subclass `Crdt` — but their behavior
+is the same LWW lattice, so they must pass the SAME backend-agnostic
+conformance suite every other backend runs (the reference ships its
+kit precisely so every storage backend proves the one contract,
+test/crdt_test.dart:7-11). This adapter closes that gap: a thin
+`Crdt` subclass that interns arbitrary keys onto dense slots and
+delegates every operation — including the merge engine and the watch
+stream — to the wrapped dense model. Nothing here re-implements CRDT
+semantics; the canonical clock lives in (and only in) the dense model.
+
+Values must be ints (or None tombstones) — the dense payload lane is
+int64 (models/dense_crdt.py module docstring).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, TypeVar
+
+from ..crdt import Crdt
+from ..hlc import Hlc
+from ..record import Record
+from ..watch import ChangeHub, ChangeStream
+from .dense_crdt import DenseCrdt
+
+K = TypeVar("K")
+
+
+class KeyedDenseCrdt(Crdt[K, int]):
+    """`Crdt` facade over a dense model (slots-as-keys adapter).
+
+    >>> kc = KeyedDenseCrdt(DenseCrdt("a", 64))
+    >>> kc.put("x", 1); kc.map
+    {'x': 1}
+
+    Key→slot interning is first-come sequential; capacity is the
+    wrapped model's ``n_slots`` (grow the dense model for more). The
+    adapter emits the wrapped model's change events re-keyed, so
+    `watch` filters by KEY, not slot.
+    """
+
+    def __init__(self, dense: DenseCrdt):
+        self._dense = dense
+        self._key_to_slot: Dict[K, int] = {}
+        self._slot_keys: List[K] = []
+        self._hub = ChangeHub()
+        self._forwarding = None
+        # Deliberately NOT calling Crdt.__init__: the canonical clock
+        # is owned by the dense model (already refreshed in its ctor);
+        # a second clock here could only drift from it.
+        self._wall_clock = dense._wall_clock
+
+    # --- clock: the dense model's, never a copy ---
+
+    @property
+    def node_id(self) -> Any:
+        return self._dense.node_id
+
+    @property
+    def dense(self) -> DenseCrdt:
+        """The wrapped dense model (for array-surface access)."""
+        return self._dense
+
+    @property
+    def _canonical_time(self) -> Hlc:
+        # Crdt.merge_json reads this attribute for the decode stamp.
+        return self._dense.canonical_time
+
+    @property
+    def canonical_time(self) -> Hlc:
+        return self._dense.canonical_time
+
+    def refresh_canonical_time(self) -> None:
+        self._dense.refresh_canonical_time()
+
+    # --- key interning ---
+
+    def _intern(self, key: K) -> int:
+        slot = self._key_to_slot.get(key)
+        if slot is None:
+            slot = len(self._slot_keys)
+            if slot >= self._dense.n_slots:
+                raise IndexError(
+                    f"adapter is out of slots ({self._dense.n_slots}); "
+                    "grow() the dense model first")
+            self._key_to_slot[key] = slot
+            self._slot_keys.append(key)
+        return slot
+
+    # --- local ops: single-stamp batches via the dense scatters ---
+
+    def put(self, key: K, value: Optional[int]) -> None:
+        slot = self._intern(key)
+        if value is None:
+            self._dense.delete_batch([slot])
+        else:
+            self._dense.put_batch([slot], [value])
+
+    def put_all(self, values: Dict[K, Optional[int]]) -> None:
+        if not values:
+            return  # no clock touch on an empty batch (crdt.dart:47-48)
+        slots = [self._intern(k) for k in values]
+        tombs = [v is None for v in values.values()]
+        self._dense.put_batch(
+            slots, [0 if v is None else v for v in values.values()],
+            tombs=tombs if any(tombs) else None)
+
+    def delete(self, key: K) -> None:
+        self.put(key, None)
+
+    # --- merge: the dense fan-in engine, not the generic host loop ---
+
+    def merge(self, remote_records: Dict[K, Record]) -> None:
+        self._dense.merge_records(
+            {self._intern(k): r for k, r in remote_records.items()})
+
+    # --- storage primitives (crdt.dart:140-169) ---
+
+    def contains_key(self, key: K) -> bool:
+        slot = self._key_to_slot.get(key)
+        return slot is not None and self._dense.contains_slot(slot)
+
+    def get_record(self, key: K) -> Optional[Record]:
+        slot = self._key_to_slot.get(key)
+        if slot is None:
+            return None
+        return self._dense.get_slot_record(slot)
+
+    def put_record(self, key: K, record: Record) -> None:
+        self.put_records({key: record})
+
+    def put_records(self, record_map: Dict[K, Record]) -> None:
+        self._dense.put_slot_records(
+            {self._intern(k): r for k, r in record_map.items()})
+
+    def record_map(self, modified_since: Optional[Hlc] = None
+                   ) -> Dict[K, Record]:
+        # Slots written through the raw `.dense` surface that this
+        # adapter never interned surface keyed by slot index — same
+        # convention as the watch forwarder.
+        keys = self._slot_keys
+        n = len(keys)
+        return {(keys[slot] if slot < n else slot): rec
+                for slot, rec in self._dense.record_map(
+                    modified_since).items()}
+
+    def watch(self, key: Optional[K] = None) -> ChangeStream:
+        if self._forwarding is None:
+            # One persistent subscription re-keys the dense model's
+            # (slot, value) events; keeping it subscribed makes the
+            # dense hub 'active' so bulk paths emit. Writes made
+            # through the raw `.dense` surface can touch slots this
+            # adapter never interned — those events pass through keyed
+            # by their slot index (never an exception from inside the
+            # hub's emission loop).
+            def forward(event):
+                keys = self._slot_keys
+                key = (keys[event.key] if 0 <= event.key < len(keys)
+                       else event.key)
+                self._hub.add(key, event.value)
+            self._forwarding = self._dense.watch().listen(forward)
+        return self._hub.stream(key)
+
+    def purge(self) -> None:
+        self._dense.purge()

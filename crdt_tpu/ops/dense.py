@@ -356,7 +356,7 @@ import functools as _functools
 
 @_functools.lru_cache(maxsize=None)
 def _put_scatter(donate: bool):
-    def step(store: DenseStore, slots, values, t, me) -> DenseStore:
+    def step(store: DenseStore, slots, values, tombs, t, me) -> DenseStore:
         return DenseStore(
             lt=store.lt.at[slots].set(t),
             node=store.node.at[slots].set(me),
@@ -364,7 +364,26 @@ def _put_scatter(donate: bool):
             mod_lt=store.mod_lt.at[slots].set(t),
             mod_node=store.mod_node.at[slots].set(me),
             occupied=store.occupied.at[slots].set(True),
-            tomb=store.tomb.at[slots].set(False),
+            tomb=store.tomb.at[slots].set(tombs),
+        )
+    return jax.jit(step, donate_argnums=(0,) if donate else ())
+
+
+@_functools.lru_cache(maxsize=None)
+def _record_scatter(donate: bool):
+    # mode="drop": callers pad the batch to a power of two with
+    # slot == n_slots sentinels (stable jit shapes); those rows must
+    # scatter nowhere.
+    def step(store: DenseStore, slots, lt, node, val, mod_lt, mod_node,
+             tomb) -> DenseStore:
+        return DenseStore(
+            lt=store.lt.at[slots].set(lt, mode="drop"),
+            node=store.node.at[slots].set(node, mode="drop"),
+            val=store.val.at[slots].set(val, mode="drop"),
+            mod_lt=store.mod_lt.at[slots].set(mod_lt, mode="drop"),
+            mod_node=store.mod_node.at[slots].set(mod_node, mode="drop"),
+            occupied=store.occupied.at[slots].set(True, mode="drop"),
+            tomb=store.tomb.at[slots].set(tomb, mode="drop"),
         )
     return jax.jit(step, donate_argnums=(0,) if donate else ())
 
@@ -384,10 +403,23 @@ def _delete_scatter(donate: bool):
     return jax.jit(step, donate_argnums=(0,) if donate else ())
 
 
-def put_scatter(store: DenseStore, slots, values, t, me,
+def put_scatter(store: DenseStore, slots, values, t, me, tombs=None,
                 donate: bool = False) -> DenseStore:
-    """Batch put: scatter one shared HLC + values at ``slots``."""
-    return _put_scatter(donate)(store, slots, values, t, me)
+    """Batch put: scatter one shared HLC + values at ``slots``.
+    ``tombs`` marks entries written as tombstones under the SAME batch
+    stamp (a mixed putAll, crdt.dart:46-54 + delete-as-put-None)."""
+    if tombs is None:
+        tombs = jnp.zeros(values.shape, bool)
+    return _put_scatter(donate)(store, slots, values, tombs, t, me)
+
+
+def record_scatter(store: DenseStore, slots, lt, node, val, mod_lt,
+                   mod_node, tomb, donate: bool = False) -> DenseStore:
+    """Raw record writes preserving the given hlc/modified stamps —
+    the putRecords storage primitive (crdt.dart:151-155): stores
+    records verbatim, no LWW compare, no clock involvement."""
+    return _record_scatter(donate)(store, slots, lt, node, val,
+                                   mod_lt, mod_node, tomb)
 
 
 def delete_scatter(store: DenseStore, slots, t, me,

@@ -44,6 +44,24 @@ class FakeClock:
         return self._millis
 
 
+class CountingClock(FakeClock):
+    """`FakeClock` that also counts reads.
+
+    Tick-accounting differentials are built on this: two backends fed
+    the same op sequence through counting clocks must consume the SAME
+    number of wall reads, or their clocks (and so their HLC stamps)
+    silently diverge under any injected clock — the failure mode the
+    shared ``Crdt._decode_wall_millis`` helper exists to prevent."""
+
+    def __init__(self, start: int = 1_700_000_000_000, step: int = 1):
+        super().__init__(start, step)
+        self.reads = 0
+
+    def __call__(self) -> int:
+        self.reads += 1
+        return super().__call__()
+
+
 def assert_dense_stores_equal(a, b, where: str = "store") -> None:
     """Lane-exact equality of two `DenseStore`s on OCCUPIED slots (an
     unoccupied slot's lane contents are unobservable through

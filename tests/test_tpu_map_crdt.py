@@ -352,3 +352,48 @@ class TestNativeHostHelpers:
         # modified lanes preserved exactly (put_records stores the
         # records' own stamps, unlike merge's re-stamping)
         assert fast.record_map() == recs
+
+
+class TestTickParity:
+    """merge_json wall-read parity is structural (one shared
+    accounting helper) and pinned here: if the generic path's read
+    count ever changes without the columnar override following, these
+    counts diverge and fail loudly."""
+
+    def _pair(self):
+        from crdt_tpu.testing import CountingClock
+        co, ct = CountingClock(), CountingClock()
+        return (MapCrdt("abc", wall_clock=co), co,
+                TpuMapCrdt("abc", wall_clock=ct), ct)
+
+    @pytest.mark.parametrize("no_native", [False, True])
+    def test_merge_json_consumes_identical_ticks(self, no_native,
+                                                 monkeypatch):
+        if no_native:
+            import crdt_tpu.crdt_json as cj
+            monkeypatch.setattr(cj.native, "load", lambda: None)
+        src = MapCrdt("peer", wall_clock=FakeClock(step=7))
+        src.put_all({"a": 1, "b": None, "c": "x"})
+        src.put("d", 4)
+        payloads = [src.to_json(), "{}",
+                    '{"a":{"hlc":"2001-01-01T00:00:00.000Z-0000-z",'
+                    '"value":9}}']
+        oracle, co, tpu, ct = self._pair()
+        for p in payloads:
+            oracle.merge_json(p)
+            tpu.merge_json(p)
+            assert co.reads == ct.reads, (
+                f"wall-read drift on payload {p[:40]!r}: "
+                f"oracle {co.reads} vs tpu {ct.reads}")
+        assert oracle.to_json() == tpu.to_json()
+
+    def test_record_merge_consumes_identical_ticks(self):
+        src = MapCrdt("peer", wall_clock=FakeClock(step=3))
+        src.put_all({"x": 1, "y": 2})
+        recs = src.record_map()
+        oracle, co, tpu, ct = self._pair()
+        for cs in (recs, {}):
+            oracle.merge(dict(cs))
+            tpu.merge(dict(cs))
+            assert co.reads == ct.reads
+        assert oracle.to_json() == tpu.to_json()
