@@ -184,7 +184,8 @@ def _check_lane_millis(millis: int) -> None:
 def decode_columns(json_str: str,
                    key_decoder: Optional[KeyDecoder] = None,
                    value_decoder: Optional[ValueDecoder] = None,
-                   node_id_decoder: Optional[NodeIdDecoder] = None):
+                   node_id_decoder: Optional[NodeIdDecoder] = None,
+                   with_hlc_strs: bool = False):
     """Wire JSON -> columnar ``(keys, lt, node_ids, values)`` without
     materializing `Record`/`Hlc` objects — the ingest shape the
     vectorized backends consume (``lt`` is an int64 ndarray of packed
@@ -194,15 +195,25 @@ def decode_columns(json_str: str,
     is the MERGING store's concern (winners are re-stamped with the
     post-absorption canonical anyway, crdt.dart:86-87; ``modified`` is
     never itself on the wire, record.dart:28-31).
+
+    ``with_hlc_strs`` appends a fifth column: each record's CANONICAL
+    wire hlc string (byte-equal to what ``str(hlc)`` would re-derive),
+    or None where only a normalizing parse was possible — backends
+    that store hlc strings (SqliteCrdt) skip the re-format round trip
+    for everything non-None.
     """
     import numpy as np
 
     from .hlc import SHIFT
     codec = native.load()
     if codec is not None:
-        scanned = codec.parse_wire(json_str)
+        scanned = codec.parse_wire(json_str, with_hlc_strs)
         if scanned is not None:
-            keys, lt_buf, nodes, values, bad = scanned
+            if with_hlc_strs:
+                keys, lt_buf, nodes, values, bad, hlc_strs = scanned
+            else:
+                keys, lt_buf, nodes, values, bad = scanned
+                hlc_strs = None
             # bytearray buffer -> writable int64 view, zero copies
             lt = np.frombuffer(lt_buf, np.int64)
             for i in bad:
@@ -218,6 +229,8 @@ def decode_columns(json_str: str,
                           for k, v in zip(keys, values)]
             if key_decoder is not None:
                 keys = [key_decoder(k) for k in keys]
+            if with_hlc_strs:
+                return keys, lt, nodes, values, hlc_strs
             return keys, lt, nodes, values
     raw = json.loads(json_str)
     items = list(raw.items())
@@ -258,6 +271,16 @@ def decode_columns(json_str: str,
     else:
         values = [None if (raw_v := v.get("value")) is None
                   else value_decoder(k, raw_v) for k, v in items]
+    if with_hlc_strs:
+        # Raw strings only where the batch parser certified the
+        # canonical shape AND the counter hex is uppercase (raw ==
+        # what str(hlc)'s %04X re-derives); everything else reports
+        # None for the caller to re-format.
+        out_strs = [s if millis_l is not None and millis_l[i] is not None
+                    and s[25:29] == s[25:29].upper()
+                    else None
+                    for i, s in enumerate(hlc_strs)]
+        return keys, lt, nodes, values, out_strs
     return keys, lt, nodes, values
 
 

@@ -47,7 +47,7 @@ import jax.numpy as jnp
 
 from ..crdt import Crdt
 from .. import crdt_json
-from ..hlc import (MAX_COUNTER, MAX_DRIFT, SHIFT, ClockDriftException,
+from ..hlc import (MAX_COUNTER, SHIFT, ClockDriftException,
                    DuplicateNodeException, Hlc)
 from ..record import KeyDecoder, Record, ValueDecoder
 from ..watch import ChangeHub, ChangeStream
@@ -59,7 +59,6 @@ K = TypeVar("K")
 V = TypeVar("V")
 
 _MIN_CAPACITY = 8
-_NEG = -(2 ** 62)
 
 
 def _next_pow2(n: int) -> int:
@@ -462,20 +461,16 @@ class TpuMapCrdt(Crdt[K, V]):
         """Columnar wire ingest: C batch HLC parse -> packed lanes ->
         vectorized join, no per-record Record/Hlc objects
         (crdt.dart:100-109 surface at numpy speed)."""
-        # Tick parity with the generic path: Crdt.merge_json reads the
-        # wall clock once for the decode-time `modified` stamp (which a
-        # merge immediately overwrites for winners) and merge() reads
-        # it twice more. Differential to_json parity under FakeClock
-        # depends on consuming the same number of ticks.
-        self._wall_clock()
+        # Tick parity by construction: the decode-time `modified` stamp
+        # read (which a merge immediately overwrites for winners) comes
+        # from the SAME accounting helper the generic path uses, and
+        # the empty payload routes through the real merge({}) — so this
+        # override cannot drift from Crdt.merge_json's read count.
+        self._decode_wall_millis()
         keys, lt, nodes, values = crdt_json.decode_columns(
             json_str, key_decoder=key_decoder, value_decoder=value_decoder)
         if not keys:
-            # Generic path for an empty payload: merge({}) reads the
-            # wall clock once, then the final send reads it again.
-            self._wall_clock()
-            self._canonical_time = Hlc.send(self._canonical_time,
-                                            millis=self._wall_clock())
+            self.merge({})
             return
         self._merge_columns(keys, lt, nodes, values, self._wall_clock())
 
@@ -496,25 +491,22 @@ class TpuMapCrdt(Crdt[K, V]):
             # --- stage 1: recv guards against the RUNNING canonical
             # (exclusive cummax — the fast path shields records the
             # clock already dominates, hlc.dart:85), in payload visit
-            # order like the reference's sequential loop.
-            running = np.maximum(canonical_lt, np.concatenate(
-                ([_NEG], np.maximum.accumulate(lt)[:-1])))
-            slow = lt > running
-            if slow.any():
-                dup = slow & (node == my_ord)
-                drift = slow & ~dup & ((lt >> SHIFT) - wall > MAX_DRIFT)
-                bad = dup | drift
-                if bad.any():
-                    # Canonical partially advanced to just before the
-                    # offender; store and host dicts untouched (guards
-                    # run before slot allocation — no rollback needed).
-                    i = int(np.argmax(bad))
-                    self._canonical_time = Hlc.from_logical_time(
-                        int(running[i]), self._node_id)
-                    if dup[i]:
-                        raise DuplicateNodeException(str(self._node_id))
-                    raise ClockDriftException(int(lt[i]) >> SHIFT, wall)
-            new_canonical = max(canonical_lt, int(lt.max()))
+            # order like the reference's sequential loop. One shared
+            # fold with the other host backends (utils/host_guards.py).
+            from ..utils.host_guards import recv_fold_columns
+            fold = recv_fold_columns(lt, node == my_ord, canonical_lt,
+                                     wall)
+            if fold.bad_index is not None:
+                # Canonical partially advanced to just before the
+                # offender; store and host dicts untouched (guards
+                # run before slot allocation — no rollback needed).
+                self._canonical_time = Hlc.from_logical_time(
+                    fold.canonical_at_fail, self._node_id)
+                if fold.bad_is_dup:
+                    raise DuplicateNodeException(str(self._node_id))
+                raise ClockDriftException(
+                    int(lt[fold.bad_index]) >> SHIFT, wall)
+            new_canonical = fold.new_canonical
 
             # --- stage 2: vectorized LWW (strict: local wins ties).
             slots = self._ensure_slots(keys)

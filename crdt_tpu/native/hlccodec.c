@@ -647,7 +647,10 @@ static PyObject *parse_json_value(Scan *sc, NodeEnt *kcache,
     return NULL;
 }
 
-static PyObject *parse_wire(PyObject *self, PyObject *arg) {
+static PyObject *parse_wire(PyObject *self, PyObject *args) {
+    PyObject *arg;
+    int want_hlc = 0;
+    if (!PyArg_ParseTuple(args, "O|p", &arg, &want_hlc)) return NULL;
     Py_ssize_t len;
     const char *s = PyUnicode_AsUTF8AndSize(arg, &len);
     if (!s) {
@@ -660,7 +663,7 @@ static PyObject *parse_wire(PyObject *self, PyObject *arg) {
 
     Scan sc = {s, len, 0, 0};
     PyObject *keys = NULL, *nodes = NULL, *values = NULL;
-    PyObject *pos_map = NULL, *result = NULL;
+    PyObject *pos_map = NULL, *result = NULL, *hlcs = NULL;
     long long *lt = NULL;
     unsigned char *badf = NULL;
     Py_ssize_t cap = 0, count = 0;
@@ -671,7 +674,12 @@ static PyObject *parse_wire(PyObject *self, PyObject *arg) {
     nodes = PyList_New(0);
     values = PyList_New(0);
     pos_map = PyDict_New();
-    if (!keys || !nodes || !values || !pos_map) goto done;
+    /* want_hlc: also return each record's RAW wire hlc string (None
+     * for deferred items) so string-storing backends skip the
+     * re-format round trip. */
+    if (want_hlc) hlcs = PyList_New(0);
+    if (!keys || !nodes || !values || !pos_map ||
+        (want_hlc && !hlcs)) goto done;
 
     skip_ws(&sc);
     if (sc.pos >= len || s[sc.pos] != '{') { sc.fallback = 1; goto done; }
@@ -706,6 +714,7 @@ static PyObject *parse_wire(PyObject *self, PyObject *arg) {
         long long item_lt = 0;
         PyObject *node_obj = NULL;   /* node id, or raw hlc when bad */
         PyObject *value_obj = NULL;
+        PyObject *hlc_obj = NULL;    /* raw wire hlc str (want_hlc) */
         int bad = 0, have_hlc = 0;
         skip_ws(&sc);
         if (sc.pos < len && s[sc.pos] == '}') sc.pos++;
@@ -730,6 +739,8 @@ static PyObject *parse_wire(PyObject *self, PyObject *arg) {
                 node_obj = NULL;
                 have_hlc = 1;
                 long long ms, counter;
+                Py_XDECREF(hlc_obj);
+                hlc_obj = NULL;
                 if (!hesc && he - hb >= 31 && s[hb + 24] == '-' &&
                     s[hb + 29] == '-' &&
                     parse_canonical_iso(s + hb, &ms) &&
@@ -743,6 +754,26 @@ static PyObject *parse_wire(PyObject *self, PyObject *arg) {
                     item_lt = (ms << 16) | counter;
                     node_obj = cached_str(cache, s + hb + 30,
                                            he - hb - 30);
+                    if (want_hlc && node_obj) {
+                        /* Certify byte-equality with str(hlc): the
+                         * parser accepts lowercase counter hex, but
+                         * the canonical re-derive emits %04X — only
+                         * uppercase spans may skip the re-format. */
+                        int canon = 1;
+                        for (int ci = 25; ci < 29; ci++) {
+                            char hc = s[hb + ci];
+                            if (hc >= 'a' && hc <= 'f') { canon = 0;
+                                                          break; }
+                        }
+                        if (canon) {
+                            hlc_obj = PyUnicode_FromStringAndSize(
+                                s + hb, he - hb);
+                            if (!hlc_obj) {
+                                Py_DECREF(node_obj);
+                                node_obj = NULL;
+                            }
+                        }
+                    }
                 } else {
                     bad = 1;
                     item_lt = 0;
@@ -783,6 +814,15 @@ static PyObject *parse_wire(PyObject *self, PyObject *arg) {
                 Py_DECREF(idx);
                 lt[i] = item_lt;
                 badf[i] = (unsigned char)bad;
+                if (want_hlc) {
+                    PyObject *h = hlc_obj ? hlc_obj : Py_None;
+                    if (!hlc_obj) Py_INCREF(Py_None);
+                    if (PyList_SetItem(hlcs, i, h) < 0) {
+                        Py_DECREF(key);
+                        goto done;
+                    }
+                    hlc_obj = NULL;   /* ref stolen */
+                }
                 if (PyList_SetItem(nodes, i, node_obj) < 0 ||
                     PyList_SetItem(values, i, value_obj) < 0) {
                     /* refs stolen even on failure path bookkeeping */
@@ -816,10 +856,14 @@ static PyObject *parse_wire(PyObject *self, PyObject *arg) {
                 int ok =
                     PyList_Append(keys, key) == 0 &&
                     PyList_Append(nodes, node_obj) == 0 &&
-                    PyList_Append(values, value_obj) == 0;
+                    PyList_Append(values, value_obj) == 0 &&
+                    (!want_hlc || PyList_Append(
+                        hlcs, hlc_obj ? hlc_obj : Py_None) == 0);
                 Py_DECREF(key);
                 Py_DECREF(node_obj);
                 Py_DECREF(value_obj);
+                Py_XDECREF(hlc_obj);
+                hlc_obj = NULL;
                 if (!ok) goto done;
                 count++;
             }
@@ -834,6 +878,7 @@ static PyObject *parse_wire(PyObject *self, PyObject *arg) {
         Py_DECREF(key);
         Py_XDECREF(node_obj);
         Py_XDECREF(value_obj);
+        Py_XDECREF(hlc_obj);
         goto done;
     }
 
@@ -859,7 +904,9 @@ finish:
                 Py_DECREF(ix);
             }
         }
-        result = PyTuple_Pack(5, keys, lt_buf, nodes, values, badl);
+        result = want_hlc
+            ? PyTuple_Pack(6, keys, lt_buf, nodes, values, badl, hlcs)
+            : PyTuple_Pack(5, keys, lt_buf, nodes, values, badl);
         Py_DECREF(lt_buf);
         Py_DECREF(badl);
     }
@@ -869,7 +916,7 @@ done:
     PyMem_Free(lt);
     PyMem_Free(badf);
     Py_XDECREF(keys); Py_XDECREF(nodes); Py_XDECREF(values);
-    Py_XDECREF(pos_map);
+    Py_XDECREF(pos_map); Py_XDECREF(hlcs);
     if (result) return result;
     if (sc.fallback && !PyErr_Occurred()) Py_RETURN_NONE;
     return NULL;
@@ -1162,6 +1209,72 @@ static int wbuf_put_scalar(WBuf *b, PyObject *v) {
     return -1;   /* container / custom object: dumps fallback */
 }
 
+/* dumps() a subtree via the Python callable and splice the text in.
+ * Returns 1 ok, 0 error, -2 defer (lone-surrogate output). */
+static int wbuf_splice_dumps(WBuf *b, PyObject *v, PyObject *dumps) {
+    PyObject *s = PyObject_CallFunctionObjArgs(dumps, v, NULL);
+    if (!s) return 0;
+    Py_ssize_t n;
+    int defer = 0;
+    const char *u = PyUnicode_CheckExact(s) ? wire_utf8(s, &n, &defer)
+                                            : NULL;
+    int ok = u && wbuf_put(b, u, (size_t)n);
+    Py_DECREF(s);
+    if (!ok) return defer ? -2 : 0;
+    return 1;
+}
+
+/* Recursive compact JSON writer: scalars via wbuf_put_scalar, exact
+ * dict/list/tuple walked natively with compact separators (the
+ * `compact_dumps` wire style, ensure_ascii=False); anything else —
+ * custom objects, str/int subclasses, dict keys that are not exact
+ * str, nesting past the cap — is serialized by the `dumps` callable
+ * and spliced in (partial native output is truncated first, so the
+ * splice never duplicates bytes). Returns 1 ok, 0 error, -2 defer
+ * (lone surrogate: the caller runs its whole-payload fallback). */
+#define WIRE_MAX_DEPTH 64
+static int wbuf_put_json(WBuf *b, PyObject *v, PyObject *dumps,
+                         int depth) {
+    int rc = wbuf_put_scalar(b, v);
+    if (rc >= 0 || rc == -2) return rc == -2 ? -2 : rc;
+    size_t start = b->len;
+    if (depth < WIRE_MAX_DEPTH && PyDict_CheckExact(v)) {
+        if (!wbuf_put(b, "{", 1)) return 0;
+        Py_ssize_t pos = 0, i = 0;
+        PyObject *k, *val;
+        while (PyDict_Next(v, &pos, &k, &val)) {
+            if (!PyUnicode_CheckExact(k)) {
+                b->len = start;   /* non-str key: dumps whole dict */
+                return wbuf_splice_dumps(b, v, dumps);
+            }
+            if (i++ && !wbuf_put(b, ",", 1)) return 0;
+            Py_ssize_t kn;
+            int kdefer = 0;
+            const char *ku = wire_utf8(k, &kn, &kdefer);
+            if (!ku) return kdefer ? -2 : 0;
+            if (!wbuf_put(b, "\"", 1) ||
+                !wbuf_put_escaped(b, ku, kn) ||
+                !wbuf_put(b, "\":", 2)) return 0;
+            int r = wbuf_put_json(b, val, dumps, depth + 1);
+            if (r != 1) return r;
+        }
+        return wbuf_put(b, "}", 1);
+    }
+    if (depth < WIRE_MAX_DEPTH &&
+        (PyList_CheckExact(v) || PyTuple_CheckExact(v))) {
+        if (!wbuf_put(b, "[", 1)) return 0;
+        Py_ssize_t n = PySequence_Fast_GET_SIZE(v);
+        for (Py_ssize_t i = 0; i < n; i++) {
+            if (i && !wbuf_put(b, ",", 1)) return 0;
+            int r = wbuf_put_json(b, PySequence_Fast_GET_ITEM(v, i),
+                                  dumps, depth + 1);
+            if (r != 1) return r;
+        }
+        return wbuf_put(b, "]", 1);
+    }
+    return wbuf_splice_dumps(b, v, dumps);
+}
+
 static PyObject *format_wire(PyObject *self, PyObject *args) {
     PyObject *keys, *hlcs, *values, *dumps;
     if (!PyArg_ParseTuple(args, "O!O!O!O", &PyList_Type, &keys,
@@ -1219,22 +1332,9 @@ static PyObject *format_wire(PyObject *self, PyObject *args) {
         if (!wbuf_put_escaped(&b, hu, hn)) goto fail;
         if (!wbuf_put(&b, "\",\"value\":", 10)) goto fail;
         PyObject *v = PyList_GET_ITEM(values, i);
-        int rc = wbuf_put_scalar(&b, v);
+        int rc = wbuf_put_json(&b, v, dumps, 0);
         if (rc == 0) goto fail;
         if (rc == -2) { PyMem_Free(b.p); Py_RETURN_NONE; }
-        if (rc < 0) {
-            PyObject *s = PyObject_CallFunctionObjArgs(dumps, v, NULL);
-            if (!s) goto fail;
-            Py_ssize_t sn;
-            int sdefer = 0;
-            const char *su = wire_utf8(s, &sn, &sdefer);
-            int ok = su && wbuf_put(&b, su, (size_t)sn);
-            Py_DECREF(s);
-            if (!ok) {
-                if (sdefer) { PyMem_Free(b.p); Py_RETURN_NONE; }
-                goto fail;
-            }
-        }
         if (!wbuf_put(&b, "}", 1)) goto fail;
     }
     if (!wbuf_put(&b, "}", 1)) goto fail;
@@ -1247,6 +1347,40 @@ static PyObject *format_wire(PyObject *self, PyObject *args) {
 fail:
     PyMem_Free(b.p);
     return NULL;
+}
+
+/* dump_values(values: list, dumps) -> list[str]
+ * Batch JSON text for a value column: each value serialized compact
+ * (the wbuf_put_json writer); items the native writer can't emit
+ * as UTF-8 (lone surrogates) fall back to the `dumps` callable per
+ * item — pass a json.dumps that can represent them (ensure_ascii).
+ * Scalar/container coverage matches format_wire's value field. */
+static PyObject *dump_values(PyObject *self, PyObject *args) {
+    PyObject *values, *dumps;
+    if (!PyArg_ParseTuple(args, "O!O", &PyList_Type, &values, &dumps))
+        return NULL;
+    Py_ssize_t n = PyList_GET_SIZE(values);
+    PyObject *out = PyList_New(n);
+    if (!out) return NULL;
+    WBuf b = {NULL, 0, 0};
+    for (Py_ssize_t i = 0; i < n; i++) {
+        b.len = 0;
+        PyObject *v = PyList_GET_ITEM(values, i);
+        int rc = wbuf_put_json(&b, v, dumps, 0);
+        PyObject *s;
+        if (rc == 1) {
+            s = PyUnicode_DecodeUTF8(b.p, (Py_ssize_t)b.len, NULL);
+        } else if (rc == -2) {
+            PyErr_Clear();
+            s = PyObject_CallFunctionObjArgs(dumps, v, NULL);
+        } else {
+            s = NULL;
+        }
+        if (!s) { PyMem_Free(b.p); Py_DECREF(out); return NULL; }
+        PyList_SET_ITEM(out, i, s);
+    }
+    PyMem_Free(b.p);
+    return out;
 }
 
 /* records_to_columns(records: list[Record], with_modified: bool)
@@ -1385,10 +1519,12 @@ static PyMethodDef methods[] = {
      "Batch attribute extraction from Record objects to lanes."},
     {"format_hlc_batch", format_hlc_batch, METH_VARARGS,
      "Batch-format HLC components to wire strings."},
-    {"parse_wire", parse_wire, METH_O,
+    {"parse_wire", parse_wire, METH_VARARGS,
      "One-pass columnar scan of a wire JSON payload."},
     {"format_wire", format_wire, METH_VARARGS,
      "Assemble a wire JSON payload from parallel columns."},
+    {"dump_values", dump_values, METH_VARARGS,
+     "Batch compact-JSON text for a value column."},
     {"ensure_slots", ensure_slots, METH_VARARGS,
      "Batch get-or-insert of keys into a key->slot dict."},
     {"none_mask", none_mask, METH_O,

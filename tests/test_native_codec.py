@@ -495,3 +495,47 @@ def test_decode_columns_deferred_item_curated_overflow(codec, monkeypatch):
     monkeypatch.setattr(crdt_json.native, "load", lambda: None)
     with pytest.raises(OverflowError, match="scalar MapCrdt"):
         crdt_json.decode_columns(payload)
+
+
+def test_dump_values_differential_vs_json_dumps(codec):
+    """The C value writer must parse-match json.dumps on everything
+    format_wire's value field models — scalars, containers, weird
+    floats, unicode, nesting."""
+    import json as json_mod
+    cases = [None, True, False, 0, -1, 2**70, 1.5, float("nan"),
+             float("inf"), "", "a\"b\\c", "ünïcode\n\t", {"k": [1, {"n":
+             None}]}, [1, [2, [3, {"d": "x"}]]], {"": ""},
+             {"num": 1e-7}, (1, 2), {"mixed": [True, None, "s", 3.25]}]
+    texts = codec.dump_values(cases, json_mod.dumps)
+    for v, t in zip(cases, texts):
+        expect = json_mod.loads(json_mod.dumps(v))
+        got = json_mod.loads(t)
+        if isinstance(v, float) and v != v:
+            assert got != got
+        else:
+            assert got == expect, (v, t)
+
+
+def test_dump_values_surrogate_falls_back_per_item(codec):
+    import json as json_mod
+    texts = codec.dump_values(["ok", "bad\ud800"], json_mod.dumps)
+    assert texts[0] == '"ok"'
+    assert json_mod.loads(texts[1]) == "bad\ud800"
+
+
+def test_parse_wire_raw_hlc_strings(codec):
+    """want_hlc returns the raw wire hlc strings byte-equal to what
+    str(hlc) would re-derive for canonical shapes, None for deferred
+    shapes; duplicate keys keep last-value semantics."""
+    h = "2023-05-06T07:08:09.123Z-00AB-nodeZ"
+    weird = "2023-05-06 07:08:09.123+00:00-0001-n2"   # non-canonical
+    payload = (f'{{"a":{{"hlc":"{h}","value":1}},'
+               f'"b":{{"hlc":"{weird}","value":2}},'
+               f'"a":{{"hlc":"{h}","value":9}}}}')
+    keys, lt_buf, nodes, values, bad, hlcs = codec.parse_wire(
+        payload, True)
+    assert keys == ["a", "b"]
+    assert hlcs[0] == h and hlcs[1] is None
+    assert values == [9, 2]           # last value, first position
+    # and the 5-tuple form is unchanged
+    assert len(codec.parse_wire(payload)) == 5

@@ -169,3 +169,84 @@ class TestPersistence:
         with SqliteCrdt("dur", db, wall_clock=FakeClock()) as b:
             assert b.record_map() == {}
             assert b.canonical_time.logical_time == 0
+
+
+def test_columnar_ingest_matches_generic_rows():
+    """The columnar merge_json and the generic object path must leave
+    identical record state — including LWW losers against existing
+    rows, logicalTime ties broken by node id, tombstones, and the
+    canonical clock."""
+    import os
+
+    from crdt_tpu import MapCrdt
+    from crdt_tpu.testing import FakeClock
+
+    src = MapCrdt("remote", wall_clock=FakeClock(start=1_700_000_000_000))
+    src.put_all({f"k{i}": {"v": i} if i % 3 else None for i in range(50)})
+    src.put("tie", 1)
+    wire = src.to_json()
+
+    def build(force_generic):
+        clk = FakeClock(start=1_700_000_000_500)
+        c = SqliteCrdt("local", wall_clock=clk)
+        c.put_all({f"k{i}": "mine" for i in range(0, 50, 5)})
+        if force_generic:
+            import crdt_tpu.native as native_mod
+            orig = native_mod.load
+            native_mod.load = lambda: None
+            try:
+                c.merge_json(wire)
+            finally:
+                native_mod.load = orig
+        else:
+            c.merge_json(wire)
+        return c
+
+    fast, slow = build(False), build(True)
+    assert fast.record_map() == slow.record_map()
+    assert fast.canonical_time == slow.canonical_time
+    assert fast.to_json() == slow.to_json()
+
+
+def test_columnar_ingest_tick_parity_with_oracle():
+    from crdt_tpu import MapCrdt
+    from crdt_tpu.testing import CountingClock, FakeClock
+    src = MapCrdt("remote", wall_clock=FakeClock())
+    src.put_all({"x": 1, "y": None})
+    co, cs = CountingClock(), CountingClock()
+    oracle = MapCrdt("abc", wall_clock=co)
+    lite = SqliteCrdt("abc", wall_clock=cs)
+    for payload in (src.to_json(), "{}"):
+        oracle.merge_json(payload)
+        lite.merge_json(payload)
+        assert co.reads == cs.reads
+    assert oracle.to_json() == lite.to_json()
+
+
+def test_wal_mode_survives_restart(tmp_path):
+    db = str(tmp_path / "replica.db")
+    from crdt_tpu.testing import FakeClock
+    c = SqliteCrdt("n1", db, wall_clock=FakeClock())
+    assert c._conn.execute("PRAGMA journal_mode").fetchone()[0] == "wal"
+    c.put_all({"a": 1, "b": 2})
+    c.delete("a")
+    wire = c.to_json()
+    c.close()
+    r = SqliteCrdt("n1", db, wall_clock=FakeClock())
+    assert r.to_json() == wire
+    assert r.map == {"b": 2}
+    r.close()
+
+
+def test_columnar_ingest_stores_canonical_hlc_strings():
+    """Lowercase counter hex on the wire parses fine but is NOT
+    byte-canonical; the columnar path must store the canonical %04X
+    form exactly like the generic path."""
+    from crdt_tpu.testing import FakeClock
+    wire = ('{"a":{"hlc":"2023-05-06T07:08:09.123Z-00ab-peer",'
+            '"value":1}}')
+    c = SqliteCrdt("local", wall_clock=FakeClock())
+    c.merge_json(wire)
+    (stored,) = c._conn.execute(
+        "SELECT hlc FROM records WHERE key='a'").fetchone()
+    assert stored == "2023-05-06T07:08:09.123Z-00AB-peer"
