@@ -111,6 +111,45 @@ def bench_payload_wire_sqlite(n_keys=10_000, repeats=3):
         "wire-json-sqlite-durable", n_keys, repeats)
 
 
+def bench_payload_wire_watched(n_keys=1 << 20, repeats=1):
+    """The watch contract under bulk merge (VERDICT r3 item 4): one
+    active subscriber must not de-vectorize the wire ingest. Reports
+    the watched/unwatched slowdown for (a) a key-filtered subscriber
+    (the realistic watch shape — answered O(1) from the batch) and
+    (b) a whole-store recording subscriber (buffer extended in one
+    C-level pass)."""
+    src = MapCrdt("remote", wall_clock=FakeClock(start=_MILLIS))
+    src.put_all({f"key-{i}": {"s": "x" * (8 + i % 57), "i": i}
+                 for i in range(n_keys)})
+    wire = src.to_json()
+
+    def run(subscribe):
+        best = float("inf")
+        for _ in range(repeats + 1):
+            dst = TpuMapCrdt("local",
+                             wall_clock=FakeClock(start=_MILLIS + 10))
+            stream = subscribe(dst) if subscribe else None
+            t0 = time.perf_counter()
+            dst.merge_json(wire)
+            best = min(best, time.perf_counter() - t0)
+            if stream is not None:
+                assert stream.events, "subscriber saw no events"
+        return best
+
+    base = run(None)
+    keyed = run(lambda d: d.watch(key="key-7").record())
+    recording = run(lambda d: d.watch().record())
+    out = result_dict(
+        f"wire_json_{n_keys}key_watched_keyed_merges_per_sec",
+        n_keys, keyed, path="wire-json-columnar-watched")
+    out["slowdown_vs_unwatched"] = round(keyed / base, 3)
+    out2 = result_dict(
+        f"wire_json_{n_keys}key_watched_recording_merges_per_sec",
+        n_keys, recording, path="wire-json-columnar-watched")
+    out2["slowdown_vs_unwatched"] = round(recording / base, 3)
+    return out, out2
+
+
 def bench_dense_to_json(n_slots=1 << 20, repeats=3):
     """1M-slot full wire export on the dense model (the interop contract
     crdt.dart:124-135 at dense scale): lane-direct C-codec formatting."""
@@ -169,9 +208,10 @@ def main():
             print(f"suite config failed ({type(e).__name__}: {e})",
                   file=sys.stderr)
             return
-        if tag:
-            r["metric"] += f"_{tag}"
-        print(json.dumps(r), flush=True)
+        for row in (r if isinstance(r, tuple) else (r,)):
+            if tag:
+                row["metric"] += f"_{tag}"
+            print(json.dumps(row), flush=True)
 
     emit(bench_example_oracle)
     emit(bench_example_device)
@@ -196,6 +236,7 @@ def main():
     # scale DenseCrdt stores actually run at.
     emit(lambda: bench_payload_wire(n_keys=1 << 20, repeats=1))
     emit(lambda: bench_payload_wire_oracle(n_keys=1 << 20, repeats=1))
+    emit(bench_payload_wire_watched)
     emit(bench_dense_to_json)
     emit(bench_tpu_map_to_json)
 

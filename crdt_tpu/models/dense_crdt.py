@@ -314,25 +314,58 @@ class DenseCrdt:
     def _emit_put(self, slots, values, tombs=None) -> None:
         if not self._hub.active:
             return  # no subscribers: bulk path stays device-only
-        for i, (s, v) in enumerate(zip(np.asarray(slots),
-                                       np.asarray(values))):
+
+        def pairs():
+            s = np.asarray(slots)
+            v = np.asarray(values)
+            vals = [None if (tombs is not None and bool(tombs[i]))
+                    else int(v[i]) for i in range(len(s))]
+            return [int(x) for x in s], vals
+
+        slot_arr = np.asarray(slots)
+
+        def get(k):
+            if not isinstance(k, (int, np.integer)):
+                return False, None
+            hit = np.nonzero(slot_arr == k)[0]
+            if hit.size == 0:
+                return False, None
+            i = int(hit[-1])   # last write in the batch wins the event
             deleted = tombs is not None and bool(tombs[i])
-            self._hub.add(int(s), None if deleted else int(v))
+            return True, None if deleted else int(np.asarray(values)[i])
+
+        self._hub.add_batch(pairs, get)
 
     def _emit_delete(self, slots) -> None:
         if not self._hub.active:
             return
-        for s in np.asarray(slots):
-            self._hub.add(int(s), None)
+        slot_arr = np.asarray(slots)
+        self._hub.add_batch(
+            lambda: ([int(s) for s in slot_arr],
+                     [None] * len(slot_arr)),
+            lambda k: (isinstance(k, (int, np.integer))
+                       and bool(np.any(slot_arr == k)), None))
 
     def _emit_merge_wins(self, store: DenseStore, win) -> None:
         """Winner change events from the fan-in's win mask — batched,
-        post-dispatch (the device work is already queued)."""
+        post-dispatch (the device work is already queued); a subscriber
+        costs one win-mask readback, never a per-record device loop."""
         if not self._hub.active:
             return
         win, tomb, val = jax.device_get((win, store.tomb, store.val))
-        for s in np.nonzero(win)[0]:
-            self._hub.add(int(s), None if tomb[s] else int(val[s]))
+        widx = np.nonzero(win)[0]
+
+        def pairs():
+            return ([int(s) for s in widx],
+                    [None if tomb[s] else int(val[s]) for s in widx])
+
+        def get(k):
+            if not (isinstance(k, (int, np.integer))
+                    and 0 <= k < win.shape[0] and win[k]):
+                return False, None
+            return True, None if tomb[k] else int(val[k])
+
+        self._hub.add_batch(pairs, get)
 
     # --- wire interop (C10/C11): every replica speaks the JSON wire
     # format (crdt_json.dart:8-37; example/crdt_example.dart:12-16), so

@@ -321,8 +321,25 @@ class SqliteCrdt(Crdt[K, V], Generic[K, V]):
             with self._conn:
                 self._conn.executemany(self._UPSERT, rows)
             if self._hub.active:
-                for i in win_list:
-                    self._hub.add(keys[i], values[i])
+                # Batch emission (hub.add_batch contract): keyed
+                # streams answered from a lazily-built winner dict,
+                # never a per-record hub.add loop.
+                win_map = None
+
+                def get(k):
+                    nonlocal win_map
+                    if win_map is None:
+                        win_map = {keys[i]: values[i] for i in win_list}
+                    if k in win_map:
+                        return True, win_map[k]
+                    return False, None
+
+                if all_win:
+                    self._hub.add_batch(lambda: (keys, values), get)
+                else:
+                    self._hub.add_batch(
+                        lambda: ([keys[i] for i in win_list],
+                                 [values[i] for i in win_list]), get)
 
         self._canonical_time = Hlc.send(
             Hlc.from_logical_time(new_canonical, self._node_id),

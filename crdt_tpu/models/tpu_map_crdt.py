@@ -264,16 +264,36 @@ class TpuMapCrdt(Crdt[K, V]):
         l.occupied[slots] = True
         l.tomb[slots] = tomb
         self._device = None
+        self._scatter_all_and_emit(codec, slots, keys, values)
+
+    def _scatter_all_and_emit(self, codec, slots, keys, values) -> None:
+        """Whole-batch payload write (every entry lands — the put
+        shapes, where there is no LWW filter) + batch event emission.
+        The C scatter runs whether or not anyone is watching; events
+        come afterwards, so a subscriber never de-vectorizes a bulk
+        put (same contract as the merge path)."""
         payload = self._payload
-        emit = self._hub.active
-        if codec is not None and not emit:
+        if codec is not None:
             codec.scatter_payload(payload, slots,
-                                  np.arange(m, dtype=np.int64), values)
+                                  np.arange(len(keys), dtype=np.int64),
+                                  values)
         else:
-            for i, key in enumerate(keys):
+            for i in range(len(keys)):
                 payload[slots[i]] = values[i]
-                if emit:
-                    self._hub.add(key, values[i])
+        if self._hub.active:
+            key_to_slot = self._key_to_slot
+
+            def get(k):
+                slot = key_to_slot.get(k)
+                # batch slots are exactly this put's keys; a key maps
+                # into the batch iff its post-put payload position was
+                # just written — putAll batches are dict-keyed, so
+                # membership is equality of the stored slot
+                if slot is None or not np.any(slots == slot):
+                    return False, None
+                return True, payload[slot]
+
+            self._hub.add_batch(lambda: (list(keys), list(values)), get)
 
     def _delta_slots(self, modified_since: Optional[Hlc]) -> np.ndarray:
         """Occupied slot indices passing the INCLUSIVE ``modified``
@@ -319,17 +339,7 @@ class TpuMapCrdt(Crdt[K, V]):
             l.tomb[slots] = np.fromiter((v is None for v in vals),
                                         bool, count=len(vals))
         self._device = None
-        payload = self._payload
-        emit = self._hub.active
-        if codec is not None and not emit:
-            codec.scatter_payload(payload, slots,
-                                  np.arange(len(keys), dtype=np.int64),
-                                  vals)
-        else:
-            for i, key in enumerate(keys):
-                payload[slots[i]] = vals[i]
-                if emit:
-                    self._hub.add(key, vals[i])
+        self._scatter_all_and_emit(codec, slots, keys, vals)
 
     def record_map(self, modified_since: Optional[Hlc] = None
                    ) -> Dict[K, Record[V]]:
@@ -536,16 +546,38 @@ class TpuMapCrdt(Crdt[K, V]):
             self._device = None
 
         self.stats.records_adopted += int(winners.size)
+        # Payload scatter stays on the C path whether or not anyone is
+        # watching (a subscriber must not de-vectorize a 1M merge);
+        # events are emitted afterwards from the winner indices.
         payload = self._payload
-        emit = self._hub.active
-        if codec is not None and not emit:
+        if codec is not None:
             codec.scatter_payload(payload, slots, winners, values)
         else:
             for i in winners.tolist():
-                value = values[i]
-                payload[slots[i]] = value
-                if emit:
-                    self._hub.add(keys[i], value)
+                payload[slots[i]] = values[i]
+        if self._hub.active:
+            win_list = winners.tolist()
+            key_to_slot = self._key_to_slot
+
+            def get(k):
+                slot = key_to_slot.get(k)
+                if slot is None:
+                    return False, None
+                # Exact winner membership: one vectorized scan of the
+                # winner slots per keyed stream. (A mod_lt==canonical
+                # stamp test is NOT sound here — a merge that doesn't
+                # advance the clock leaves pre-merge records carrying
+                # the same stamp, yielding spurious events.)
+                if not bool(np.any(widx == slot)):
+                    return False, None
+                return True, payload[slot]
+
+            if len(win_list) == m:   # every record won (fresh sync)
+                self._hub.add_batch(lambda: (keys, values), get)
+            else:
+                self._hub.add_batch(
+                    lambda: ([keys[i] for i in win_list],
+                             [values[i] for i in win_list]), get)
 
         self._canonical_time = Hlc.send(
             Hlc.from_logical_time(new_canonical, self._node_id),

@@ -243,6 +243,37 @@ class CrdtConformance:
         assert [(e.key, e.value) for e in stream.events] == \
             [("y", 7), ("y", 9)]
 
+    def test_watch_bulk_merge_events(self):
+        # Bulk-merge reactivity at batch size: winners (and ONLY
+        # winners) emit — new keys, newer updates, merged-in
+        # tombstones — while LWW losers stay silent; a key-filtered
+        # stream sees exactly its key; an idempotent re-merge emits
+        # nothing. Pins the batch emission path the vectorized
+        # backends use (hub.add_batch), not just single-record adds.
+        from crdt_tpu import Hlc, Record
+        base = 1_700_000_000_000
+        crdt = self.make_crdt()
+        crdt.put_all({f"mine{i}": 100 + i for i in range(20)})
+        mk = lambda ms, v: Record(Hlc(ms, 0, "peer"), v,
+                                  Hlc(ms, 0, "peer"))
+        cs = {}
+        for i in range(20):
+            cs[f"mine{i}"] = mk(base - 1000, -1)     # losers: too old
+        for i in range(20):
+            cs[f"new{i}"] = mk(base + 100 + i,
+                               None if i % 5 == 0 else i)
+        whole = crdt.watch().record()
+        keyed = crdt.watch(key="new7").record()
+        crdt.merge(dict(cs))
+        got = sorted((e.key, e.value) for e in whole.events)
+        want = sorted((f"new{i}", None if i % 5 == 0 else i)
+                      for i in range(20))
+        assert got == want, f"winner events wrong: {got[:5]}..."
+        assert [(e.key, e.value) for e in keyed.events] == [("new7", 7)]
+        crdt.merge(dict(cs))                          # idempotent
+        assert len(whole.events) == 20
+        assert len(keyed.events) == 1
+
     # --- Merge algebra: the CRDT laws (SURVEY.md §5 race-detection
     # equivalent — commutativity/associativity/idempotence under
     # permutation, map_crdt_test.dart:252-269 in spirit) ---

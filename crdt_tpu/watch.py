@@ -23,6 +23,9 @@ class ChangeEvent(NamedTuple):
     value: Any
 
 
+_ANY_KEY = object()   # sentinel: stream not filtered to a single key
+
+
 class ChangeStream:
     """A filtered view over a :class:`ChangeHub`.
 
@@ -31,9 +34,15 @@ class ChangeStream:
     """
 
     def __init__(self, hub: "ChangeHub",
-                 predicate: Optional[Callable[[ChangeEvent], bool]] = None):
+                 predicate: Optional[Callable[[ChangeEvent], bool]] = None,
+                 key_filter: Any = _ANY_KEY):
         self._hub = hub
         self._predicate = predicate
+        # When the stream is exactly a single-key filter (the common
+        # `watch(key=...)` shape), the key is kept structurally so
+        # batch emission can answer it in O(1) instead of scanning the
+        # batch; `where()` chains fall back to the per-event path.
+        self._key_filter = key_filter
         self._buffer: List[ChangeEvent] = []
         self._recording = False
         # Each subscription is a single-element list token so duplicate
@@ -48,6 +57,17 @@ class ChangeStream:
             self._buffer.append(event)
         for token in list(self._callbacks):
             token[0](event)
+
+    def _emit_many(self, keys, values) -> None:
+        """Batch emission: an unfiltered recording-only stream extends
+        its buffer in one C-level pass (no per-event Python); anything
+        with a predicate or callbacks takes the per-event path."""
+        if self._predicate is None and not self._callbacks:
+            if self._recording:
+                self._buffer.extend(map(ChangeEvent, keys, values))
+            return
+        for k, v in zip(keys, values):
+            self._emit(ChangeEvent(k, v))
 
     def listen(self, callback: Callable[[ChangeEvent], None]
                ) -> Callable[[], None]:
@@ -82,6 +102,7 @@ class ChangeStream:
         prev = self._predicate
         combined = (predicate if prev is None
                     else (lambda e: prev(e) and predicate(e)))
+        # a custom predicate can't be answered structurally
         return ChangeStream(self._hub, combined)
 
     def cancel(self) -> None:
@@ -205,6 +226,32 @@ class ChangeHub:
         for stream in list(self._streams):
             stream._emit(event)
 
+    def add_batch(self, pairs,
+                  get: Optional[Callable[[Any], tuple]] = None) -> None:
+        """Emit a whole batch of (key, value) changes.
+
+        Equivalent to ``add`` per pair, but bulk backends stay
+        vectorized: ``pairs`` is ``(keys, values)`` or a zero-arg
+        callable producing it, materialized at most once and ONLY if
+        some stream needs the full batch — single-key-filtered
+        streams are answered via ``get(key) -> (present, value)``,
+        the caller's O(1) lookup into the batch, without touching it.
+        Unfiltered recording streams extend their buffers in one
+        pass; predicate/callback streams take the per-event path."""
+        mat = None
+        for stream in list(self._streams):
+            k = stream._key_filter
+            if k is not _ANY_KEY and get is not None:
+                present, v = get(k)
+                if present:
+                    stream._emit(ChangeEvent(k, v))
+                continue
+            if mat is None:
+                mat = pairs() if callable(pairs) else pairs
+            stream._emit_many(*mat)
+
     def stream(self, key: Any = None) -> ChangeStream:
-        predicate = None if key is None else (lambda e: e.key == key)
-        return ChangeStream(self, predicate)
+        if key is None:
+            return ChangeStream(self)
+        return ChangeStream(self, lambda e: e.key == key,
+                            key_filter=key)

@@ -397,3 +397,23 @@ class TestTickParity:
             tpu.merge(dict(cs))
             assert co.reads == ct.reads
         assert oracle.to_json() == tpu.to_json()
+
+
+def test_keyed_watch_no_spurious_event_when_clock_static():
+    """A merge that does NOT advance the canonical clock (every remote
+    record older/losing) must emit nothing — the keyed winner check
+    may not confuse pre-merge records stamped at the current canonical
+    with this merge's winners."""
+    clk = FakeClock()
+    c = TpuMapCrdt("abc", wall_clock=clk)
+    c.put("a", 1)
+    stream = c.watch(key="a").record()
+    whole = c.watch().record()
+    old = Hlc(1_600_000_000_000, 0, "peer")
+    c.merge({"b": Record(old, 99, old)})   # b wins (new key), a untouched
+    assert [(e.key, e.value) for e in stream.events] == []
+    assert [(e.key, e.value) for e in whole.events] == [("b", 99)]
+    # and a merge where the watched key LOSES an exact tie stays silent
+    rec_a = c.get_record("a")
+    c.merge({"a": Record(rec_a.hlc, 77, rec_a.hlc)})  # exact tie: local wins
+    assert [(e.key, e.value) for e in stream.events] == []
