@@ -191,7 +191,7 @@ def bench(n_keys: int, n_replicas: int, chunk_replicas: int,
     return out
 
 
-def bench_distinct(n_keys: int, n_rows: int, loops: int = 16,
+def bench_distinct(n_keys: int, n_rows: int, loops: int = 48,
                    interpret: bool = False) -> dict:
     """GENUINELY DISTINCT replica rows: one [n_rows, n_keys] changeset
     resident in HBM — every record independent random data — merged by
@@ -220,7 +220,7 @@ def bench_distinct(n_keys: int, n_rows: int, loops: int = 16,
     def run(store, scs, canonical, local_node, wall):
         st2, res = pallas_fanin_batch(
             split_store(store), scs, canonical,
-            local_node, wall, chunk_rows=8, interpret=interpret)
+            local_node, wall, chunk_rows=16, interpret=interpret)
         return st2, res.new_canonical
 
     args = (store, scs, jnp.int64(_MILLIS << SHIFT), jnp.int32(0),
@@ -277,8 +277,11 @@ def main() -> None:
                          "independent replica rows (north-star shape)")
     ap.add_argument("--rows", type=int, default=128,
                     help="distinct mode: replica rows resident in HBM")
-    ap.add_argument("--loops", type=int, default=16,
-                    help="distinct mode: chained full passes")
+    ap.add_argument("--loops", type=int, default=48,
+                    help="distinct mode: chained full passes (the "
+                         "one-off dispatch/fence round trip is ~100ms "
+                         "on remote-proxied chips; more loops keep it "
+                         "out of the steady-state number)")
     args = ap.parse_args()
 
     if args.smoke:

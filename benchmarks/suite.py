@@ -226,7 +226,7 @@ def main():
     # GENUINELY DISTINCT replica rows resident in HBM (the
     # BASELINE.md:26 north-star workload; every counted merge pays its
     # full HBM read — see bench.bench_distinct).
-    emit(lambda: bench_distinct(1 << 20, 128, loops=16))
+    emit(lambda: bench_distinct(1 << 20, 128, loops=48))
     emit(lambda: bench(1 << 20, 1024, 8, config="tombstone", repeats=64))
     emit(lambda: bench(1 << 20, 1024, 8, config="tiebreak", repeats=64))
     emit(bench_payload_wire)

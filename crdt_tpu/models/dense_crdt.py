@@ -314,15 +314,16 @@ class DenseCrdt:
     def _emit_put(self, slots, values, tombs=None) -> None:
         if not self._hub.active:
             return  # no subscribers: bulk path stays device-only
+        # Host copies ONCE per batch — the arrays arrive as device
+        # buffers here, and a per-lookup np.asarray would re-transfer
+        # the whole lane to read one element.
+        slot_arr = np.asarray(slots)
+        val_arr = np.asarray(values)
 
         def pairs():
-            s = np.asarray(slots)
-            v = np.asarray(values)
             vals = [None if (tombs is not None and bool(tombs[i]))
-                    else int(v[i]) for i in range(len(s))]
-            return [int(x) for x in s], vals
-
-        slot_arr = np.asarray(slots)
+                    else int(val_arr[i]) for i in range(len(slot_arr))]
+            return [int(x) for x in slot_arr], vals
 
         def get(k):
             if not isinstance(k, (int, np.integer)):
@@ -330,21 +331,27 @@ class DenseCrdt:
             hit = np.nonzero(slot_arr == k)[0]
             if hit.size == 0:
                 return False, None
-            i = int(hit[-1])   # last write in the batch wins the event
+            i = int(hit[-1])
             deleted = tombs is not None and bool(tombs[i])
-            return True, None if deleted else int(np.asarray(values)[i])
+            return True, None if deleted else int(val_arr[i])
 
-        self._hub.add_batch(pairs, get)
+        # A raw slot array may repeat a slot; keyed streams must then
+        # see every occurrence (add_batch's per-pair contract), so the
+        # O(1) keyed shortcut only applies to duplicate-free batches.
+        unique = len(np.unique(slot_arr)) == len(slot_arr)
+        self._hub.add_batch(pairs, get if unique else None)
 
     def _emit_delete(self, slots) -> None:
         if not self._hub.active:
             return
         slot_arr = np.asarray(slots)
+        unique = len(np.unique(slot_arr)) == len(slot_arr)
         self._hub.add_batch(
             lambda: ([int(s) for s in slot_arr],
                      [None] * len(slot_arr)),
-            lambda k: (isinstance(k, (int, np.integer))
-                       and bool(np.any(slot_arr == k)), None))
+            (lambda k: (isinstance(k, (int, np.integer))
+                        and bool(np.any(slot_arr == k)), None))
+            if unique else None)
 
     def _emit_merge_wins(self, store: DenseStore, win) -> None:
         """Winner change events from the fan-in's win mask — batched,
@@ -721,13 +728,24 @@ class DenseCrdt:
     def _use_pallas(self) -> bool:
         """Route merges through the Mosaic kernel? ``executor=`` forces
         it on ("pallas" / "pallas-interpret") or off ("xla"); "auto"
-        takes the kernel whenever the store is tile-aligned and the
-        backend is an accelerator."""
+        takes the kernel whenever the store is tile-aligned, the node
+        table fits the kernel's int16 wire lane, and the backend is an
+        accelerator."""
+        from ..ops.pallas_merge import MAX_NODE_ORDINAL, TILE
+        if len(self._table.ids()) > MAX_NODE_ORDINAL:
+            # The kernel's changeset node lane is int16 (ordinals are
+            # distinct-replica counts); a table past 32k ordinals
+            # routes to the XLA fold rather than wrapping silently.
+            if self._executor in ("pallas", "pallas-interpret"):
+                raise ValueError(
+                    f"executor={self._executor!r} supports at most "
+                    f"{MAX_NODE_ORDINAL} node ordinals; table holds "
+                    f"{len(self._table.ids())}")
+            return False
         if self._executor == "xla":
             return False
         if self._executor in ("pallas", "pallas-interpret"):
             return True
-        from ..ops.pallas_merge import TILE
         # Mosaic lowers on TPU only — a GPU backend must keep the XLA
         # fold, not crash in pltpu BlockSpecs.
         return (self.n_slots % TILE == 0

@@ -67,14 +67,25 @@ class SplitStore(NamedTuple):
     mod_node: jax.Array  # int32[N]
 
 
+# Node ordinals ride an int16 changeset lane (ordinals count DISTINCT
+# replica ids — far below 32k in any real deployment); the in-kernel
+# compare widens to int32, so (lt, node) semantics are unchanged while
+# the wire lane costs 2 bytes instead of 4. I16_NEG is the invalid
+# sentinel (widens below any real ordinal, which are >= 0).
+I16_NEG = -(2 ** 15)
+MAX_NODE_ORDINAL = 2 ** 15 - 1
+
+
 class SplitChangeset(NamedTuple):
-    """[R, N] changeset lanes, invalid entries pre-masked to sentinels."""
+    """[R, N] changeset lanes, invalid entries pre-masked to sentinels.
+    Narrow wire lanes (int16 node, int8 tomb) cut HBM traffic per
+    merge from 24 B to 19 B; compares run widened in-kernel."""
     hi: jax.Array      # int32[R, N] (NEG_HI = invalid)
     lo: jax.Array      # uint32[R, N]
-    node: jax.Array    # int32[R, N] (_I32_NEG when invalid)
+    node: jax.Array    # int16[R, N] (I16_NEG when invalid)
     val_hi: jax.Array  # int32[R, N]
     val_lo: jax.Array  # uint32[R, N]
-    tomb: jax.Array    # int32[R, N]
+    tomb: jax.Array    # int8[R, N]
 
 
 def _split64(x: jax.Array) -> Tuple[jax.Array, jax.Array]:
@@ -113,11 +124,14 @@ def split_changeset(cs: DenseChangeset) -> SplitChangeset:
     lt = jnp.where(cs.valid, cs.lt, _NEG)
     hi, lo = _split64(lt)
     val_hi, val_lo = _split64(cs.val)
+    # Callers must keep node ordinals <= MAX_NODE_ORDINAL (the model
+    # layer routes to the XLA fold beyond that); the cast would wrap
+    # silently under jit, so the bound is enforced host-side.
     return SplitChangeset(
         hi=hi, lo=lo,
-        node=jnp.where(cs.valid, cs.node, _I32_NEG),
+        node=jnp.where(cs.valid, cs.node, I16_NEG).astype(jnp.int16),
         val_hi=val_hi, val_lo=val_lo,
-        tomb=cs.tomb.astype(jnp.int32))
+        tomb=cs.tomb.astype(jnp.int8))
 
 
 def _lex_gt(a_hi, a_lo, a_node, b_hi, b_lo, b_node):
@@ -225,7 +239,9 @@ def _fanin_stream_kernel(exact_guards, advance_clock,
     for r in range(cs_hi.shape[0]):  # static unroll over replica rows
         hi0 = cs_hi[r]
         lo0 = cs_lo[r]
-        node = cs_node[r]
+        # Narrow wire lanes widen on load: compares are int32 either
+        # way, so (lt, node) semantics are untouched.
+        node = cs_node[r].astype(jnp.int32)
         if advance_clock:
             # Advance the chunk clock on real lanes only: the NEG
             # sentinel must stay the unique minimum (its lo is 0, so a
@@ -253,7 +269,7 @@ def _fanin_stream_kernel(exact_guards, advance_clock,
         b_node = jnp.where(gt, node, b_node)
         b_vhi = jnp.where(gt, cs_vhi[r], b_vhi)
         b_vlo = jnp.where(gt, cs_vlo[r], b_vlo)
-        b_tomb = jnp.where(gt, cs_tomb[r], b_tomb)
+        b_tomb = jnp.where(gt, cs_tomb[r].astype(jnp.int32), b_tomb)
         win = win | gt
 
     o_hi[...] = b_hi
@@ -281,15 +297,26 @@ def _fanin_stream_kernel(exact_guards, advance_clock,
 
 
 # Tile geometry: (sublane, lane) int32 tiles (Mosaic floor: sublane %
-# 8 == 0, lane % 128 == 0). (8, 1024) measured fastest on v5e for the
-# per-chunk launch — 4.65B merges/s vs 4.34B at (8, 512), 3.85B at
-# (8, 2048), 3.80B at (32, 512); (32, 1024) exceeds VMEM. The
-# multi-chunk stream grid keeps the same tile and reaches ~42B
-# merges/s device-side (~34B wall) at the 1M×1024 headline — the
-# VMEM-resident store amortizes HBM traffic across the chunk dim.
+# 8 == 0, lane % 128 == 0). The two kernels want DIFFERENT tiles
+# (measured on v5e with the narrow int16/int8 wire lanes, 48-loop
+# production-kernel runs — not synthetic probes, which mislead on
+# this platform; docs/PERF.md):
+#
+# - distinct batch (HBM-bound; every chunk reads fresh rows):
+#   (8, 512) → 7.40B merges/s vs 6.6B at (8, 1024), 7.0B at (8, 2048).
+# - stream replay (compute-bound; the cs block is VMEM-resident
+#   across chunks): (8, 1024) → 69B vs 58B at (8, 512).
+#
+# TILE (the n_slots alignment floor, 4096) is the batch tile; the
+# stream path upgrades its lane width to 1024 when n_slots allows.
 _SB = 8
-_LANE = 1024
+_LANE = 512
 TILE = _SB * _LANE  # n_slots must be a multiple of this
+_STREAM_LANE = 1024
+
+
+def _stream_tile_lane(n: int) -> int:
+    return _STREAM_LANE if n % (_SB * _STREAM_LANE) == 0 else _LANE
 
 
 @partial(jax.jit, static_argnames=("interpret",))
@@ -359,12 +386,19 @@ def pallas_fanin_stream(store: SplitStore, cs: SplitChangeset,
     # basemax + c<<SHIFT, threaded against canonical in-kernel.
     m_hi = jnp.max(cs.hi)
     m_lo = jnp.max(jnp.where(cs.hi == m_hi, cs.lo, 0))
+    # The replayed changeset block is VMEM-resident across the chunk
+    # dim, so its lane width costs nothing in HBM — widen the narrow
+    # wire lanes ONCE here and the in-kernel astype becomes identity
+    # (the compute-bound replay loses no VPU cycles to widening).
+    cs = cs._replace(node=cs.node.astype(jnp.int32),
+                     tomb=cs.tomb.astype(jnp.int32))
     outs = _launch_stream_grid(
         guards == "exact", True, store, cs, canonical_lt, local_node,
         wall_millis, m_hi, m_lo, cs_block_rows=r,
         cs_index_map=lambda i, c: (jnp.int32(0), jnp.int32(i),
                                    jnp.int32(0)),
-        n_chunks=n_chunks, interpret=interpret)
+        n_chunks=n_chunks, interpret=interpret,
+        lane=_stream_tile_lane(n))
 
     final_off = ((n_chunks - 1) << SHIFT)
     basemax = _join64(m_hi, m_lo)
@@ -401,7 +435,7 @@ def pallas_fanin_stream(store: SplitStore, cs: SplitChangeset,
 def _max_local_lt(cs: SplitChangeset, local_node: jax.Array) -> jax.Array:
     """Max logicalTime over the changeset's local-node records (the
     closed-form dup-candidate bound); NEG when there are none."""
-    loc = cs.node == local_node
+    loc = cs.node.astype(jnp.int32) == local_node
     ml_hi = jnp.max(jnp.where(loc, cs.hi, NEG_HI))
     ml_lo = jnp.max(jnp.where(loc & (cs.hi == ml_hi), cs.lo, 0))
     return _join64(ml_hi, ml_lo)
@@ -410,13 +444,13 @@ def _max_local_lt(cs: SplitChangeset, local_node: jax.Array) -> jax.Array:
 def _launch_stream_grid(exact_guards, advance_clock, store, cs,
                         canonical_lt, local_node, wall_millis, m_hi, m_lo,
                         *, cs_block_rows, cs_index_map, n_chunks,
-                        interpret):
+                        interpret, lane=_LANE):
     """Shared pallas_call plumbing for the (row_blocks, n_chunks) grid:
     scalar stack, block specs, reshapes, out shapes, store aliasing.
-    The two wrappers differ only in the kernel's static flags and the
-    changeset block geometry/index map."""
+    The two wrappers differ only in the kernel's static flags, the
+    changeset block geometry/index map, and the tile lane width."""
     r, n = cs.hi.shape
-    rows = n // _LANE
+    rows = n // lane
     canon_hi, canon_lo = _split64(canonical_lt)
     thresh_hi, thresh_lo = _split64(
         ((wall_millis + MAX_DRIFT) << SHIFT) | MAX_COUNTER)
@@ -426,19 +460,19 @@ def _launch_stream_grid(exact_guards, advance_clock, store, cs,
         m_hi, m_lo.astype(jnp.int32)]).astype(jnp.int32)
 
     _i32 = jnp.int32
-    cs_spec = pl.BlockSpec((cs_block_rows, _SB, _LANE), cs_index_map,
+    cs_spec = pl.BlockSpec((cs_block_rows, _SB, lane), cs_index_map,
                            memory_space=pltpu.VMEM)
-    st_spec = pl.BlockSpec((_SB, _LANE), lambda i, c: (_i32(i), _i32(0)),
+    st_spec = pl.BlockSpec((_SB, lane), lambda i, c: (_i32(i), _i32(0)),
                            memory_space=pltpu.VMEM)
     flag_spec = pl.BlockSpec((1, 1), lambda i, c: (_i32(0), _i32(0)),
                              memory_space=pltpu.SMEM)
 
-    st2d = [lane.reshape(rows, _LANE) for lane in store]
-    cs3d = [lane.reshape(r, rows, _LANE) for lane in cs]
+    st2d = [ln.reshape(rows, lane) for ln in store]
+    cs3d = [ln.reshape(r, rows, lane) for ln in cs]
 
     out_shapes = (
-        [jax.ShapeDtypeStruct((rows, _LANE), lane.dtype) for lane in st2d] +
-        [jax.ShapeDtypeStruct((rows, _LANE), jnp.int32),  # win (OR)
+        [jax.ShapeDtypeStruct((rows, lane), ln.dtype) for ln in st2d] +
+        [jax.ShapeDtypeStruct((rows, lane), jnp.int32),   # win (OR)
          jax.ShapeDtypeStruct((1, 1), jnp.int32),         # any_dup
          jax.ShapeDtypeStruct((1, 1), jnp.int32)])        # any_drift
 

@@ -237,9 +237,16 @@ class ChangeHub:
         streams are answered via ``get(key) -> (present, value)``,
         the caller's O(1) lookup into the batch, without touching it.
         Unfiltered recording streams extend their buffers in one
-        pass; predicate/callback streams take the per-event path."""
+        pass; predicate/callback streams take the per-event path.
+
+        ``get`` answers a key AT MOST ONCE per batch; callers whose
+        batch may repeat a key (raw slot arrays, not dict-keyed
+        payloads) must pass ``get=None`` so keyed streams see every
+        occurrence like everyone else."""
         mat = None
         for stream in list(self._streams):
+            if not (stream._recording or stream._callbacks):
+                continue   # no sink: never materialize on its behalf
             k = stream._key_filter
             if k is not _ANY_KEY and get is not None:
                 present, v = get(k)

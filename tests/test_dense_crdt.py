@@ -802,12 +802,13 @@ class TestGrow:
         assert b.get(N + 1) == 44 and b.get(3) == 33
 
     def test_grow_forced_pallas_requires_alignment(self):
-        a = DenseCrdt("na", 8192, wall_clock=FakeClock(start=BASE),
+        from crdt_tpu.ops.pallas_merge import TILE
+        a = DenseCrdt("na", TILE, wall_clock=FakeClock(start=BASE),
                       executor="pallas-interpret")
-        with pytest.raises(ValueError, match="8192"):
-            a.grow(8192 + 16)
-        a.grow(2 * 8192)                   # aligned growth fine
-        assert a.n_slots == 2 * 8192
+        with pytest.raises(ValueError, match=str(TILE)):
+            a.grow(TILE + 16)
+        a.grow(2 * TILE)                   # aligned growth fine
+        assert a.n_slots == 2 * TILE
 
     @pytest.mark.parametrize("seed", range(2))
     def test_fuzz_mixed_capacity_convergence(self, seed):

@@ -124,3 +124,41 @@ def test_aiter_works_on_all_backends():
             return [(e.key, e.value) async for e in it]
 
         assert run(consume()) == [("k", 7)], type(crdt).__name__
+
+
+def test_add_batch_skips_sinkless_streams():
+    """An idle watch() handle (no record()/listen()) must not force
+    batch materialization or keyed scans."""
+    from crdt_tpu.watch import ChangeHub
+    hub = ChangeHub()
+    hub.stream()          # idle unfiltered handle
+    hub.stream(key="k")   # idle keyed handle
+    live = hub.stream(key="k").record()
+    calls = {"pairs": 0, "get": 0}
+
+    def pairs():
+        calls["pairs"] += 1
+        return ["k"], [1]
+
+    def get(k):
+        calls["get"] += 1
+        return True, 1
+
+    hub.add_batch(pairs, get)
+    assert calls["pairs"] == 0      # idle streams forced nothing
+    assert calls["get"] == 1        # only the live keyed stream asked
+    assert [(e.key, e.value) for e in live.events] == [("k", 1)]
+
+
+def test_dense_duplicate_slot_batch_events_agree():
+    """put_batch with a repeated slot: keyed and whole-store
+    subscribers see the SAME per-occurrence events."""
+    from crdt_tpu import DenseCrdt
+    from crdt_tpu.testing import FakeClock
+    c = DenseCrdt("n", 64, wall_clock=FakeClock())
+    keyed = c.watch(5).record()
+    whole = c.watch().record()
+    c.put_batch([5, 3, 5], [1, 9, 2])
+    assert [(e.key, e.value) for e in keyed.events] == [(5, 1), (5, 2)]
+    assert [(e.key, e.value) for e in whole.events] == \
+        [(5, 1), (3, 9), (5, 2)]
