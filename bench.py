@@ -243,6 +243,94 @@ def bench_distinct(n_keys: int, n_rows: int, loops: int = 48,
     return out
 
 
+def bench_e2e_1024(n_keys: int, rows_per_pass: int = 128,
+                   passes: int = 8, through_model: bool = True) -> dict:
+    """THE north-star workload, end to end: 1M keys × 1024 DISTINCT
+    replica rows, as ``passes`` freshly device-generated
+    ``rows_per_pass``-row changesets — no replay, every counted merge
+    pays full HBM traffic AND the generation cost of its data (the
+    batches cannot all be HBM-resident at once; generating in-loop is
+    disclosed in the protocol fields and can only make the number
+    worse).
+
+    ``through_model=True`` drives `DenseCrdt.merge` inside a
+    ``pipelined()`` window (real model API: ordinal remap, fit_slots,
+    stats, device clock threading, guard accumulation — zero host
+    syncs until the closing flush). ``False`` runs the identical loop
+    shape against the raw kernel (gen → split → `pallas_fanin_batch`,
+    canonical threaded by hand) — the pair isolates model-API overhead
+    at the headline scale."""
+    from crdt_tpu import DenseCrdt
+    platform = jax.devices()[0].platform
+    ids = [f"n{i}" for i in range(9)]   # make_changeset ordinals 1..8
+    n_rows_total = rows_per_pass * passes
+
+    # Valid-lane counts per pass, computed OUTSIDE the timed loop.
+    merges = 0
+    for p in range(passes):
+        cs = make_changeset(rows_per_pass, n_keys, seed=p)
+        merges += int(jnp.sum(cs.valid))
+        del cs
+
+    if through_model:
+        crdt = DenseCrdt("n0", n_keys, node_ids=ids)
+        # warm the whole path (compile) with pass 0, then rebuild
+        with crdt.pipelined():
+            crdt.merge(make_changeset(rows_per_pass, n_keys, seed=0),
+                       ids)
+        crdt = DenseCrdt("n0", n_keys, node_ids=ids)
+        t0 = time.perf_counter()
+        with crdt.pipelined():   # exit = ONE fenced readback
+            for p in range(passes):
+                crdt.merge(
+                    make_changeset(rows_per_pass, n_keys, seed=p), ids)
+        elapsed = time.perf_counter() - t0
+        path = ("model-pipelined-" +
+                ("pallas" if crdt._use_pallas() else "xla"))
+    else:
+        from crdt_tpu.ops.pallas_merge import (pallas_fanin_batch,
+                                               split_changeset,
+                                               split_store)
+        store = split_store(empty_dense_store(n_keys))
+        wall = jnp.int64(_MILLIS + 10_000)
+
+        @jax.jit
+        def step(store, cs, canonical):
+            st2, res = pallas_fanin_batch(
+                store, split_changeset(cs), canonical, jnp.int32(0),
+                wall, chunk_rows=16)
+            return st2, res.new_canonical
+
+        canonical = jnp.int64(0)
+        st, canonical = step(store, make_changeset(
+            rows_per_pass, n_keys, seed=0), canonical)
+        int(jax.device_get(canonical))   # warm + fence
+        store = split_store(empty_dense_store(n_keys))
+        canonical = jnp.int64(0)
+        t0 = time.perf_counter()
+        for p in range(passes):
+            store, canonical = step(
+                store, make_changeset(rows_per_pass, n_keys, seed=p),
+                canonical)
+        int(jax.device_get(canonical))
+        elapsed = time.perf_counter() - t0
+        path = "raw-kernel"
+
+    out = result_dict(
+        f"record_merges_per_sec_{n_keys // 1000}k_keys_"
+        f"x{n_rows_total}_distinct_replicas_e2e_"
+        f"{'model' if through_model else 'kernel'}",
+        merges, elapsed, path=path, platform=platform)
+    out["protocol"] = {
+        "passes": passes, "rows_per_pass": rows_per_pass,
+        "fresh_device_generated_batches": True,
+        "includes_generation_cost": True,
+        "api": ("DenseCrdt.merge in a pipelined() window"
+                if through_model else
+                "pallas_fanin_batch loop, hand-threaded canonical")}
+    return out
+
+
 def result_dict(metric: str, merges: int, secs: float,
                 path: str = None, platform: str = None) -> dict:
     """The one-line JSON contract shared by bench.py and the suite.
@@ -270,11 +358,15 @@ def main() -> None:
     ap.add_argument("--config", choices=tuple(CONFIGS), default="fanin")
     ap.add_argument("--repeats", type=int, default=64,
                     help="chained timed runs (one readback at the end)")
-    ap.add_argument("--mode", choices=("stream", "distinct"),
+    ap.add_argument("--mode",
+                    choices=("stream", "distinct", "e2e", "e2e-kernel"),
                     default="stream",
                     help="stream: write-stream replay (chunk replayed "
                          "with +1ms offsets); distinct: HBM-resident "
-                         "independent replica rows (north-star shape)")
+                         "independent replica rows (north-star shape); "
+                         "e2e: 1024 fresh distinct rows through the "
+                         "model API (pipelined); e2e-kernel: same loop "
+                         "against the raw kernel")
     ap.add_argument("--rows", type=int, default=128,
                     help="distinct mode: replica rows resident in HBM")
     ap.add_argument("--loops", type=int, default=48,
@@ -292,7 +384,13 @@ def main() -> None:
     n_replicas = args.replicas or n_replicas
     chunk = args.chunk or chunk
 
-    if args.mode == "distinct":
+    if args.mode in ("e2e", "e2e-kernel"):
+        result = bench_e2e_1024(
+            n_keys,
+            rows_per_pass=16 if args.smoke else args.rows,
+            passes=2 if args.smoke else 8,
+            through_model=args.mode == "e2e")
+    elif args.mode == "distinct":
         result = bench_distinct(n_keys, 16 if args.smoke else args.rows,
                                 loops=args.loops)
     else:

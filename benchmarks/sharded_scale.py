@@ -1,12 +1,19 @@
 """Sharded fan-in at scale on the virtual 8-device mesh.
 
-Round-2 verdict: the sharded path's evidence was dryrun-scale only
-(64 records), and `ShardedDenseCrdt.put_batch` re-shards the whole
-store after every local write batch with unmeasured cost. This harness
-runs the 8-device (2 replica-shards × 4 key-shards) mesh at
-≥256k keys × 64 replica rows with a lane-exact cross-check against the
-single-device executor, times the put_batch path, and writes a
-MULTICHIP-style JSON artifact.
+Correctness at scale (round 2) plus a WEAK-SCALING characterization
+(round 4): 1/2/4/8 devices with FIXED per-device key shards, timing
+the sharded fan-in and `put_batch` at each width, against the
+single-device executor at the same total size. The round-3 verdict's
+gap — "no 1/2/4/8 curve separating collective overhead from the
+virtual-CPU artifact" — is this curve; write scatters now land
+pre-sharded (`with_sharding_constraint` inside the jit), closing the
+3.4× sharded `put_batch` overhead.
+
+CAVEAT the artifact also records: these are 8 VIRTUAL CPU devices on
+one host — absolute times mean nothing and "collectives" are memcpy;
+the curve's SHAPE (does per-device work stay flat as devices grow?)
+and the sharded/single write ratio are the meaningful outputs. Real
+ICI scaling needs real chips.
 
 Run:
     python benchmarks/sharded_scale.py [--keys 262144] [--rows 64]
@@ -63,7 +70,7 @@ def main() -> None:
     ap = argparse.ArgumentParser()
     ap.add_argument("--keys", type=int, default=1 << 18)
     ap.add_argument("--rows", type=int, default=64)
-    ap.add_argument("--out", default="MULTICHIP_SCALE_r03.json")
+    ap.add_argument("--out", default="MULTICHIP_SCALE_r04.json")
     args = ap.parse_args()
     n, rows = args.keys, args.rows
 
@@ -115,21 +122,48 @@ def main() -> None:
     slots = np.arange(0, k * 16, 16)
     vals = np.arange(k, dtype=np.int64)
     sharded2.put_batch(slots, vals)  # compile
-    jax.block_until_ready(sharded2.store.lt)
-    t0 = time.perf_counter()
-    reps = 10
-    for _ in range(reps):
-        sharded2.put_batch(slots, vals)
-    jax.block_until_ready(sharded2.store.lt)
-    put_sharded = (time.perf_counter() - t0) / reps
-
     single.put_batch(slots, vals)
+    jax.block_until_ready(sharded2.store.lt)
     jax.block_until_ready(single.store.lt)
-    t0 = time.perf_counter()
+    # Interleaved best-of reps: host-contention noise on the virtual
+    # mesh hits both sides alike, so the RATIO stays meaningful.
+    reps = 12
+    put_sharded = put_single = float("inf")
     for _ in range(reps):
+        t0 = time.perf_counter()
+        sharded2.put_batch(slots, vals)
+        jax.block_until_ready(sharded2.store.lt)
+        put_sharded = min(put_sharded, time.perf_counter() - t0)
+        t0 = time.perf_counter()
         single.put_batch(slots, vals)
-    jax.block_until_ready(single.store.lt)
-    put_single = (time.perf_counter() - t0) / reps
+        jax.block_until_ready(single.store.lt)
+        put_single = min(put_single, time.perf_counter() - t0)
+
+    # Dispatch floor: one trivial elementwise program over the same
+    # store — what merely RUNNING an 8-partition program on this ONE
+    # host costs, independent of any scatter work. The sharded write's
+    # "overhead" over single-device is ~this floor (plus each
+    # partition scanning the replicated index list serially on one
+    # host); on real chips partitions dispatch in parallel and the
+    # floor collapses. No re-shard exists: see
+    # sharded_put_collective_free below.
+    @jax.jit
+    def _touch(store):
+        return type(store)(*(
+            (lane if lane.dtype == bool else lane + 0)
+            for lane in store))
+
+    floors = {}
+    for label, cc in (("sharded", sharded2), ("single_device", single)):
+        st = cc.store
+        jax.block_until_ready(_touch(st))
+        best = float("inf")
+        for _ in range(reps):
+            t0 = time.perf_counter()
+            jax.block_until_ready(_touch(st))
+            best = min(best, time.perf_counter() - t0)
+        floors[label] = round(best * 1e3, 3)
+    result["dispatch_floor_ms"] = floors
 
     shardings = {str(getattr(sharded2.store, f).sharding)
                  for f in sharded2.store._fields}
@@ -139,6 +173,78 @@ def main() -> None:
     }
     result["store_sharding_consistent"] = len(shardings) == 1
     result["store_sharding"] = shardings.pop()
+
+    # --- weak scaling: fixed per-device keys, 1/2/4/8 devices ---
+    # replica axis fixed at 2 (1-device mesh has 1); key shards grow
+    # with the device count, so per-device key work is constant.
+    per_dev_keys = n // 4               # matches the 8-dev (2,4) mesh
+    curve = []
+    for n_dev, (r_sh, k_sh) in [(1, (1, 1)), (2, (2, 1)),
+                                (4, (2, 2)), (8, (2, 4))]:
+        keys_d = per_dev_keys * k_sh
+        mesh_d = make_fanin_mesh(r_sh, k_sh,
+                                 devices=jax.devices()[:n_dev])
+        batches = random_changesets(rows, keys_d, seed=11, n_groups=4)
+        m_count = int(sum(int(jnp.sum(cs.valid)) for cs, _ in batches))
+        c = ShardedDenseCrdt("local", keys_d, mesh_d,
+                             wall_clock=FakeClock(start=BASE + 2000))
+        c.merge_many(batches)                      # compile
+        jax.block_until_ready(c.store.lt)
+        # Best-of protocol throughout (same rationale as the
+        # head-to-head put comparison: on this one-host virtual mesh
+        # only minima are noise-robust, and the curve SHAPE is the
+        # deliverable).
+        fanin_s = float("inf")
+        for _ in range(3):
+            c2 = ShardedDenseCrdt(
+                "local", keys_d, mesh_d,
+                wall_clock=FakeClock(start=BASE + 2000))
+            t0 = time.perf_counter()
+            c2.merge_many(batches)
+            jax.block_until_ready(c2.store.lt)
+            fanin_s = min(fanin_s, time.perf_counter() - t0)
+
+        c2.put_batch(slots, vals)                  # compile
+        jax.block_until_ready(c2.store.lt)
+        put_s = float("inf")
+        for _ in range(reps):
+            t0 = time.perf_counter()
+            c2.put_batch(slots, vals)
+            jax.block_until_ready(c2.store.lt)
+            put_s = min(put_s, time.perf_counter() - t0)
+        curve.append({
+            "devices": n_dev, "mesh": f"(replica={r_sh}, key={k_sh})",
+            "n_keys": keys_d, "replica_rows": rows,
+            "fanin_warm_s": round(fanin_s, 4),
+            "fanin_merges_per_sec": round(m_count / fanin_s, 1),
+            "fanin_merges_per_sec_per_device":
+                round(m_count / fanin_s / n_dev, 1),
+            "put_batch_1024_slots_ms": round(put_s * 1e3, 3),
+        })
+    result["weak_scaling_note"] = (
+        "fixed per-device keys; virtual CPU devices — curve SHAPE and "
+        "write ratios are meaningful, absolute times are not")
+    result["weak_scaling"] = curve
+    result["sharded_put_vs_single_ratio"] = round(
+        put_sharded / put_single, 2)
+
+    # --- structural check: the sharded write must compile with ZERO
+    # collectives (each shard scatters its own rows; no re-shard, no
+    # gather). Robust where virtual-CPU timings wobble. ---
+    import re
+    from collections import Counter
+
+    from crdt_tpu.ops.dense import _put_scatter
+    from crdt_tpu.parallel import store_sharding
+    fn = _put_scatter(False, store_sharding(mesh))
+    hlo = fn.lower(
+        sharded2.store, jnp.asarray(slots, jnp.int32),
+        jnp.asarray(vals), jnp.zeros(len(slots), bool),
+        jnp.int64(1), jnp.int32(0)).compile().as_text()
+    colls = Counter(re.findall(
+        r"(all-gather|all-reduce|collective-permute|all-to-all)", hlo))
+    result["sharded_put_collectives"] = dict(colls)
+    result["sharded_put_collective_free"] = not colls
     result["ok"] = True
 
     with open(args.out, "w") as f:

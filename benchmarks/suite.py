@@ -27,7 +27,8 @@ import time
 sys.path.insert(0, os.path.dirname(os.path.dirname(
     os.path.abspath(__file__))))  # repo root (bench.py helpers)
 
-from bench import _MILLIS, bench, bench_distinct, result_dict
+from bench import (_MILLIS, bench, bench_distinct, bench_e2e_1024,
+                   result_dict)
 from crdt_tpu import Hlc, MapCrdt, Record, TpuMapCrdt
 from crdt_tpu.testing import FakeClock
 
@@ -227,6 +228,13 @@ def main():
     # BASELINE.md:26 north-star workload; every counted merge pays its
     # full HBM read — see bench.bench_distinct).
     emit(lambda: bench_distinct(1 << 20, 128, loops=48))
+    # THE north-star workload end to end: 1M × 1024 DISTINCT replica
+    # rows as 8 freshly device-generated batches (generation cost
+    # included, disclosed in the protocol fields) — once through the
+    # model API (pipelined window), once through the raw kernel; the
+    # pair isolates model-API overhead at the headline scale.
+    emit(lambda: bench_e2e_1024(1 << 20, through_model=True))
+    emit(lambda: bench_e2e_1024(1 << 20, through_model=False))
     emit(lambda: bench(1 << 20, 1024, 8, config="tombstone", repeats=64))
     emit(lambda: bench(1 << 20, 1024, 8, config="tiebreak", repeats=64))
     emit(bench_payload_wire)

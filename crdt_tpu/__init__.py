@@ -28,7 +28,8 @@ from .crdt_json import CrdtJson, dart_str
 from .watch import ChangeEvent, ChangeStream
 from .models.map_crdt import MapCrdt
 from .models.tpu_map_crdt import TpuMapCrdt
-from .models.dense_crdt import DenseCrdt, ShardedDenseCrdt, sync_dense
+from .models.dense_crdt import (DenseCrdt, PipelinedGuardError,
+                                ShardedDenseCrdt, sync_dense)
 from .models.keyed_dense import KeyedDenseCrdt
 from .models.sqlite_crdt import SqliteCrdt
 from .sync import sync, sync_json
@@ -43,7 +44,8 @@ __all__ = [
     "Record", "KeyDecoder", "KeyEncoder", "NodeIdDecoder", "ValueDecoder",
     "ValueEncoder", "Crdt", "CrdtJson", "dart_str", "ChangeEvent",
     "ChangeStream", "MapCrdt", "TpuMapCrdt", "DenseCrdt",
-    "ShardedDenseCrdt", "KeyedDenseCrdt", "sync_dense", "SqliteCrdt",
+    "ShardedDenseCrdt", "KeyedDenseCrdt", "PipelinedGuardError",
+    "sync_dense", "SqliteCrdt",
     "sync", "sync_json", "SyncServer", "sync_over_tcp",
     "load_dense", "load_json", "save_dense", "save_json",
 ]

@@ -26,6 +26,7 @@ The columnar store round-trips through `crdt_tpu.checkpoint.save_dense`.
 
 from __future__ import annotations
 
+from contextlib import contextmanager
 from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
@@ -48,6 +49,31 @@ from ..record import (KeyDecoder, KeyEncoder, Record, ValueDecoder,
                       ValueEncoder)
 from ..utils.stats import MergeStats, merge_annotation
 from ..watch import ChangeHub, ChangeStream
+
+
+class PipelinedGuardError(Exception):
+    """A clock guard tripped inside a ``DenseCrdt.pipelined()`` window.
+
+    Pipelined merges trade first-offender diagnostics for zero
+    per-merge host synchronization: guard flags accumulate on device
+    and are checked once at the window's end, so all this error can
+    say is WHICH guard class fired. Re-run the same batches
+    unpipelined for the exact sequential diagnosis (the store already
+    holds the merged state — merge is idempotent, a re-run is safe).
+    """
+
+
+class _PipeState:
+    """Device-resident clock state threaded across a pipelined window."""
+
+    __slots__ = ("canonical", "any_bad", "overflow", "drift", "merges")
+
+    def __init__(self, canonical_lt: int):
+        self.canonical = jnp.int64(canonical_lt)
+        self.any_bad = jnp.asarray(False)
+        self.overflow = jnp.asarray(False)
+        self.drift = jnp.asarray(False)
+        self.merges = 0
 
 
 class DenseCrdt:
@@ -90,6 +116,7 @@ class DenseCrdt:
             self._intern_ids([node_id])
         self.stats = MergeStats()
         self._hub = ChangeHub()
+        self._pipe: Optional[_PipeState] = None
         self.refresh_canonical_time()
 
     # --- clock (crdt.dart:8-33,114-121) ---
@@ -119,7 +146,90 @@ class DenseCrdt:
         self._canonical_time = Hlc.from_logical_time(
             int(dense_max_logical_time(self._store)), self._node_id)
 
+    def _canonical_lt(self) -> jax.Array:
+        """The canonical logicalTime as a device scalar — the live
+        pipeline clock inside a ``pipelined()`` window, the host
+        ``Hlc`` otherwise."""
+        if self._pipe is not None:
+            return self._pipe.canonical
+        return jnp.int64(self._canonical_time.logical_time)
+
+    @contextmanager
+    def pipelined(self):
+        """Zero-host-sync merge window: inside it, ``merge`` /
+        ``merge_many`` thread the canonical clock as a DEVICE scalar
+        (the final send bump runs on device, `ops.merge.send_step`)
+        and accumulate guard flags instead of fetching them — no
+        device→host round trip per merge, which on remote-proxied
+        chips is the dominant per-call cost. On exit, ONE readback
+        materializes the clock and raises `PipelinedGuardError` if any
+        recv/send guard fired during the window (coarse by design —
+        the docstring there explains the trade).
+
+        Semantic differences from unpipelined merges, stated plainly:
+
+        - **Merges land optimistically.** An unpipelined merge with a
+          real guard violation refuses the changeset (store
+          untouched); a pipelined window has already applied it by
+          the time the flush reports the flag. The lattice join is
+          monotone either way, but clock-policy-violating records are
+          IN the store when the error raises.
+        - **Flags may be spurious.** The Mosaic/sharded executors'
+          guard flags are documented supersets (a record the exact
+          sequential order shields can still flag); unpipelined
+          merges clear those by exact host recomputation, which needs
+          the changesets — gone by flush time. A
+          `PipelinedGuardError` therefore means "re-run unpipelined
+          to find out": a clean re-run (merge is idempotent — the
+          state is already merged) proves the flag spurious.
+        - Wall-read counts match unpipelined merges, but the reads
+          feed device ops; exception payloads are coarse.
+
+        Store lanes and the canonical clock are bit-identical to the
+        same merges issued unpipelined (differentially tested).
+        Local writes (`put_batch` etc.) are refused inside the
+        window — they need the host clock."""
+        if self._pipe is not None:
+            raise RuntimeError("pipelined() windows do not nest")
+        import sys as _sys
+        self._pipe = _PipeState(self._canonical_time.logical_time)
+        try:
+            yield self
+        finally:
+            pipe, self._pipe = self._pipe, None
+            lt, any_bad, overflow, drift = jax.device_get(
+                (pipe.canonical, pipe.any_bad, pipe.overflow,
+                 pipe.drift))
+            self._canonical_time = Hlc.from_logical_time(
+                int(lt), self._node_id)
+            if ((bool(any_bad) or bool(overflow) or bool(drift))
+                    and _sys.exc_info()[0] is None):
+                # Never shadow an in-flight exception from the window
+                # body — the guard report matters less than the error
+                # that actually interrupted the caller.
+                kinds = [k for k, f in (
+                    ("recv-guard (duplicate-node or drift)", any_bad),
+                    ("send counter overflow", overflow),
+                    ("send drift", drift)) if bool(f)]
+                raise PipelinedGuardError(
+                    f"guards tripped in pipelined window: "
+                    f"{', '.join(kinds)} across {pipe.merges} merges; "
+                    "possibly spurious (superset flags) — re-run the "
+                    "batches unpipelined for the exact diagnosis")
+
     # --- local ops: one send per batch (crdt.dart:39-54) ---
+
+    def _write_sharding(self):
+        """NamedSharding pinned onto write-scatter outputs, or None.
+        The sharded model returns its key-axis sharding so local
+        writes land laid out — no post-write re-shard copy."""
+        return None
+
+    def _refuse_in_pipeline(self, op: str) -> None:
+        if self._pipe is not None:
+            raise RuntimeError(
+                f"{op} needs the host clock; it cannot run inside a "
+                "pipelined() merge window — exit the window first")
 
     def _check_slots(self, slots: np.ndarray) -> None:
         # JAX scatter drops out-of-bounds indices silently; fail loudly
@@ -149,6 +259,7 @@ class DenseCrdt:
         same batch stamp — the mixed putAll shape (delete = put None,
         crdt.dart:58) that `delete_batch` alone can't express without
         spending a second stamp."""
+        self._refuse_in_pipeline("put_batch")
         slots = np.asarray(slots, np.int32)
         self._check_slots(slots)
         slots = jnp.asarray(slots)
@@ -164,7 +275,7 @@ class DenseCrdt:
         self._store = put_scatter(
             self._store, slots, values,
             t, me, tombs=None if tombs_h is None else jnp.asarray(tombs_h),
-            donate=self._donate_writes())
+            donate=self._donate_writes(), sharding=self._write_sharding())
         self._store_escaped = False
         self.stats.puts += 1
         self.stats.records_put += int(slots.shape[0])
@@ -172,6 +283,7 @@ class DenseCrdt:
 
     def delete_batch(self, slots) -> None:
         """Tombstone slots (delete = put None, crdt.dart:58)."""
+        self._refuse_in_pipeline("delete_batch")
         slots = np.asarray(slots, np.int32)
         self._check_slots(slots)
         slots = jnp.asarray(slots)
@@ -180,7 +292,8 @@ class DenseCrdt:
         t = jnp.int64(self._canonical_time.logical_time)
         me = jnp.int32(self._table.ordinal(self._node_id))
         self._store = delete_scatter(self._store, slots, t, me,
-                                     donate=self._donate_writes())
+                                     donate=self._donate_writes(),
+                                     sharding=self._write_sharding())
         self._store_escaped = False
         self.stats.puts += 1
         self.stats.records_put += int(slots.shape[0])
@@ -431,7 +544,8 @@ class DenseCrdt:
             self._store, jnp.asarray(slot_arr), jnp.asarray(lt),
             jnp.asarray(node), jnp.asarray(val), jnp.asarray(mod_lt),
             jnp.asarray(mod_node), jnp.asarray(tomb),
-            donate=self._donate_writes()))
+            donate=self._donate_writes(),
+            sharding=self._write_sharding()))
         self._store_escaped = False
         self.stats.puts += 1
         self.stats.records_put += k
@@ -558,6 +672,7 @@ class DenseCrdt:
         materialize 1M-wide lanes). Equivalence with the full-width
         changeset join is property-tested
         (tests/test_dense_crdt.py::TestSparseWireDelta)."""
+        self._refuse_in_pipeline("merge_records")  # host recv loop
         if not record_map:
             self.merge_many([])
             return
@@ -713,8 +828,12 @@ class DenseCrdt:
         Every id in ``node_ids`` must already be interned — encoding
         against a table that can still shift corrupts earlier-encoded
         changesets (the round-1 stale-ordinal bug)."""
-        peer_to_local = jnp.asarray(
-            [self._table.ordinal(n) for n in node_ids], jnp.int32)
+        remap = [self._table.ordinal(n) for n in node_ids]
+        if remap == list(range(len(self._table))):
+            # Peer table == local table (the steady gossip state):
+            # the gather would rewrite an identical [R, N] node lane.
+            return cs
+        peer_to_local = jnp.asarray(remap, jnp.int32)
         return cs._replace(node=peer_to_local[cs.node])
 
     # Above this many replica rows the fold is executed as a lax.scan
@@ -732,7 +851,7 @@ class DenseCrdt:
         table fits the kernel's int16 wire lane, and the backend is an
         accelerator."""
         from ..ops.pallas_merge import MAX_NODE_ORDINAL, TILE
-        if len(self._table.ids()) > MAX_NODE_ORDINAL:
+        if len(self._table) > MAX_NODE_ORDINAL:
             # The kernel's changeset node lane is int16 (ordinals are
             # distinct-replica counts); a table past 32k ordinals
             # routes to the XLA fold rather than wrapping silently.
@@ -740,7 +859,7 @@ class DenseCrdt:
                 raise ValueError(
                     f"executor={self._executor!r} supports at most "
                     f"{MAX_NODE_ORDINAL} node ordinals; table holds "
-                    f"{len(self._table.ids())}")
+                    f"{len(self._table)}")
             return False
         if self._executor == "xla":
             return False
@@ -754,7 +873,7 @@ class DenseCrdt:
     def _dispatch_fanin(self, cs: DenseChangeset, wall: int):
         """Run the fan-in join; subclasses route to other executors.
         Returns ``(new_store, res)`` with a FaninResult-compatible res."""
-        canonical = jnp.int64(self._canonical_time.logical_time)
+        canonical = self._canonical_lt()
         local = jnp.int32(self._table.ordinal(self._node_id))
         if self._use_pallas():
             return self._dispatch_pallas(cs, canonical, local, wall)
@@ -861,6 +980,9 @@ class DenseCrdt:
             # cross-backend differentials under an injected clock
             # can't drift on empty anti-entropy rounds.
             self._wall_clock()
+            if self._pipe is not None:
+                self._pipe_send_bump(self._wall_clock())
+                return
             self._canonical_time = Hlc.send(self._canonical_time,
                                             millis=self._wall_clock())
             return
@@ -875,14 +997,32 @@ class DenseCrdt:
         self._intern_ids(union)
         parts = [self._encode_peer(self._fit_slots(cs), ids)
                  for cs, ids in changesets]
-        cs = DenseChangeset(*(jnp.concatenate([getattr(p, f) for p in parts])
-                              for f in DenseChangeset._fields))
+        # Single-peer merges (the common gossip round) skip the concat
+        # entirely — jnp.concatenate of one part still copies [R, N]
+        # lanes.
+        cs = parts[0] if len(parts) == 1 else DenseChangeset(
+            *(jnp.concatenate([getattr(p, f) for p in parts])
+              for f in DenseChangeset._fields))
         # Lazy device scalar: no device->host sync on the hot path.
         self.stats.add_seen_lazy(jnp.sum(cs.valid))
 
         wall = self._wall_clock()
         with merge_annotation("crdt_tpu.dense_merge"):
             new_store, res = self._dispatch_fanin(cs, wall)
+
+        if self._pipe is not None:
+            # Pipelined tail: nothing leaves the device. Guard flags
+            # OR-accumulate; the canonical threads through the device
+            # send bump; the adopted counter drains lazily.
+            pipe = self._pipe
+            pipe.any_bad = pipe.any_bad | res.any_bad
+            pipe.merges += 1
+            self._store = new_store
+            self.stats.add_adopted_lazy(res.win_count)
+            self._emit_merge_wins(new_store, res.win)
+            pipe.canonical = res.new_canonical
+            self._pipe_send_bump(self._wall_clock())
+            return
 
         # The small result scalars come back in ONE batched fetch: on
         # remote-proxied backends each separate readback is a full
@@ -905,6 +1045,17 @@ class DenseCrdt:
         self._canonical_time = Hlc.send(
             Hlc.from_logical_time(int(new_canonical), self._node_id),
             millis=self._wall_clock())
+
+    def _pipe_send_bump(self, wall: int) -> None:
+        """The final crdt.dart:93 send bump, on device, flags
+        accumulated (a device op can't raise; flush checks them)."""
+        from ..ops.merge import send_step
+        pipe = self._pipe
+        new_lt, overflow, drift = send_step(pipe.canonical,
+                                            jnp.int64(wall))
+        pipe.canonical = new_lt
+        pipe.overflow = pipe.overflow | overflow
+        pipe.drift = pipe.drift | drift
 
 
 class ShardedDenseCrdt(DenseCrdt):
@@ -946,7 +1097,7 @@ class ShardedDenseCrdt(DenseCrdt):
         cs = shard_changeset(cs, self._mesh)
         return self._sharded_step(
             self._store, cs,
-            jnp.int64(self._canonical_time.logical_time),
+            self._canonical_lt(),
             jnp.int32(self._table.ordinal(self._node_id)),
             jnp.int64(wall))
 
@@ -960,7 +1111,14 @@ class ShardedDenseCrdt(DenseCrdt):
         # matches).
         return self._shard(store)
 
+    def _write_sharding(self):
+        from ..parallel import store_sharding
+        return store_sharding(self._mesh)
+
     def put_batch(self, slots, values, tombs=None) -> None:
+        # The scatter's output is constrained to the store sharding
+        # inside the jit (_write_sharding); the _shard() call is then
+        # a no-copy identity device_put kept as a safety net.
         super().put_batch(slots, values, tombs=tombs)
         self._store = self._shard(self._store)
 

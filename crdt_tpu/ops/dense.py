@@ -354,10 +354,14 @@ def store_to_changeset(store: DenseStore,
 import functools as _functools
 
 
+# ``sharding``: optional NamedSharding pinned onto the OUTPUT store
+# inside the jit (with_sharding_constraint) — a sharded model's local
+# write then lands already laid out, instead of XLA choosing and the
+# model paying a full-store re-shard copy afterwards.
 @_functools.lru_cache(maxsize=None)
-def _put_scatter(donate: bool):
+def _put_scatter(donate: bool, sharding=None):
     def step(store: DenseStore, slots, values, tombs, t, me) -> DenseStore:
-        return DenseStore(
+        out = DenseStore(
             lt=store.lt.at[slots].set(t),
             node=store.node.at[slots].set(me),
             val=store.val.at[slots].set(values),
@@ -366,17 +370,20 @@ def _put_scatter(donate: bool):
             occupied=store.occupied.at[slots].set(True),
             tomb=store.tomb.at[slots].set(tombs),
         )
+        if sharding is not None:
+            out = jax.lax.with_sharding_constraint(out, sharding)
+        return out
     return jax.jit(step, donate_argnums=(0,) if donate else ())
 
 
 @_functools.lru_cache(maxsize=None)
-def _record_scatter(donate: bool):
+def _record_scatter(donate: bool, sharding=None):
     # mode="drop": callers pad the batch to a power of two with
     # slot == n_slots sentinels (stable jit shapes); those rows must
     # scatter nowhere.
     def step(store: DenseStore, slots, lt, node, val, mod_lt, mod_node,
              tomb) -> DenseStore:
-        return DenseStore(
+        out = DenseStore(
             lt=store.lt.at[slots].set(lt, mode="drop"),
             node=store.node.at[slots].set(node, mode="drop"),
             val=store.val.at[slots].set(val, mode="drop"),
@@ -385,13 +392,16 @@ def _record_scatter(donate: bool):
             occupied=store.occupied.at[slots].set(True, mode="drop"),
             tomb=store.tomb.at[slots].set(tomb, mode="drop"),
         )
+        if sharding is not None:
+            out = jax.lax.with_sharding_constraint(out, sharding)
+        return out
     return jax.jit(step, donate_argnums=(0,) if donate else ())
 
 
 @_functools.lru_cache(maxsize=None)
-def _delete_scatter(donate: bool):
+def _delete_scatter(donate: bool, sharding=None):
     def step(store: DenseStore, slots, t, me) -> DenseStore:
-        return DenseStore(
+        out = DenseStore(
             lt=store.lt.at[slots].set(t),
             node=store.node.at[slots].set(me),
             val=store.val,
@@ -400,29 +410,35 @@ def _delete_scatter(donate: bool):
             occupied=store.occupied.at[slots].set(True),
             tomb=store.tomb.at[slots].set(True),
         )
+        if sharding is not None:
+            out = jax.lax.with_sharding_constraint(out, sharding)
+        return out
     return jax.jit(step, donate_argnums=(0,) if donate else ())
 
 
 def put_scatter(store: DenseStore, slots, values, t, me, tombs=None,
-                donate: bool = False) -> DenseStore:
+                donate: bool = False, sharding=None) -> DenseStore:
     """Batch put: scatter one shared HLC + values at ``slots``.
     ``tombs`` marks entries written as tombstones under the SAME batch
     stamp (a mixed putAll, crdt.dart:46-54 + delete-as-put-None)."""
     if tombs is None:
         tombs = jnp.zeros(values.shape, bool)
-    return _put_scatter(donate)(store, slots, values, tombs, t, me)
+    return _put_scatter(donate, sharding)(store, slots, values, tombs,
+                                          t, me)
 
 
 def record_scatter(store: DenseStore, slots, lt, node, val, mod_lt,
-                   mod_node, tomb, donate: bool = False) -> DenseStore:
+                   mod_node, tomb, donate: bool = False,
+                   sharding=None) -> DenseStore:
     """Raw record writes preserving the given hlc/modified stamps —
     the putRecords storage primitive (crdt.dart:151-155): stores
     records verbatim, no LWW compare, no clock involvement."""
-    return _record_scatter(donate)(store, slots, lt, node, val,
-                                   mod_lt, mod_node, tomb)
+    return _record_scatter(donate, sharding)(store, slots, lt, node,
+                                             val, mod_lt, mod_node,
+                                             tomb)
 
 
 def delete_scatter(store: DenseStore, slots, t, me,
-                   donate: bool = False) -> DenseStore:
+                   donate: bool = False, sharding=None) -> DenseStore:
     """Batch tombstone: scatter one shared HLC at ``slots``."""
-    return _delete_scatter(donate)(store, slots, t, me)
+    return _delete_scatter(donate, sharding)(store, slots, t, me)

@@ -192,3 +192,21 @@ def delta_mask(store: Store, since_lt: jax.Array) -> jax.Array:
     """modifiedSince filter: INCLUSIVE bound on the modified lane
     (map_crdt.dart:44-45)."""
     return store.occupied & (store.mod_lt >= since_lt)
+
+
+@jax.jit
+def send_step(lt: jax.Array, wall_millis: jax.Array):
+    """``Hlc.send`` on a packed int64 logicalTime, on device
+    (hlc.dart:51-74 on the lane encoding): millis = max(stored, wall),
+    counter increments iff millis unchanged else resets — which on the
+    packed form is ``lt + 1`` vs ``wall << 16``. Returns
+    ``(new_lt, overflow, drift)`` guard FLAGS instead of raising (a
+    device op can't throw; the pipelined model layer accumulates the
+    flags and raises host-side at the synchronization point)."""
+    from ..hlc import MAX_COUNTER, MAX_DRIFT, SHIFT
+    ms = lt >> SHIFT
+    stay = ms >= wall_millis
+    overflow = stay & ((lt & MAX_COUNTER) == MAX_COUNTER)
+    new_lt = jnp.where(stay, lt + 1, wall_millis << SHIFT)
+    drift = ms - wall_millis > MAX_DRIFT
+    return new_lt, overflow, drift

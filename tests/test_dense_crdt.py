@@ -1058,3 +1058,95 @@ class TestWriteDonationSafety:
         assert c._store_escaped is False
         c.export_delta()
         assert c._store_escaped is True
+
+
+class TestPipelined:
+    """`DenseCrdt.pipelined()` — zero-host-sync merge windows."""
+
+    def _batches(self, n=64, count=4, base=BASE):
+        out = []
+        for p in range(count):
+            peer = DenseCrdt(f"p{p}", n,
+                             wall_clock=FakeClock(start=base + p * 7))
+            peer.put_batch(list(range(0, n, p + 2)),
+                           [p * 100 + i for i in range(0, n, p + 2)])
+            peer.delete_batch([1, 3])
+            out.append(peer.export_delta())
+        return out
+
+    def test_bit_identical_to_unpipelined(self):
+        batches = self._batches()
+        a = DenseCrdt("na", 64, wall_clock=FakeClock(start=BASE + 500))
+        b = DenseCrdt("na", 64, wall_clock=FakeClock(start=BASE + 500))
+        for cs, ids in batches:
+            a.merge(cs, ids)
+        with b.pipelined():
+            for cs, ids in batches:
+                b.merge(cs, ids)
+        from crdt_tpu.testing import assert_dense_stores_equal
+        assert_dense_stores_equal(a.store, b.store)
+        assert a.canonical_time == b.canonical_time
+        assert a.record_map() == b.record_map()
+
+    def test_guard_trip_raises_at_flush(self):
+        from crdt_tpu import PipelinedGuardError
+        a = DenseCrdt("na", 64, wall_clock=FakeClock(start=BASE))
+        peer = DenseCrdt("na", 64,           # duplicate node id!
+                         wall_clock=FakeClock(start=BASE + 999))
+        peer.put_batch([0], [1])
+        cs, ids = peer.export_delta()
+        with pytest.raises(PipelinedGuardError, match="recv-guard"):
+            with a.pipelined():
+                a.merge(cs, ids)     # no raise here (deferred)...
+        # ...and the clock still materialized at flush
+        assert a.canonical_time.millis >= BASE
+
+    def test_local_writes_refused_inside_window(self):
+        a = DenseCrdt("na", 64, wall_clock=FakeClock(start=BASE))
+        with pytest.raises(RuntimeError, match="pipelined"):
+            with a.pipelined():
+                a.put_batch([0], [1])
+
+    def test_windows_do_not_nest(self):
+        a = DenseCrdt("na", 64, wall_clock=FakeClock(start=BASE))
+        with pytest.raises(RuntimeError, match="nest"):
+            with a.pipelined():
+                with a.pipelined():
+                    pass
+
+    def test_empty_merge_in_window_bumps_clock(self):
+        a = DenseCrdt("na", 64, wall_clock=FakeClock(start=BASE))
+        before = a.canonical_time
+        with a.pipelined():
+            a.merge_many([])
+        assert a.canonical_time > before
+
+    def test_sharded_pipelined_matches(self):
+        from crdt_tpu import ShardedDenseCrdt
+        from crdt_tpu.parallel import make_fanin_mesh
+        from crdt_tpu.testing import assert_dense_stores_equal
+        batches = self._batches()
+        mesh = make_fanin_mesh(2, 4)
+        a = DenseCrdt("na", 64, wall_clock=FakeClock(start=BASE + 500))
+        b = ShardedDenseCrdt("na", 64, mesh,
+                             wall_clock=FakeClock(start=BASE + 500))
+        for cs, ids in batches:
+            a.merge(cs, ids)
+        with b.pipelined():
+            for cs, ids in batches:
+                b.merge(cs, ids)
+        assert_dense_stores_equal(a.store, b.store)
+        assert a.canonical_time == b.canonical_time
+
+    def test_flush_never_shadows_inflight_exception(self):
+        # A guard flag set earlier in the window must not replace the
+        # exception that actually interrupted the body.
+        a = DenseCrdt("na", 64, wall_clock=FakeClock(start=BASE))
+        peer = DenseCrdt("na", 64,           # duplicate node id
+                         wall_clock=FakeClock(start=BASE + 999))
+        peer.put_batch([0], [1])
+        cs, ids = peer.export_delta()
+        with pytest.raises(KeyError, match="boom"):
+            with a.pipelined():
+                a.merge(cs, ids)             # sets the guard flag
+                raise KeyError("boom")       # the REAL error
