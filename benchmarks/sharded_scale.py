@@ -182,6 +182,11 @@ def main() -> None:
     for n_dev, (r_sh, k_sh) in [(1, (1, 1)), (2, (2, 1)),
                                 (4, (2, 2)), (8, (2, 4))]:
         keys_d = per_dev_keys * k_sh
+        # slot array scaled to THIS width's capacity (a --keys below
+        # 64k must not index past the 1-device store)
+        stride = max(keys_d // k, 1)
+        slots_d = np.arange(0, k * stride, stride)[:keys_d]
+        vals_d = np.arange(len(slots_d), dtype=np.int64)
         mesh_d = make_fanin_mesh(r_sh, k_sh,
                                  devices=jax.devices()[:n_dev])
         batches = random_changesets(rows, keys_d, seed=11, n_groups=4)
@@ -204,12 +209,12 @@ def main() -> None:
             jax.block_until_ready(c2.store.lt)
             fanin_s = min(fanin_s, time.perf_counter() - t0)
 
-        c2.put_batch(slots, vals)                  # compile
+        c2.put_batch(slots_d, vals_d)              # compile
         jax.block_until_ready(c2.store.lt)
         put_s = float("inf")
         for _ in range(reps):
             t0 = time.perf_counter()
-            c2.put_batch(slots, vals)
+            c2.put_batch(slots_d, vals_d)
             jax.block_until_ready(c2.store.lt)
             put_s = min(put_s, time.perf_counter() - t0)
         curve.append({

@@ -36,7 +36,7 @@ from .sync import sync, sync_json
 from .net import SyncServer, sync_over_tcp
 from .checkpoint import load_dense, load_json, save_dense, save_json
 
-__version__ = "0.4.7"
+__version__ = "0.5.0"
 
 __all__ = [
     "Hlc", "ClockDriftException", "DuplicateNodeException",

@@ -184,6 +184,11 @@ class DenseCrdt:
           state is already merged) proves the flag spurious.
         - Wall-read counts match unpipelined merges, but the reads
           feed device ops; exception payloads are coarse.
+        - **An active watch subscriber re-introduces a per-merge
+          readback.** Change events are host-side by design (the win
+          mask and winner lanes must be fetched to emit), so a window
+          with live subscribers runs at unpipelined latency — the
+          events themselves stay correct.
 
         Store lanes and the canonical clock are bit-identical to the
         same merges issued unpipelined (differentially tested).

@@ -1150,3 +1150,41 @@ class TestPipelined:
             with a.pipelined():
                 a.merge(cs, ids)             # sets the guard flag
                 raise KeyError("boom")       # the REAL error
+
+    def test_send_overflow_flag_raises_at_flush(self):
+        from crdt_tpu import PipelinedGuardError
+        # Drive the device send bump into counter overflow: a frozen
+        # wall clock at the canonical's millis makes every bump an
+        # increment; start the counter at MAX via a merged record.
+        from crdt_tpu.hlc import MAX_COUNTER
+        frozen = lambda: BASE
+        a = DenseCrdt("na", 64, wall_clock=frozen)
+        peer = DenseCrdt("np", 64, wall_clock=FakeClock(start=BASE - 10))
+        peer.put_batch([0], [1])
+        cs, ids = peer.export_delta()
+        # craft a changeset whose max lt sits at (BASE, MAX_COUNTER):
+        # after absorption the device send bump must overflow.
+        import jax.numpy as jnp
+        cs = cs._replace(lt=jnp.where(cs.valid,
+                                      (BASE << 16) | MAX_COUNTER,
+                                      cs.lt))
+        with pytest.raises(PipelinedGuardError, match="overflow"):
+            with a.pipelined():
+                a.merge(cs, ids)
+
+    def test_send_drift_flag_raises_at_flush(self):
+        from crdt_tpu import PipelinedGuardError
+        from crdt_tpu.hlc import MAX_DRIFT
+        # Wall clock far BEHIND the canonical: seed the high canonical
+        # through the raw putRecords primitive (no clock involvement,
+        # so no recv guard fires on the way in), then the device send
+        # bump sees millis - wall > MAX_DRIFT.
+        from crdt_tpu import Hlc, Record
+        a = DenseCrdt("na", 64,
+                      wall_clock=FakeClock(start=BASE - MAX_DRIFT - 10_000))
+        h = Hlc(BASE, 0, "np")
+        a.put_slot_records({0: Record(h, 1, h)})
+        a.refresh_canonical_time()
+        with pytest.raises(PipelinedGuardError, match="send drift"):
+            with a.pipelined():
+                a.merge_many([])   # empty merge still bumps the clock
