@@ -192,7 +192,8 @@ def bench(n_keys: int, n_replicas: int, chunk_replicas: int,
 
 
 def bench_distinct(n_keys: int, n_rows: int, loops: int = 48,
-                   interpret: bool = False) -> dict:
+                   interpret: bool = False,
+                   value_width: int = 64) -> dict:
     """GENUINELY DISTINCT replica rows: one [n_rows, n_keys] changeset
     resident in HBM — every record independent random data — merged by
     `pallas_fanin_batch` walking n_rows/8 distinct row groups per pass
@@ -211,8 +212,15 @@ def bench_distinct(n_keys: int, n_rows: int, loops: int = 48,
     merges = int(jnp.sum(cs.valid))
     # The HBM-resident wire format IS the split form: convert once
     # outside the timed loop (paying the int64 emulation per pass would
-    # measure the conversion, not the join).
-    scs = split_changeset(cs)
+    # measure the conversion, not the join). value_width=32 takes the
+    # value-ref lanes (int32 payloads/table indices, 15 B/merge).
+    if value_width == 32:
+        from crdt_tpu.ops.pallas_merge import split_changeset_narrow
+        scs, overflow = split_changeset_narrow(
+            cs._replace(val=cs.val & 0x7FFFFFFF))
+        assert not bool(overflow)
+    else:
+        scs = split_changeset(cs)
     jax.block_until_ready(scs)
     del cs
 
@@ -222,6 +230,8 @@ def bench_distinct(n_keys: int, n_rows: int, loops: int = 48,
             split_store(store), scs, canonical,
             local_node, wall, chunk_rows=16, interpret=interpret)
         return st2, res.new_canonical
+
+    suffix = "" if value_width == 64 else "_valref32"
 
     args = (store, scs, jnp.int64(_MILLIS << SHIFT), jnp.int32(0),
             jnp.int64(_MILLIS + 10_000))
@@ -237,7 +247,7 @@ def bench_distinct(n_keys: int, n_rows: int, loops: int = 48,
 
     out = result_dict(
         f"record_merges_per_sec_{n_keys // 1000}k_keys_"
-        f"x{n_rows}_distinct_replicas", merges * loops, elapsed,
+        f"x{n_rows}_distinct_replicas{suffix}", merges * loops, elapsed,
         path="pallas-batch", platform=platform)
     out["loops"] = loops  # every loop re-reads all rows from HBM
     return out

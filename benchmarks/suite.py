@@ -228,6 +228,10 @@ def main():
     # BASELINE.md:26 north-star workload; every counted merge pays its
     # full HBM read — see bench.bench_distinct).
     emit(lambda: bench_distinct(1 << 20, 128, loops=48))
+    # value-ref mode: int32 payloads/table indices (15 B vs 19 B per
+    # merge) — the recommended shape for variable-length values.
+    emit(lambda: bench_distinct(1 << 20, 128, loops=48,
+                                value_width=32))
     # THE north-star workload end to end: 1M × 1024 DISTINCT replica
     # rows as 8 freshly device-generated batches (generation cost
     # included, disclosed in the protocol fields) — once through the

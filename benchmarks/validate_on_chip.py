@@ -96,7 +96,23 @@ def validate_batch(n_keys, seed):
     assert int(sres.new_canonical) == int(ref_res.new_canonical)
     np.testing.assert_array_equal(np.asarray(sres.win),
                                   np.asarray(ref_res.win))
-    print(f"PASS batch seed={seed} (16 rows, chunked 8, == fanin_step)")
+    # value-ref (int32 val lane) mode, incl. negative payloads: the
+    # sign-extension must land bit-identical on hardware too.
+    from crdt_tpu.ops.pallas_merge import split_changeset_narrow
+    ncs_src = cs._replace(
+        val=((cs.val & 0xFFFFFFFF).astype(jnp.int32)).astype(jnp.int64))
+    nref_store, nref_res = fanin_step(store, ncs_src, canonical,
+                                      jnp.int32(0), wall)
+    ncs, overflow = split_changeset_narrow(ncs_src)
+    assert not bool(overflow)
+    nst, nres = pallas_fanin_batch(
+        split_store(store), ncs, canonical, jnp.int32(0), wall,
+        chunk_rows=8)
+    assert_lanes_equal(nref_store, join_store(nst),
+                       f"narrow batch seed={seed}")
+    assert int(nres.new_canonical) == int(nref_res.new_canonical)
+    print(f"PASS batch seed={seed} (16 rows, chunked 8, == fanin_step; "
+          "narrow valref32 incl. negatives)")
 
 
 def validate_model(n_keys):

@@ -66,13 +66,15 @@ class PipelinedGuardError(Exception):
 class _PipeState:
     """Device-resident clock state threaded across a pipelined window."""
 
-    __slots__ = ("canonical", "any_bad", "overflow", "drift", "merges")
+    __slots__ = ("canonical", "any_bad", "overflow", "drift",
+                 "val_overflow", "merges")
 
     def __init__(self, canonical_lt: int):
         self.canonical = jnp.int64(canonical_lt)
         self.any_bad = jnp.asarray(False)
         self.overflow = jnp.asarray(False)
         self.drift = jnp.asarray(False)
+        self.val_overflow = jnp.asarray(False)
         self.merges = 0
 
 
@@ -83,9 +85,21 @@ class DenseCrdt:
                  wall_clock: Optional[Callable[[], int]] = None,
                  store: Optional[DenseStore] = None,
                  node_ids: Optional[Sequence[Any]] = None,
-                 executor: str = "auto"):
+                 executor: str = "auto", value_width: int = 64):
         if executor not in ("auto", "xla", "pallas", "pallas-interpret"):
             raise ValueError(f"unknown executor {executor!r}")
+        if value_width not in (64, 32):
+            raise ValueError(f"value_width must be 64 or 32, got "
+                             f"{value_width}")
+        # value_width=32 — the value-ref mode: values are int32-range
+        # scalars or indices into an application-side payload table
+        # (SURVEY.md §7 hard part 4). The Mosaic executor then carries
+        # ONE int32 val lane (15 B/merge instead of 19; ~1.27× the
+        # distinct-row throughput) and sign-extends into the int64
+        # storage lane in-kernel. Out-of-range values are rejected:
+        # host-side writes immediately, device changesets via a lazily
+        # checked overflow flag (no extra sync).
+        self._value_width = value_width
         if executor in ("pallas", "pallas-interpret"):
             # Validate eagerly (mirroring grow()): deferring to the
             # first merge's kernel-level check would mis-run silently
@@ -117,6 +131,7 @@ class DenseCrdt:
         self.stats = MergeStats()
         self._hub = ChangeHub()
         self._pipe: Optional[_PipeState] = None
+        self._pending_val_overflow = None
         self.refresh_canonical_time()
 
     # --- clock (crdt.dart:8-33,114-121) ---
@@ -202,12 +217,13 @@ class DenseCrdt:
             yield self
         finally:
             pipe, self._pipe = self._pipe, None
-            lt, any_bad, overflow, drift = jax.device_get(
+            lt, any_bad, overflow, drift, val_ovf = jax.device_get(
                 (pipe.canonical, pipe.any_bad, pipe.overflow,
-                 pipe.drift))
+                 pipe.drift, pipe.val_overflow))
             self._canonical_time = Hlc.from_logical_time(
                 int(lt), self._node_id)
-            if ((bool(any_bad) or bool(overflow) or bool(drift))
+            if ((bool(any_bad) or bool(overflow) or bool(drift)
+                    or bool(val_ovf))
                     and _sys.exc_info()[0] is None):
                 # Never shadow an in-flight exception from the window
                 # body — the guard report matters less than the error
@@ -215,7 +231,11 @@ class DenseCrdt:
                 kinds = [k for k, f in (
                     ("recv-guard (duplicate-node or drift)", any_bad),
                     ("send counter overflow", overflow),
-                    ("send drift", drift)) if bool(f)]
+                    ("send drift", drift),
+                    ("value-ref overflow (records with values past "
+                     "int32 were SKIPPED, not merged; re-sync from "
+                     "the peer with a value_width=64 replica)",
+                     val_ovf)) if bool(f)]
                 raise PipelinedGuardError(
                     f"guards tripped in pipelined window: "
                     f"{', '.join(kinds)} across {pipe.merges} merges; "
@@ -267,6 +287,7 @@ class DenseCrdt:
         self._refuse_in_pipeline("put_batch")
         slots = np.asarray(slots, np.int32)
         self._check_slots(slots)
+        self._check_value_width(values)
         slots = jnp.asarray(slots)
         values = jnp.asarray(values, jnp.int64)
         tombs_h = None if tombs is None else np.asarray(tombs, bool)
@@ -497,6 +518,15 @@ class DenseCrdt:
     # a dense replica can sync with MapCrdt/TpuMapCrdt or external
     # JSON peers, not just other dense stores. ---
 
+    def _check_value_width(self, values) -> None:
+        if self._value_width == 32:
+            v = np.asarray(values, np.int64)
+            if v.size and (v.min() < -(2 ** 31) or v.max() >= 2 ** 31):
+                raise ValueError(
+                    "value_width=32 replica got a value outside int32 "
+                    "range; use value_width=64 or store a payload-"
+                    "table index instead")
+
     def _check_int_values(self, record_map: Dict[int, Record]) -> None:
         """The payload lane is int64; any other type would be silently
         truncated and (sharing the peer's hlc) diverge forever — fail
@@ -523,6 +553,8 @@ class DenseCrdt:
         self._check_slots(slots)
         recs = list(record_map.values())
         self._check_int_values(record_map)
+        self._check_value_width(
+            [0 if r.value is None else int(r.value) for r in recs])
         self._intern_ids({r.hlc.node_id for r in recs}
                          | {r.modified.node_id for r in recs})
         ords = {nid: i for i, nid in enumerate(self._table.ids())}
@@ -696,6 +728,8 @@ class DenseCrdt:
         slots = np.fromiter(record_map.keys(), np.int64, count=k)
         self._check_slots(slots)
         recs = list(record_map.values())
+        self._check_value_width(
+            [0 if r.value is None else int(r.value) for r in recs])
         self._intern_ids({r.hlc.node_id for r in recs})
         ords = {nid: i for i, nid in enumerate(self._table.ids())}
         # Pad k to a power of two so the jitted step compiles O(log k)
@@ -902,10 +936,18 @@ class DenseCrdt:
         chunks; optimistic guard flags — `_exact_guards` recomputes on
         a trip because the result carries no first-offender fields)."""
         from ..ops.pallas_merge import (join_store, pallas_fanin_batch,
-                                        split_changeset, split_store)
+                                        split_changeset,
+                                        split_changeset_narrow,
+                                        split_store)
         cs = pad_replica_rows(cs, self.STREAM_CHUNK_ROWS)
+        if self._value_width == 32:
+            # overflow rows were masked invalid (and the flag set) in
+            # merge_many; discard the split's own flag
+            scs, _ = split_changeset_narrow(cs)
+        else:
+            scs = split_changeset(cs)
         sst, pres = pallas_fanin_batch(
-            split_store(self._store), split_changeset(cs), canonical,
+            split_store(self._store), scs, canonical,
             local, jnp.int64(wall),
             chunk_rows=self.STREAM_CHUNK_ROWS,
             interpret=self._executor == "pallas-interpret")
@@ -1008,6 +1050,17 @@ class DenseCrdt:
         cs = parts[0] if len(parts) == 1 else DenseChangeset(
             *(jnp.concatenate([getattr(p, f) for p in parts])
               for f in DenseChangeset._fields))
+        if self._value_width == 32:
+            # Uniform value-ref enforcement for EVERY executor: records
+            # whose values don't round-trip through int32 are masked
+            # INVALID before dispatch — they never merge, so neither a
+            # truncated (Mosaic) nor an unnarrowed (XLA) payload can
+            # ever land under the peer's winning HLC — and the flag
+            # reports at the next batched fetch / pipeline flush.
+            fits = cs.val.astype(jnp.int32).astype(jnp.int64) == cs.val
+            self._pending_val_overflow = jnp.any(cs.valid & ~fits)
+            cs = cs._replace(valid=cs.valid & fits)
+
         # Lazy device scalar: no device->host sync on the hot path.
         self.stats.add_seen_lazy(jnp.sum(cs.valid))
 
@@ -1015,12 +1068,17 @@ class DenseCrdt:
         with merge_annotation("crdt_tpu.dense_merge"):
             new_store, res = self._dispatch_fanin(cs, wall)
 
+        voverflow, self._pending_val_overflow = \
+            self._pending_val_overflow, None
+
         if self._pipe is not None:
             # Pipelined tail: nothing leaves the device. Guard flags
             # OR-accumulate; the canonical threads through the device
             # send bump; the adopted counter drains lazily.
             pipe = self._pipe
             pipe.any_bad = pipe.any_bad | res.any_bad
+            if voverflow is not None:
+                pipe.val_overflow = pipe.val_overflow | voverflow
             pipe.merges += 1
             self._store = new_store
             self.stats.add_adopted_lazy(res.win_count)
@@ -1033,8 +1091,23 @@ class DenseCrdt:
         # remote-proxied backends each separate readback is a full
         # round trip. The [N] win mask stays on device unless a watch
         # subscriber needs it.
-        any_bad, win_count, new_canonical = jax.device_get(
-            (res.any_bad, res.win_count, res.new_canonical))
+        if voverflow is None:
+            any_bad, win_count, new_canonical = jax.device_get(
+                (res.any_bad, res.win_count, res.new_canonical))
+            val_ovf = False
+        else:
+            any_bad, win_count, new_canonical, val_ovf = jax.device_get(
+                (res.any_bad, res.win_count, res.new_canonical,
+                 voverflow))
+        if bool(val_ovf):
+            # Raised BEFORE the store swap: the merge is rejected
+            # whole (replica untouched; the offending records were
+            # additionally masked out of the join), matching the
+            # host-side write validation.
+            raise ValueError(
+                "value_width=32 replica merged a changeset holding "
+                "values outside int32 range; use a value_width=64 "
+                "replica (or payload-table indices) for such data")
 
         if bool(any_bad):
             exact = self._exact_guards(cs, res, wall)

@@ -88,6 +88,44 @@ class SplitChangeset(NamedTuple):
     tomb: jax.Array    # int8[R, N]
 
 
+class NarrowSplitChangeset(NamedTuple):
+    """[R, N] changeset lanes for the value-ref mode: ``val`` is a
+    SINGLE int32 lane (sign-extended into the store's 64-bit payload
+    in-kernel), for workloads whose values are int32-range scalars or
+    indices into an application-side payload table (SURVEY.md §7 hard
+    part 4 — the recommended shape for variable-length values). 15 B
+    of HBM per merge instead of the wide form's 19 B."""
+    hi: jax.Array    # int32[R, N] (NEG_HI = invalid)
+    lo: jax.Array    # uint32[R, N]
+    node: jax.Array  # int16[R, N] (I16_NEG when invalid)
+    val: jax.Array   # int32[R, N]
+    tomb: jax.Array  # int8[R, N]
+
+
+@jax.jit
+def split_changeset_narrow(cs: DenseChangeset
+                           ) -> Tuple[NarrowSplitChangeset, jax.Array]:
+    """`split_changeset` for the value-ref mode. Returns the narrow
+    lanes AND a ``val_overflow`` device flag: True iff any valid value
+    does not round-trip through int32 — the caller must check it (at
+    its next batched fetch; merging out-of-range values in this mode
+    would silently truncate payloads)."""
+    v32 = cs.val.astype(jnp.int32)
+    fits = v32.astype(jnp.int64) == cs.val
+    overflow = jnp.any(cs.valid & ~fits)
+    # Overflowing rows are masked INVALID, not truncated: a silently
+    # narrowed payload under the peer's winning HLC could never be
+    # repaired by any later merge (LWW ties keep the local record).
+    ok = cs.valid & fits
+    lt = jnp.where(ok, cs.lt, _NEG)
+    hi, lo = _split64(lt)
+    return NarrowSplitChangeset(
+        hi=hi, lo=lo,
+        node=jnp.where(ok, cs.node, I16_NEG).astype(jnp.int16),
+        val=v32,
+        tomb=cs.tomb.astype(jnp.int8)), overflow
+
+
 def _split64(x: jax.Array) -> Tuple[jax.Array, jax.Array]:
     return ((x >> 32).astype(jnp.int32),
             (x & 0xFFFFFFFF).astype(jnp.uint32))
@@ -160,14 +198,8 @@ def _add_off64(hi, lo, off_u32):
     return hi + (lo2 < lo).astype(hi.dtype), lo2
 
 
-def _fanin_stream_kernel(exact_guards, advance_clock,
-                         scalars_ref,
-                         cs_hi, cs_lo, cs_node, cs_vhi, cs_vlo, cs_tomb,
-                         st_hi, st_lo, st_node, st_vhi, st_vlo, st_tomb,
-                         st_mhi, st_mlo, st_mnode,
-                         o_hi, o_lo, o_node, o_vhi, o_vlo, o_tomb,
-                         o_mhi, o_mlo, o_mnode,
-                         win_ref, dup_ref, drift_ref):
+def _fanin_stream_kernel(exact_guards, advance_clock, narrow_val,
+                         scalars_ref, *refs):
     """Multi-chunk fan-in: grid (row_blocks, n_chunks); the store block
     stays VMEM-resident across the chunk dimension (block index constant
     in c), so HBM sees each store/changeset lane once per row block
@@ -190,6 +222,21 @@ def _fanin_stream_kernel(exact_guards, advance_clock,
     canonical (`pallas_fanin_batch`)."""
     assert advance_clock or not exact_guards, (
         "exact guards are only defined for the clock-advancing stream")
+    if narrow_val:
+        # value-ref mode: ONE int32 val lane, sign-extended per row
+        (cs_hi, cs_lo, cs_node, cs_v32, cs_tomb,
+         st_hi, st_lo, st_node, st_vhi, st_vlo, st_tomb,
+         st_mhi, st_mlo, st_mnode,
+         o_hi, o_lo, o_node, o_vhi, o_vlo, o_tomb,
+         o_mhi, o_mlo, o_mnode,
+         win_ref, dup_ref, drift_ref) = refs
+    else:
+        (cs_hi, cs_lo, cs_node, cs_vhi, cs_vlo, cs_tomb,
+         st_hi, st_lo, st_node, st_vhi, st_vlo, st_tomb,
+         st_mhi, st_mlo, st_mnode,
+         o_hi, o_lo, o_node, o_vhi, o_vlo, o_tomb,
+         o_mhi, o_mlo, o_mnode,
+         win_ref, dup_ref, drift_ref) = refs
     rb = pl.program_id(0)
     c = pl.program_id(1)
     first = c == 0
@@ -267,8 +314,16 @@ def _fanin_stream_kernel(exact_guards, advance_clock,
         b_hi = jnp.where(gt, hi, b_hi)
         b_lo = jnp.where(gt, lo, b_lo)
         b_node = jnp.where(gt, node, b_node)
-        b_vhi = jnp.where(gt, cs_vhi[r], b_vhi)
-        b_vlo = jnp.where(gt, cs_vlo[r], b_vlo)
+        if narrow_val:
+            v = cs_v32[r]
+            # sign-extend into the store's 64-bit payload: hi word is
+            # the sign fill; lo word the int32 bits (signed->unsigned
+            # convert is modular in XLA, i.e. a bit-preserving wrap)
+            b_vhi = jnp.where(gt, v >> 31, b_vhi)
+            b_vlo = jnp.where(gt, v.astype(jnp.uint32), b_vlo)
+        else:
+            b_vhi = jnp.where(gt, cs_vhi[r], b_vhi)
+            b_vlo = jnp.where(gt, cs_vlo[r], b_vlo)
         b_tomb = jnp.where(gt, cs_tomb[r].astype(jnp.int32), b_tomb)
         win = win | gt
 
@@ -390,8 +445,16 @@ def pallas_fanin_stream(store: SplitStore, cs: SplitChangeset,
     # dim, so its lane width costs nothing in HBM — widen the narrow
     # wire lanes ONCE here and the in-kernel astype becomes identity
     # (the compute-bound replay loses no VPU cycles to widening).
-    cs = cs._replace(node=cs.node.astype(jnp.int32),
-                     tomb=cs.tomb.astype(jnp.int32))
+    if isinstance(cs, NarrowSplitChangeset):
+        v = cs.val
+        cs = SplitChangeset(hi=cs.hi, lo=cs.lo,
+                            node=cs.node.astype(jnp.int32),
+                            val_hi=v >> 31,
+                            val_lo=v.astype(jnp.uint32),
+                            tomb=cs.tomb.astype(jnp.int32))
+    else:
+        cs = cs._replace(node=cs.node.astype(jnp.int32),
+                         tomb=cs.tomb.astype(jnp.int32))
     outs = _launch_stream_grid(
         guards == "exact", True, store, cs, canonical_lt, local_node,
         wall_millis, m_hi, m_lo, cs_block_rows=r,
@@ -476,15 +539,17 @@ def _launch_stream_grid(exact_guards, advance_clock, store, cs,
          jax.ShapeDtypeStruct((1, 1), jnp.int32),         # any_dup
          jax.ShapeDtypeStruct((1, 1), jnp.int32)])        # any_drift
 
+    n_cs = len(cs3d)   # 6 wide lanes, 5 in value-ref (narrow) mode
     return pl.pallas_call(
-        partial(_fanin_stream_kernel, exact_guards, advance_clock),
+        partial(_fanin_stream_kernel, exact_guards, advance_clock,
+                n_cs == 5),
         grid=(rows // _SB, n_chunks),
         in_specs=([pl.BlockSpec((7,), lambda i, c: (_i32(0),),
                                 memory_space=pltpu.SMEM)] +
-                  [cs_spec] * 6 + [st_spec] * 9),
+                  [cs_spec] * n_cs + [st_spec] * 9),
         out_specs=tuple([st_spec] * 9 + [st_spec, flag_spec, flag_spec]),
         out_shape=tuple(out_shapes),
-        input_output_aliases={1 + 6 + j: j for j in range(9)},
+        input_output_aliases={1 + n_cs + j: j for j in range(9)},
         interpret=interpret,
     )(scalars, *cs3d, *st2d)
 

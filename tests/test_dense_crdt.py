@@ -1188,3 +1188,103 @@ class TestPipelined:
         with pytest.raises(PipelinedGuardError, match="send drift"):
             with a.pipelined():
                 a.merge_many([])   # empty merge still bumps the clock
+
+
+class TestValueWidth32:
+    """The value-ref mode (`value_width=32`): int32 payloads/table
+    indices in a single narrow kernel lane, identical semantics."""
+
+    def _peer_batches(self, n=8192, lo=-(2 ** 31), hi=2 ** 31):
+        import numpy as np
+        rng = np.random.default_rng(3)
+        peers = []
+        for i in range(3):
+            p = DenseCrdt(f"p{i}", n,
+                          wall_clock=FakeClock(start=BASE + i * 5))
+            slots = rng.choice(n, 500, replace=False)
+            p.put_batch(slots, rng.integers(lo, hi, 500))
+            peers.append(p.export_delta())
+        return peers
+
+    def test_matches_wide_replica(self):
+        from crdt_tpu.ops.pallas_merge import TILE
+        batches = self._peer_batches(n=TILE)
+        wide = DenseCrdt("na", TILE, wall_clock=FakeClock(start=BASE),
+                         executor="pallas-interpret")
+        narrow = DenseCrdt("na", TILE, wall_clock=FakeClock(start=BASE),
+                           executor="pallas-interpret", value_width=32)
+        for cs, ids in batches:
+            wide.merge(cs, ids)
+            narrow.merge(cs, ids)
+        from crdt_tpu.testing import assert_dense_stores_equal
+        assert_dense_stores_equal(wide.store, narrow.store)
+        assert wide.canonical_time == narrow.canonical_time
+
+    def test_host_write_rejects_wide_values(self):
+        c = DenseCrdt("na", 64, wall_clock=FakeClock(start=BASE),
+                      value_width=32)
+        with pytest.raises(ValueError, match="int32"):
+            c.put_batch([0], [2 ** 40])
+        c.put_batch([0], [-(2 ** 31)])      # boundary fits
+        assert c.get(0) == -(2 ** 31)
+
+    def test_merge_rejects_wide_values_whole(self):
+        from crdt_tpu.ops.pallas_merge import TILE
+        peer = DenseCrdt("np", TILE, wall_clock=FakeClock(start=BASE))
+        peer.put_batch([1, 2], [5, 2 ** 40])
+        cs, ids = peer.export_delta()
+        c = DenseCrdt("na", TILE, wall_clock=FakeClock(start=BASE + 9),
+                      executor="pallas-interpret", value_width=32)
+        before = c.canonical_time
+        with pytest.raises(ValueError, match="int32"):
+            c.merge(cs, ids)
+        assert len(c.record_map()) == 0     # store untouched
+        assert c.canonical_time == before
+
+    def test_pipelined_flags_value_overflow_at_flush(self):
+        from crdt_tpu import PipelinedGuardError
+        from crdt_tpu.ops.pallas_merge import TILE
+        peer = DenseCrdt("np", TILE, wall_clock=FakeClock(start=BASE))
+        peer.put_batch([1], [2 ** 40])
+        cs, ids = peer.export_delta()
+        c = DenseCrdt("na", TILE, wall_clock=FakeClock(start=BASE + 9),
+                      executor="pallas-interpret", value_width=32)
+        with pytest.raises(PipelinedGuardError, match="value-ref"):
+            with c.pipelined():
+                c.merge(cs, ids)
+
+    def test_xla_executor_enforces_width_too(self):
+        # The rejection contract must not depend on the executor.
+        peer = DenseCrdt("np", 64, wall_clock=FakeClock(start=BASE))
+        peer.put_batch([1], [2 ** 40])
+        cs, ids = peer.export_delta()
+        c = DenseCrdt("na", 64, wall_clock=FakeClock(start=BASE + 9),
+                      executor="xla", value_width=32)
+        with pytest.raises(ValueError, match="int32"):
+            c.merge(cs, ids)
+        assert len(c.record_map()) == 0
+
+    def test_put_slot_records_enforces_width(self):
+        from crdt_tpu import Hlc, Record
+        c = DenseCrdt("na", 64, wall_clock=FakeClock(start=BASE),
+                      value_width=32)
+        h = Hlc(BASE, 0, "x")
+        with pytest.raises(ValueError, match="int32"):
+            c.put_slot_records({0: Record(h, 2 ** 40, h)})
+
+    def test_pipelined_overflow_skips_record_never_truncates(self):
+        # The flagged record must NOT land truncated; in-range records
+        # in the same changeset still merge.
+        from crdt_tpu import PipelinedGuardError
+        from crdt_tpu.ops.pallas_merge import TILE
+        peer = DenseCrdt("np", TILE, wall_clock=FakeClock(start=BASE))
+        peer.put_batch([1, 2], [2 ** 40, 7])
+        cs, ids = peer.export_delta()
+        c = DenseCrdt("na", TILE, wall_clock=FakeClock(start=BASE + 9),
+                      executor="pallas-interpret", value_width=32)
+        with pytest.raises(PipelinedGuardError, match="SKIPPED"):
+            with c.pipelined():
+                c.merge(cs, ids)
+        assert c.get(2) == 7            # in-range record merged
+        assert c.get(1) is None         # overflow record skipped,
+        assert not c.contains_slot(1)   # never truncated into place

@@ -431,3 +431,83 @@ def test_split_roundtrip():
     store, _ = fanin_step(empty_dense_store(n), cs, jnp.int64(0),
                           jnp.int32(LOCAL), jnp.int64(MILLIS + 10_000))
     assert_stores_equal(store, join_store(split_store(store)))
+
+
+class TestNarrowVal:
+    """Value-ref (int32 val lane) kernel mode: bit-identical store
+    results to the wide kernel whenever values fit int32 — including
+    negative values (sign extension) — and a raised overflow flag
+    when they don't."""
+
+    def _cs(self, r, n, seed, lo=-(2 ** 31), hi=2 ** 31):
+        import numpy as np
+        rng = np.random.default_rng(seed)
+        lt = ((1_700_000_000_000 + rng.integers(0, 500, (r, n))) << 16) \
+            + rng.integers(0, 3, (r, n))
+        from crdt_tpu.ops.dense import DenseChangeset
+        return DenseChangeset(
+            lt=jnp.asarray(lt, jnp.int64),
+            node=jnp.asarray(rng.integers(1, 9, (r, n)), jnp.int32),
+            val=jnp.asarray(rng.integers(lo, hi, (r, n)), jnp.int64),
+            tomb=jnp.asarray(rng.random((r, n)) < 0.3),
+            valid=jnp.asarray(rng.random((r, n)) < 0.8),
+        )
+
+    def test_batch_matches_wide_kernel(self):
+        from crdt_tpu.ops.dense import empty_dense_store
+        from crdt_tpu.ops.pallas_merge import (
+            TILE, join_store, pallas_fanin_batch, split_changeset,
+            split_changeset_narrow, split_store)
+        from crdt_tpu.testing import assert_dense_stores_equal
+        n = TILE
+        cs = self._cs(16, n, seed=3)
+        store = split_store(empty_dense_store(n))
+        canonical = jnp.int64(0)
+        local = jnp.int32(0)
+        wall = jnp.int64(1_700_000_100_000)
+        wide_st, wide_res = pallas_fanin_batch(
+            store, split_changeset(cs), canonical, local, wall,
+            chunk_rows=8, interpret=True)
+        ncs, overflow = split_changeset_narrow(cs)
+        assert not bool(overflow)
+        nar_st, nar_res = pallas_fanin_batch(
+            store, ncs, canonical, local, wall,
+            chunk_rows=8, interpret=True)
+        assert_dense_stores_equal(join_store(wide_st),
+                                  join_store(nar_st), "wide vs narrow")
+        assert int(wide_res.new_canonical) == int(nar_res.new_canonical)
+        import numpy as np
+        np.testing.assert_array_equal(np.asarray(wide_res.win),
+                                      np.asarray(nar_res.win))
+
+    def test_negative_values_sign_extend(self):
+        from crdt_tpu.ops.dense import empty_dense_store
+        from crdt_tpu.ops.pallas_merge import (
+            TILE, join_store, pallas_fanin_batch,
+            split_changeset_narrow, split_store)
+        n = TILE
+        cs = self._cs(8, n, seed=5, lo=-1000, hi=0)
+        ncs, overflow = split_changeset_narrow(cs)
+        assert not bool(overflow)
+        st, _ = pallas_fanin_batch(
+            split_store(empty_dense_store(n)), ncs, jnp.int64(0),
+            jnp.int32(0), jnp.int64(1_700_000_100_000),
+            chunk_rows=8, interpret=True)
+        out = join_store(st)
+        import numpy as np
+        occ = np.asarray(out.occupied)
+        vals = np.asarray(out.val)[occ]
+        assert vals.size and (vals < 0).all()
+        assert vals.min() >= -1000
+
+    def test_overflow_flag(self):
+        from crdt_tpu.ops.pallas_merge import split_changeset_narrow
+        cs = self._cs(2, 256, seed=1)
+        cs = cs._replace(val=cs.val.at[0, 0].set(2 ** 40),
+                         valid=cs.valid.at[0, 0].set(True))
+        _, overflow = split_changeset_narrow(cs)
+        assert bool(overflow)
+        # invalid lanes never flag
+        cs2 = cs._replace(valid=cs.valid.at[0, 0].set(False))
+        _, overflow2 = split_changeset_narrow(cs2)
+        assert not bool(overflow2)
