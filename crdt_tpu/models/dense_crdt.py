@@ -931,33 +931,30 @@ class DenseCrdt:
 
     def _dispatch_pallas(self, cs: DenseChangeset, canonical, local,
                          wall: int):
-        """The Mosaic executor: split 32-bit lanes through
-        `pallas_fanin_batch` (store VMEM-resident across row-group
-        chunks; optimistic guard flags — `_exact_guards` recomputes on
-        a trip because the result carries no first-offender fields)."""
-        from ..ops.pallas_merge import (join_store, pallas_fanin_batch,
-                                        split_changeset,
-                                        split_changeset_narrow,
-                                        split_store)
+        """The Mosaic executor — ONE fused dispatch
+        (`model_fanin_batch`): lane split/narrowing, value-width
+        masking, seen count, the batch kernel, and the store re-join
+        all inside a single jit, because on remote-proxied backends
+        each separate dispatch is a host round trip (optimistic guard
+        flags — `_exact_guards` recomputes on a trip because the
+        result carries no first-offender fields)."""
+        from ..ops.pallas_merge import model_fanin_batch
         cs = pad_replica_rows(cs, self.STREAM_CHUNK_ROWS)
-        if self._value_width == 32:
-            # overflow rows were masked invalid (and the flag set) in
-            # merge_many; discard the split's own flag
-            scs, _ = split_changeset_narrow(cs)
-        else:
-            scs = split_changeset(cs)
-        sst, pres = pallas_fanin_batch(
-            split_store(self._store), scs, canonical,
-            local, jnp.int64(wall),
+        new_store, pres, seen, voverflow = model_fanin_batch(
+            self._store, cs, canonical, local, jnp.int64(wall),
             chunk_rows=self.STREAM_CHUNK_ROWS,
-            interpret=self._executor == "pallas-interpret")
+            interpret=self._executor == "pallas-interpret",
+            value_width=self._value_width)
+        self.stats.add_seen_lazy(seen)
+        if self._value_width == 32:
+            self._pending_val_overflow = voverflow
         res = FaninResult(
             new_canonical=pres.new_canonical,
             win_count=jnp.sum(pres.win).astype(jnp.int32),
             win=pres.win,
             any_bad=pres.any_dup | pres.any_drift,
             first_bad=None, first_is_dup=None, canonical_at_fail=None)
-        return join_store(sst), res
+        return new_store, res
 
     def _exact_guards(self, cs: DenseChangeset, res, wall: int):
         """Exact r-major sequential guard diagnostics (the visit order
@@ -1050,19 +1047,23 @@ class DenseCrdt:
         cs = parts[0] if len(parts) == 1 else DenseChangeset(
             *(jnp.concatenate([getattr(p, f) for p in parts])
               for f in DenseChangeset._fields))
-        if self._value_width == 32:
-            # Uniform value-ref enforcement for EVERY executor: records
-            # whose values don't round-trip through int32 are masked
-            # INVALID before dispatch — they never merge, so neither a
-            # truncated (Mosaic) nor an unnarrowed (XLA) payload can
-            # ever land under the peer's winning HLC — and the flag
-            # reports at the next batched fetch / pipeline flush.
-            fits = cs.val.astype(jnp.int32).astype(jnp.int64) == cs.val
-            self._pending_val_overflow = jnp.any(cs.valid & ~fits)
-            cs = cs._replace(valid=cs.valid & fits)
-
-        # Lazy device scalar: no device->host sync on the hot path.
-        self.stats.add_seen_lazy(jnp.sum(cs.valid))
+        if not self._use_pallas():
+            # The Mosaic route folds BOTH of these into its single
+            # fused dispatch (`model_fanin_batch`); the other
+            # executors run them as standalone device ops here.
+            if self._value_width == 32:
+                # Uniform value-ref enforcement: records whose values
+                # don't round-trip through int32 are masked INVALID
+                # before dispatch — they never merge, so no truncated
+                # or unnarrowed payload can land under the peer's
+                # winning HLC — and the flag reports at the next
+                # batched fetch / pipeline flush.
+                fits = (cs.val.astype(jnp.int32).astype(jnp.int64)
+                        == cs.val)
+                self._pending_val_overflow = jnp.any(cs.valid & ~fits)
+                cs = cs._replace(valid=cs.valid & fits)
+            # Lazy device scalar: no device->host sync on the hot path.
+            self.stats.add_seen_lazy(jnp.sum(cs.valid))
 
         wall = self._wall_clock()
         with merge_annotation("crdt_tpu.dense_merge"):
@@ -1178,6 +1179,13 @@ class ShardedDenseCrdt(DenseCrdt):
             self._canonical_lt(),
             jnp.int32(self._table.ordinal(self._node_id)),
             jnp.int64(wall))
+
+    def _use_pallas(self) -> bool:
+        # The sharded route is the shard_map collective fan-in; the
+        # Mosaic kernel never runs here (a per-shard kernel under
+        # shard_map is future work), so merge_many must keep its own
+        # seen-count / value-width device ops.
+        return False
 
     # _exact_guards: inherited — ShardedFaninResult carries no
     # first_bad field, so the base recompute path handles the sharded

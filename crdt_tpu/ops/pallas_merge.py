@@ -554,6 +554,37 @@ def _launch_stream_grid(exact_guards, advance_clock, store, cs,
     )(scalars, *cs3d, *st2d)
 
 
+@partial(jax.jit,
+         static_argnames=("chunk_rows", "interpret", "value_width"))
+def model_fanin_batch(store, cs, canonical_lt, local_node, wall_millis,
+                      *, chunk_rows: int = 16, interpret: bool = False,
+                      value_width: int = 64):
+    """The model layer's ONE-dispatch merge: wide `DenseStore` +
+    `DenseChangeset` in, wide store out — split/convert, the batch
+    kernel, and the re-join all inside a single jit. On remote-proxied
+    backends every separate dispatch costs a host round trip, and an
+    unfused model merge was paying four of them per call.
+
+    Returns ``(new_store, PallasFaninResult, seen, val_overflow)``
+    where ``seen`` counts valid lanes (the stats counter) and
+    ``val_overflow`` flags value_width=32 range violations (those
+    records are masked out of the join, never truncated)."""
+    if value_width == 32:
+        fits = cs.val.astype(jnp.int32).astype(jnp.int64) == cs.val
+        val_overflow = jnp.any(cs.valid & ~fits)
+        cs = cs._replace(valid=cs.valid & fits)
+        scs, _ = split_changeset_narrow.__wrapped__(cs)
+    else:
+        val_overflow = jnp.asarray(False)
+        scs = split_changeset.__wrapped__(cs)
+    seen = jnp.sum(cs.valid)
+    sst = split_store.__wrapped__(store)
+    out, res = pallas_fanin_batch.__wrapped__(
+        sst, scs, canonical_lt, local_node, wall_millis,
+        chunk_rows=chunk_rows, interpret=interpret)
+    return join_store.__wrapped__(out), res, seen, val_overflow
+
+
 @partial(jax.jit, static_argnames=("chunk_rows", "interpret"))
 def pallas_fanin_batch(store: SplitStore, cs: SplitChangeset,
                        canonical_lt: jax.Array, local_node: jax.Array,
