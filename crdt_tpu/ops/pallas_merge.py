@@ -43,7 +43,7 @@ from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
 from ..hlc import MAX_COUNTER, MAX_DRIFT, SHIFT
-from .dense import DenseChangeset, DenseStore, _NEG, _I32_NEG
+from .dense import DenseChangeset, DenseStore, _NEG
 
 # Sentinel hi word of _NEG = -(2**62): anything real compares greater.
 # Plain ints (not jnp scalars): module-level concrete arrays would
