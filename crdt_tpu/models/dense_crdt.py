@@ -67,7 +67,7 @@ class _PipeState:
     """Device-resident clock state threaded across a pipelined window."""
 
     __slots__ = ("canonical", "any_bad", "overflow", "drift",
-                 "val_overflow", "merges")
+                 "val_overflow", "first_flag_idx", "merges")
 
     def __init__(self, canonical_lt: int):
         self.canonical = jnp.int64(canonical_lt)
@@ -75,7 +75,19 @@ class _PipeState:
         self.overflow = jnp.asarray(False)
         self.drift = jnp.asarray(False)
         self.val_overflow = jnp.asarray(False)
+        # Index (0-based, in window order) of the first merge that set
+        # ANY flag — the flush names it so "re-run unpipelined" can
+        # start at the right batch instead of replaying the window.
+        self.first_flag_idx = jnp.int32(-1)
         self.merges = 0
+
+    def note(self, flags, idx: Optional[int] = None) -> None:
+        """Attribute freshly-raised flags to window slot ``idx``
+        (default: the current merge counter)."""
+        i = self.merges if idx is None else idx
+        newly = ((self.first_flag_idx < 0) & flags).astype(jnp.bool_)
+        self.first_flag_idx = jnp.where(newly, jnp.int32(i),
+                                        self.first_flag_idx)
 
 
 class DenseCrdt:
@@ -217,9 +229,11 @@ class DenseCrdt:
             yield self
         finally:
             pipe, self._pipe = self._pipe, None
-            lt, any_bad, overflow, drift, val_ovf = jax.device_get(
-                (pipe.canonical, pipe.any_bad, pipe.overflow,
-                 pipe.drift, pipe.val_overflow))
+            lt, any_bad, overflow, drift, val_ovf, first_idx = \
+                jax.device_get(
+                    (pipe.canonical, pipe.any_bad, pipe.overflow,
+                     pipe.drift, pipe.val_overflow,
+                     pipe.first_flag_idx))
             self._canonical_time = Hlc.from_logical_time(
                 int(lt), self._node_id)
             if ((bool(any_bad) or bool(overflow) or bool(drift)
@@ -238,9 +252,11 @@ class DenseCrdt:
                      val_ovf)) if bool(f)]
                 raise PipelinedGuardError(
                     f"guards tripped in pipelined window: "
-                    f"{', '.join(kinds)} across {pipe.merges} merges; "
-                    "possibly spurious (superset flags) — re-run the "
-                    "batches unpipelined for the exact diagnosis")
+                    f"{', '.join(kinds)}; first flagged at merge "
+                    f"#{int(first_idx)} of {pipe.merges} (0-based, "
+                    "window order); possibly spurious (superset "
+                    "flags) — re-run from that batch unpipelined for "
+                    "the exact diagnosis")
 
     # --- local ops: one send per batch (crdt.dart:39-54) ---
 
@@ -1025,6 +1041,10 @@ class DenseCrdt:
             # can't drift on empty anti-entropy rounds.
             self._wall_clock()
             if self._pipe is not None:
+                # empty merges still occupy a window slot so the
+                # flush's first-flag index stays aligned with the
+                # caller's merge order
+                self._pipe.merges += 1
                 self._pipe_send_bump(self._wall_clock())
                 return
             self._canonical_time = Hlc.send(self._canonical_time,
@@ -1077,9 +1097,12 @@ class DenseCrdt:
             # OR-accumulate; the canonical threads through the device
             # send bump; the adopted counter drains lazily.
             pipe = self._pipe
-            pipe.any_bad = pipe.any_bad | res.any_bad
+            new_flags = res.any_bad
             if voverflow is not None:
                 pipe.val_overflow = pipe.val_overflow | voverflow
+                new_flags = new_flags | voverflow
+            pipe.note(new_flags)
+            pipe.any_bad = pipe.any_bad | res.any_bad
             pipe.merges += 1
             self._store = new_store
             self.stats.add_adopted_lazy(res.win_count)
@@ -1133,6 +1156,9 @@ class DenseCrdt:
         new_lt, overflow, drift = send_step(pipe.canonical,
                                             jnp.int64(wall))
         pipe.canonical = new_lt
+        # merges was already incremented for this merge; attribute the
+        # send-bump flags to it (merges - 1 in 0-based window order).
+        pipe.note(overflow | drift, idx=pipe.merges - 1)
         pipe.overflow = pipe.overflow | overflow
         pipe.drift = pipe.drift | drift
 

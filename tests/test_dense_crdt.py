@@ -1288,3 +1288,20 @@ class TestValueWidth32:
         assert c.get(2) == 7            # in-range record merged
         assert c.get(1) is None         # overflow record skipped,
         assert not c.contains_slot(1)   # never truncated into place
+
+    def test_flush_names_first_flagged_merge(self):
+        from crdt_tpu import PipelinedGuardError
+        a = DenseCrdt("na", 64, wall_clock=FakeClock(start=BASE))
+        good = DenseCrdt("ng", 64, wall_clock=FakeClock(start=BASE + 3))
+        good.put_batch([5], [1])
+        bad = DenseCrdt("na", 64,            # duplicate node id
+                        wall_clock=FakeClock(start=BASE + 999))
+        bad.put_batch([0], [1])
+        gcs, gids = good.export_delta()
+        bcs, bids = bad.export_delta()
+        with pytest.raises(PipelinedGuardError, match="#2 of 4"):
+            with a.pipelined():
+                a.merge(gcs, gids)        # 0: clean
+                a.merge_many([])          # 1: empty, still a slot
+                a.merge(bcs, bids)        # 2: trips
+                a.merge(gcs, gids)        # 3: clean
