@@ -1190,6 +1190,63 @@ class TestPipelined:
                 a.merge_many([])   # empty merge still bumps the clock
 
 
+    def test_flush_names_first_flagged_merge(self):
+        from crdt_tpu import PipelinedGuardError
+        a = DenseCrdt("na", 64, wall_clock=FakeClock(start=BASE))
+        good = DenseCrdt("ng", 64, wall_clock=FakeClock(start=BASE + 3))
+        good.put_batch([5], [1])
+        bad = DenseCrdt("na", 64,            # duplicate node id
+                        wall_clock=FakeClock(start=BASE + 999))
+        bad.put_batch([0], [1])
+        gcs, gids = good.export_delta()
+        bcs, bids = bad.export_delta()
+        with pytest.raises(PipelinedGuardError, match="#2 of 4"):
+            with a.pipelined():
+                a.merge(gcs, gids)        # 0: clean
+                a.merge_many([])          # 1: empty, still a slot
+                a.merge(bcs, bids)        # 2: trips
+                a.merge(gcs, gids)        # 3: clean
+
+    @pytest.mark.parametrize("seed", range(3))
+    def test_randomized_window_differential(self, seed):
+        # Random mixes of peer merges, empty merges, and value shapes
+        # through one pipelined window vs the same sequence unpipelined
+        # — lanes, clock, and stats must match exactly.
+        import random
+        rng = random.Random(seed * 31 + 7)
+        n = 256
+        batches = []
+        for i in range(6):
+            p = DenseCrdt(f"p{i}", n,
+                          wall_clock=FakeClock(start=BASE + rng.randrange(50)))
+            slots = rng.sample(range(n), rng.randrange(1, 64))
+            p.put_batch(slots, [rng.randrange(-2 ** 40, 2 ** 40)
+                                for _ in slots])
+            if rng.random() < 0.5:
+                p.delete_batch(slots[:3])
+            batches.append(p.export_delta())
+        seq = [rng.choice([None, *range(len(batches))])
+               for _ in range(10)]
+        a = DenseCrdt("na", n, wall_clock=FakeClock(start=BASE + 500))
+        b = DenseCrdt("na", n, wall_clock=FakeClock(start=BASE + 500))
+        for s in seq:
+            if s is None:
+                a.merge_many([])
+            else:
+                a.merge(*batches[s])
+        with b.pipelined():
+            for s in seq:
+                if s is None:
+                    b.merge_many([])
+                else:
+                    b.merge(*batches[s])
+        from crdt_tpu.testing import assert_dense_stores_equal
+        assert_dense_stores_equal(a.store, b.store, f"seed={seed}")
+        assert a.canonical_time == b.canonical_time
+        assert a.stats.records_seen == b.stats.records_seen
+        assert a.stats.records_adopted == b.stats.records_adopted
+
+
 class TestValueWidth32:
     """The value-ref mode (`value_width=32`): int32 payloads/table
     indices in a single narrow kernel lane, identical semantics."""
@@ -1288,20 +1345,3 @@ class TestValueWidth32:
         assert c.get(2) == 7            # in-range record merged
         assert c.get(1) is None         # overflow record skipped,
         assert not c.contains_slot(1)   # never truncated into place
-
-    def test_flush_names_first_flagged_merge(self):
-        from crdt_tpu import PipelinedGuardError
-        a = DenseCrdt("na", 64, wall_clock=FakeClock(start=BASE))
-        good = DenseCrdt("ng", 64, wall_clock=FakeClock(start=BASE + 3))
-        good.put_batch([5], [1])
-        bad = DenseCrdt("na", 64,            # duplicate node id
-                        wall_clock=FakeClock(start=BASE + 999))
-        bad.put_batch([0], [1])
-        gcs, gids = good.export_delta()
-        bcs, bids = bad.export_delta()
-        with pytest.raises(PipelinedGuardError, match="#2 of 4"):
-            with a.pipelined():
-                a.merge(gcs, gids)        # 0: clean
-                a.merge_many([])          # 1: empty, still a slot
-                a.merge(bcs, bids)        # 2: trips
-                a.merge(gcs, gids)        # 3: clean
