@@ -548,8 +548,12 @@ class DenseCrdt:
         truncated and (sharing the peer's hlc) diverge forever — fail
         loudly, identically on every record ingest path."""
         for slot, rec in record_map.items():
-            if rec.value is not None and not isinstance(
-                    rec.value, (int, np.integer)):
+            if rec.value is not None and (
+                    isinstance(rec.value, bool)
+                    or not isinstance(rec.value, (int, np.integer))):
+                # bool is an int subclass but would be stored as 0/1
+                # and re-exported as such under the peer's hlc — the
+                # silent-divergence shape this check exists to stop.
                 raise TypeError(
                     f"DenseCrdt values must be ints; slot {slot} got "
                     f"{type(rec.value).__name__}")
@@ -709,9 +713,11 @@ class DenseCrdt:
         JSON decode). Values must be ints (or None tombstones) — the
         dense model's payload lane is int64.
 
-        Clock absorption and recv guards run host-side here, in the
+        Clock absorption and recv guards run host-side, in the
         payload's own iteration order — the reference's visit order
-        (crdt.dart:80-85) — so guard trips, their payloads, and the
+        (crdt.dart:80-85) — through the shared vectorized fold
+        (`utils.host_guards.recv_fold_columns`, the same one the other
+        host backends use), so guard trips, their payloads, and the
         partially-advanced canonical on failure match ``MapCrdt.merge``
         exactly. A slot-ordered device-side check could disagree on
         which records the fast path shields (hlc.dart:85). After
@@ -725,81 +731,250 @@ class DenseCrdt:
         materialize 1M-wide lanes). Equivalence with the full-width
         changeset join is property-tested
         (tests/test_dense_crdt.py::TestSparseWireDelta)."""
-        self._refuse_in_pipeline("merge_records")  # host recv loop
+        self._refuse_in_pipeline("merge_records")  # host recv fold
         if not record_map:
             self.merge_many([])
             return
+        k = len(record_map)
+        slots = np.fromiter(record_map.keys(), np.int64, count=k)
+        recs = list(record_map.values())
+        from .. import native
+        codec = native.load()
+        if codec is not None:
+            lt_buf, nodes, values = codec.records_to_columns(recs, False)
+            lt = np.frombuffer(lt_buf, np.int64)
+        else:
+            lt = np.fromiter((r.hlc.logical_time for r in recs),
+                             np.int64, count=k)
+            nodes = [r.hlc.node_id for r in recs]
+            values = [r.value for r in recs]
+        self._merge_columns(slots, lt, nodes, values)
+
+    def merge_json(self, json_str: str,
+                   key_decoder: Optional[KeyDecoder] = None,
+                   value_decoder: Optional[ValueDecoder] = None) -> None:
+        """Columnar wire JSON ingest (crdt.dart:100-109): C batch HLC
+        parse → packed int64 lanes → shared recv fold →
+        `sparse_fanin_step`, no per-record Record/Hlc objects (the
+        same decode shape `TpuMapCrdt`/`SqliteCrdt` ingest through).
+        Keys decode to int slots by default."""
+        self._refuse_in_pipeline("merge_json")  # host recv fold
+        # Tick parity with the generic Crdt.merge_json: the decode-time
+        # `modified` stamp consumes one wall read there
+        # (Crdt._decode_wall_millis contract) — a merge immediately
+        # re-stamps winners, so only the READ must happen here.
+        self._wall_clock()
+        if (key_decoder is None or key_decoder is int) \
+                and value_decoder is None:
+            from .. import native
+            codec = native.load()
+            scanned = (codec.parse_wire_dense(json_str)
+                       if codec is not None else None)
+            if scanned is not None:
+                # Zero-Python-object lane: the C scan produced raw
+                # columnar buffers (no key strings, no value ints) —
+                # validate ranges, map node ordinals, and join.
+                sbuf, ltbuf, nibuf, uniq, vbuf, tbuf, vmin, vmax = scanned
+                k = len(tbuf)
+                if not k:
+                    self.merge_many([])
+                    return
+                self.stats.merges += 1
+                self.stats.add_seen_lazy(k)
+                slots = np.frombuffer(sbuf, np.int32)
+                self._check_slots(slots)
+                self._check_value_width(np.array([vmin, vmax], np.int64))
+                self._intern_ids(uniq)
+                ords = {nid: i for i, nid
+                        in enumerate(self._table.ids())}
+                node = np.fromiter((ords[u] for u in uniq), np.int32,
+                                   count=len(uniq))[
+                                       np.frombuffer(nibuf, np.int32)]
+                self._merge_validated(
+                    slots, np.frombuffer(ltbuf, np.int64), node,
+                    np.frombuffer(vbuf, np.int64),
+                    np.frombuffer(tbuf, np.uint8).astype(bool))
+                return
+        keys, lt, nodes, values = crdt_json.decode_columns(
+            json_str, key_decoder=key_decoder or int,
+            value_decoder=value_decoder)
+        if not keys:
+            self.merge_many([])
+            return
+        self._merge_columns(np.asarray(keys, np.int64), lt, nodes,
+                            values)
+
+    def _merge_columns(self, slots: np.ndarray, lt: np.ndarray,
+                       node_ids: List[Any], values: List[Any]) -> None:
+        """The shared O(k) columnar merge core (`merge_records` /
+        `merge_json`): ``lt`` is int64[k] packed logical times aligned
+        with ``slots``/``node_ids``/``values``. Every validation runs
+        BEFORE the first clock mutation (and before the absorption
+        wall read — the legacy visit order under a counting clock), so
+        a rejected payload leaves the replica untouched."""
+        k = len(slots)
         self.stats.merges += 1
         # add_seen_lazy (host int here): `records_seen +=` would drain
         # any pending lazy device scalar with a blocking readback.
-        self.stats.add_seen_lazy(len(record_map))
-        # Validate payloads BEFORE any clock mutation so a bad record
-        # rejects the merge with the replica untouched.
-        self._check_int_values(record_map)
-        wall = self._wall_clock()
-        for rec in record_map.values():
-            self._canonical_time = Hlc.recv(self._canonical_time, rec.hlc,
-                                            millis=wall)
-        k = len(record_map)
-        slots = np.fromiter(record_map.keys(), np.int64, count=k)
+        self.stats.add_seen_lazy(k)
         self._check_slots(slots)
-        recs = list(record_map.values())
-        self._check_value_width(
-            [0 if r.value is None else int(r.value) for r in recs])
-        self._intern_ids({r.hlc.node_id for r in recs})
+        # The payload lane is int64; any other type (incl. bool, an
+        # int subclass that would store as 0/1) would silently diverge
+        # under the peer's hlc — one O(k) offender scan, on this
+        # non-C fallback path only (the C wire scan rejects upstream
+        # by deferring, and record dicts are already Python-bound).
+        from .. import native
+        codec = native.load()
+        if codec is not None:
+            tomb = np.frombuffer(codec.none_mask(values), bool)
+        else:
+            tomb = np.fromiter((v is None for v in values), bool, count=k)
+        bad = next((i for i, v in enumerate(values)
+                    if v is not None
+                    and (isinstance(v, bool)
+                         or not isinstance(v, (int, np.integer)))), None)
+        if bad is not None:
+            raise TypeError(
+                f"DenseCrdt values must be ints; slot {slots[bad]} got "
+                f"{type(values[bad]).__name__}")
+        val = np.fromiter((0 if v is None else v for v in values),
+                          np.int64, count=k)
+        self._check_value_width(val)
+        self._intern_ids(set(node_ids))
         ords = {nid: i for i, nid in enumerate(self._table.ids())}
-        # Pad k to a power of two so the jitted step compiles O(log k)
-        # distinct shapes, not one per delta size.
-        padded = 1 << max(k - 1, 1).bit_length()
-        lt = np.zeros((padded,), np.int64)
-        node = np.zeros((padded,), np.int32)
-        val = np.zeros((padded,), np.int64)
-        tomb = np.zeros((padded,), bool)
-        valid = np.zeros((padded,), bool)
-        slot_arr = np.full((padded,), self.n_slots, np.int64)
-        slot_arr[:k] = slots
-        valid[:k] = True
-        lt[:k] = [r.hlc.logical_time for r in recs]
-        node[:k] = [ords[r.hlc.node_id] for r in recs]
-        val[:k] = [0 if r.value is None else int(r.value) for r in recs]
-        tomb[:k] = [r.is_deleted for r in recs]
+        node = np.fromiter((ords[n] for n in node_ids), np.int32, count=k)
+        self._merge_validated(slots, lt, node, val, tomb)
 
-        stamp = jnp.int64(self._canonical_time.logical_time)
+    def _merge_validated(self, slots: np.ndarray, lt: np.ndarray,
+                         node: np.ndarray, val: np.ndarray,
+                         tomb: np.ndarray) -> None:
+        """Columnar merge tail on fully validated int lanes: recv fold,
+        store join, watch emission, final send bump. ``node`` already
+        holds LOCAL ordinals; stats counters are the caller's job up to
+        ``merges``/``records_seen`` (this adds adopted)."""
+        k = len(slots)
+        my_ord = self._table.ordinal(self._node_id)
+        wall = self._wall_clock()
+
+        # Recv guards + clock absorption against the RUNNING canonical
+        # (exclusive cummax — hlc.dart:85's fast path shields records
+        # the clock already dominates), in payload visit order, shared
+        # with the other host backends (utils/host_guards.py).
+        from ..utils.host_guards import recv_fold_columns
+        fold = recv_fold_columns(lt, node == my_ord,
+                                 self._canonical_time.logical_time, wall)
+        if fold.bad_index is not None:
+            # Canonical partially advanced to just before the offender
+            # (sequential-merge parity, crdt.dart:77-94 throw path);
+            # store untouched.
+            self._canonical_time = Hlc.from_logical_time(
+                fold.canonical_at_fail, self._node_id)
+            if fold.bad_is_dup:
+                raise DuplicateNodeException(str(self._node_id))
+            raise ClockDriftException(int(lt[fold.bad_index]) >> 16, wall)
+        new_canonical = fold.new_canonical
+
         with merge_annotation("crdt_tpu.dense_merge"):
-            new_store, win = sparse_fanin_step(
-                self._store, jnp.asarray(slot_arr), jnp.asarray(lt),
-                jnp.asarray(node), jnp.asarray(val), jnp.asarray(tomb),
-                jnp.asarray(valid), stamp,
-                jnp.int32(self._table.ordinal(self._node_id)))
+            new_store, win, slot_aligned = self._dispatch_columns(
+                slots, lt, node, val, tomb, new_canonical, my_ord)
         self._store = self._postprocess_store(new_store)
 
         if self._hub.active:
-            win_h = np.asarray(jax.device_get(win))[:k]
+            win_full = np.asarray(jax.device_get(win))
+            # The wide join reports win per SLOT; re-align to payload
+            # order so events keep the reference's visit order.
+            win_h = win_full[slots] if slot_aligned else win_full[:k]
             self.stats.records_adopted += int(win_h.sum())
-            for i, (slot, rec) in enumerate(record_map.items()):
-                if win_h[i]:
-                    self._hub.add(int(slot),
-                                  None if rec.is_deleted else int(rec.value))
+            widx = np.nonzero(win_h)[0]
+
+            def value_at(i):
+                return None if tomb[i] else int(val[i])
+
+            self._hub.add_batch(
+                lambda: ([int(slots[i]) for i in widx],
+                         [value_at(i) for i in widx]),
+                lambda q: ((True,
+                            value_at(int(np.nonzero(slots == q)[0][-1])))
+                           if isinstance(q, (int, np.integer))
+                           and bool(np.any(slots[widx] == q))
+                           else (False, None)))
         else:
             # No subscriber: keep the win mask on device — the warm
             # sparse path then has ZERO device->host fetches (each one
             # is a full round trip on remote-proxied backends); the
             # adopted counter drains lazily when stats are read.
             self.stats.add_adopted_lazy(jnp.sum(win))
-        self._canonical_time = Hlc.send(self._canonical_time,
-                                        millis=self._wall_clock())
+        self._canonical_time = Hlc.send(
+            Hlc.from_logical_time(new_canonical, self._node_id),
+            millis=self._wall_clock())
 
-    def merge_json(self, json_str: str,
-                   key_decoder: Optional[KeyDecoder] = None,
-                   value_decoder: Optional[ValueDecoder] = None) -> None:
-        """Wire JSON ingest (crdt.dart:100-109). Keys decode to int
-        slots by default."""
-        records = crdt_json.decode(
-            json_str, self._canonical_time,
-            key_decoder=key_decoder or int,
-            value_decoder=value_decoder,
-            now_millis=self._wall_clock())
-        self.merge_records(records)
+    # Above this fraction of the slot space a columnar delta executes
+    # as the elementwise N-wide join instead of the k-index scatter:
+    # TPU scatters serialize per index (~0.3 s for 1M indices on v5e),
+    # while the slot-aligned compare/select sweep is one fused
+    # elementwise pass; the host-side fancy-write that builds the
+    # N-wide lanes costs ~30 ms at 1M. Below the threshold the O(k)
+    # scatter wins (a 10-record sync must not touch N-wide lanes).
+    WIDE_JOIN_FRACTION = 4
+
+    def _dispatch_columns(self, slots, lt, node, val, tomb,
+                          new_canonical: int, my_ord: int):
+        """Run a validated columnar delta through the store join.
+        Returns ``(new_store, win, slot_aligned)`` — ``win`` is per
+        SLOT (N-wide) when ``slot_aligned``, else per payload entry."""
+        k = len(slots)
+        n = self.n_slots
+        if k * self.WIDE_JOIN_FRACTION >= n:
+            lt_n = np.zeros((n,), np.int64)
+            node_n = np.zeros((n,), np.int16
+                              if len(self._table) <= 0x7FFF else np.int32)
+            tomb_n = np.zeros((n,), bool)
+            valid_n = np.zeros((n,), bool)
+            lt_n[slots] = lt
+            node_n[slots] = node
+            tomb_n[slots] = tomb
+            valid_n[slots] = True
+            # Narrow the value lane to int32 when every value fits —
+            # the transfer is the wide join's main cost and the jit
+            # widens on device (value_width=32 replicas always fit).
+            if self._value_width == 32 or (
+                    k and -(2 ** 31) <= int(val.min())
+                    and int(val.max()) < 2 ** 31):
+                val_n = np.zeros((n,), np.int32)
+            else:
+                val_n = np.zeros((n,), np.int64)
+            val_n[slots] = val
+            from ..ops.dense import wire_join_step
+            new_store, win = wire_join_step(
+                self._store, jnp.asarray(lt_n), jnp.asarray(node_n),
+                jnp.asarray(val_n), jnp.asarray(tomb_n),
+                jnp.asarray(valid_n), jnp.int64(new_canonical),
+                jnp.int32(my_ord))
+            return new_store, win, True
+        # Pad k to a power of two (invalid rows scatter to the n_slots
+        # sentinel, mode="drop") so the jitted step compiles O(log k)
+        # distinct shapes, not one per delta size.
+        padded = 1 << max(k - 1, 1).bit_length()
+        lt_p = np.zeros((padded,), np.int64)
+        node_p = np.zeros((padded,), np.int32)
+        val_p = np.zeros((padded,), np.int64)
+        tomb_p = np.zeros((padded,), bool)
+        valid = np.zeros((padded,), bool)
+        slot_arr = np.full((padded,), self.n_slots,
+                           np.int32 if self.n_slots < 2 ** 31 - 1
+                           else np.int64)
+        slot_arr[:k] = slots
+        valid[:k] = True
+        lt_p[:k] = lt
+        node_p[:k] = node
+        val_p[:k] = val
+        tomb_p[:k] = tomb
+        new_store, win = sparse_fanin_step(
+            self._store, jnp.asarray(slot_arr), jnp.asarray(lt_p),
+            jnp.asarray(node_p), jnp.asarray(val_p),
+            jnp.asarray(tomb_p), jnp.asarray(valid),
+            jnp.int64(new_canonical), jnp.int32(my_ord))
+        return new_store, win, False
 
     # --- checkpoint/resume (SURVEY.md §5) ---
 
@@ -1173,6 +1348,15 @@ class ShardedDenseCrdt(DenseCrdt):
     padded with invalid rows up to a multiple of the mesh's replica
     dimension, then sharded ``(replica, key)``.
 
+    On TPU meshes whose per-device key shards are tile-aligned (and
+    under forced ``executor="pallas"``/``"pallas-interpret"``), the
+    per-device reduce inside the collective step runs through the
+    Mosaic batch kernel (`parallel.fanin.make_sharded_pallas_fanin`) —
+    the same executor as the single-chip headline path — with the
+    pmax/pmin/psum replica reduction combining the per-shard partial
+    stores. ``executor="xla"`` forces the plain shard_map fold.
+    Results are lane-exact across all three executors.
+
     Guard semantics: the collective flags are per-device (coarser than
     the sequential visit order); when one trips, the guards are
     recomputed exactly on the unsharded changeset (`_exact_guards`), so
@@ -1184,21 +1368,50 @@ class ShardedDenseCrdt(DenseCrdt):
     def __init__(self, node_id: Any, n_slots: int, mesh,
                  wall_clock: Optional[Callable[[], int]] = None,
                  store: Optional[DenseStore] = None,
-                 node_ids: Optional[Sequence[Any]] = None):
-        from ..parallel import make_sharded_fanin, shard_store
+                 node_ids: Optional[Sequence[Any]] = None,
+                 executor: str = "auto", value_width: int = 64):
+        from ..parallel import KEY_AXIS, make_sharded_fanin, shard_store
         self._mesh = mesh
         self._sharded_step = make_sharded_fanin(mesh)
+        self._sharded_pallas_step = None
         self._shard = lambda s: shard_store(s, mesh)
+        if executor in ("pallas", "pallas-interpret"):
+            # Per-shard alignment, validated eagerly like the base
+            # model: each device's key shard feeds the kernel whole.
+            from ..ops.pallas_merge import TILE
+            k = mesh.shape[KEY_AXIS]
+            if n_slots % k or (n_slots // k) % TILE:
+                raise ValueError(
+                    f"executor={executor!r} needs n_slots divisible by "
+                    f"the mesh's {k} key shards with each shard a "
+                    f"multiple of {TILE}; got n_slots={n_slots}")
         super().__init__(node_id, n_slots, wall_clock=wall_clock,
-                         store=store, node_ids=node_ids)
+                         store=store, node_ids=node_ids,
+                         executor=executor, value_width=value_width)
         self._store = self._shard(self._store)
 
     def _dispatch_fanin(self, cs: DenseChangeset, wall: int):
-        from ..parallel import replica_extent, shard_changeset
+        from ..parallel import (make_sharded_pallas_fanin, replica_extent,
+                                shard_changeset)
         # The replica dim shards over EVERY non-key mesh axis (just
         # "replica" on a flat mesh; ("slice", "replica") on a
         # multi-slice one).
-        cs = pad_replica_rows(cs, replica_extent(self._mesh))
+        extent = replica_extent(self._mesh)
+        if self._use_pallas_sharded():
+            # Kernel path: each device's shard must walk in whole
+            # chunk_rows groups, so the replica padding is coarser.
+            if self._sharded_pallas_step is None:
+                self._sharded_pallas_step = make_sharded_pallas_fanin(
+                    self._mesh, chunk_rows=self.STREAM_CHUNK_ROWS,
+                    interpret=self._executor == "pallas-interpret")
+            cs = pad_replica_rows(cs, extent * self.STREAM_CHUNK_ROWS)
+            cs = shard_changeset(cs, self._mesh)
+            return self._sharded_pallas_step(
+                self._store, cs,
+                self._canonical_lt(),
+                jnp.int32(self._table.ordinal(self._node_id)),
+                jnp.int64(wall))
+        cs = pad_replica_rows(cs, extent)
         cs = shard_changeset(cs, self._mesh)
         return self._sharded_step(
             self._store, cs,
@@ -1207,11 +1420,40 @@ class ShardedDenseCrdt(DenseCrdt):
             jnp.int64(wall))
 
     def _use_pallas(self) -> bool:
-        # The sharded route is the shard_map collective fan-in; the
-        # Mosaic kernel never runs here (a per-shard kernel under
-        # shard_map is future work), so merge_many must keep its own
-        # seen-count / value-width device ops.
+        # False on purpose: merge_many's generic branch must keep its
+        # seen-count / value-width device ops (the sharded collective
+        # step doesn't fold them in). The kernel still runs — PER
+        # SHARD, inside the shard_map body — when
+        # `_use_pallas_sharded` routes `_dispatch_fanin` to
+        # `make_sharded_pallas_fanin`.
         return False
+
+    def _use_pallas_sharded(self) -> bool:
+        """Route the sharded fan-in through the per-device Mosaic
+        kernel? Forced by ``executor=`` ("pallas"/"pallas-interpret"
+        on, "xla" off); "auto" takes the kernel when each device's key
+        shard is tile-aligned, the node table fits the kernel's int16
+        wire lane, and the backend is TPU."""
+        from ..ops.pallas_merge import MAX_NODE_ORDINAL, TILE
+        from ..parallel import KEY_AXIS
+        if len(self._table) > MAX_NODE_ORDINAL:
+            if self._executor in ("pallas", "pallas-interpret"):
+                raise ValueError(
+                    f"executor={self._executor!r} supports at most "
+                    f"{MAX_NODE_ORDINAL} node ordinals; table holds "
+                    f"{len(self._table)}")
+            return False
+        if self._executor == "xla":
+            return False
+        if self._executor in ("pallas", "pallas-interpret"):
+            return True
+        k = self._mesh.shape[KEY_AXIS]
+        # Gate on the MESH's devices, not the process default: a CPU
+        # validation mesh on a TPU host (or vice versa) must route by
+        # where the store actually lives.
+        return (self.n_slots % k == 0
+                and (self.n_slots // k) % TILE == 0
+                and self._mesh.devices.flat[0].platform == "tpu")
 
     # _exact_guards: inherited — ShardedFaninResult carries no
     # first_bad field, so the base recompute path handles the sharded
@@ -1249,6 +1491,13 @@ class ShardedDenseCrdt(DenseCrdt):
             raise ValueError(
                 f"n_slots={n_slots} not divisible by the mesh's "
                 f"{k} key shards")
+        if self._executor in ("pallas", "pallas-interpret"):
+            from ..ops.pallas_merge import TILE
+            if (n_slots // k) % TILE:
+                raise ValueError(
+                    f"executor={self._executor!r} needs each of the "
+                    f"{k} key shards a multiple of {TILE}; got "
+                    f"n_slots={n_slots}")
         super().grow(n_slots)
         self._store = self._shard(self._store)
 

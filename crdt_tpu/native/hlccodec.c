@@ -922,6 +922,305 @@ done:
     return NULL;
 }
 
+/* parse_wire_dense(json_str) — the DenseCrdt-targeted scan of the
+ * canonical int-key wire payload: besides parse_wire's one-pass
+ * structure it skips EVERY per-record Python object (no key strings,
+ * no value ints, no node list), emitting raw columnar buffers:
+ *
+ *   (slots: bytearray int32   — strictly ascending int keys,
+ *    lt:    bytearray int64   — packed (millis<<16)|counter,
+ *    node_idx: bytearray int32 — index into uniq_nodes,
+ *    uniq_nodes: list[str]    — first-seen order, deduped,
+ *    values: bytearray int64  — 0 for tombstones,
+ *    tomb:  bytearray uint8,
+ *    vmin: int, vmax: int)    — value range (0, 0 when all tombs)
+ *
+ * or None to defer to the generic path. Beyond parse_wire's fallback
+ * rules it defers when: a key is not a canonical non-negative int
+ * literal fitting int32, or keys are not strictly ascending (so
+ * duplicate-key collapse never arises — every producer in this
+ * codebase exports slot-ordered); an hlc is non-canonical; a value is
+ * not an int64-range integer literal or null (floats, bools, strings,
+ * containers all defer — the generic path then raises the documented
+ * TypeError or handles them); more than DENSE_MAX_NODES distinct node
+ * ids appear. Deferring is always semantics-preserving: the generic
+ * path computes the identical result, slower. */
+
+#define DENSE_MAX_NODES 4096
+#define DENSE_NTAB 8192   /* open-address table, 2x max uniques */
+
+typedef struct {
+    const char *p;
+    Py_ssize_t n;
+    int idx;              /* index into uniq list; -1 = empty */
+} DenseNodeEnt;
+
+static PyObject *parse_wire_dense(PyObject *self, PyObject *arg) {
+    Py_ssize_t len;
+    const char *s = PyUnicode_AsUTF8AndSize(arg, &len);
+    if (!s) { PyErr_Clear(); Py_RETURN_NONE; }
+
+    Scan sc = {s, len, 0, 0};
+    PyObject *uniq = NULL, *result = NULL;
+    int *slots = NULL;
+    long long *lt = NULL, *vals = NULL;
+    int *nidx = NULL;
+    unsigned char *tomb = NULL;
+    DenseNodeEnt *ntab = NULL;
+    Py_ssize_t cap = 0, count = 0;
+    long long vmin = 0, vmax = 0;
+    int have_val_range = 0;
+    long long last_slot = -1;
+    NodeEnt kcache[NCACHE];       /* for discarded unknown members */
+    memset(kcache, 0, sizeof kcache);
+
+    uniq = PyList_New(0);
+    ntab = (DenseNodeEnt *)PyMem_Malloc(
+        DENSE_NTAB * sizeof(DenseNodeEnt));
+    if (!uniq || !ntab) { PyErr_NoMemory(); goto done; }
+    for (int i = 0; i < DENSE_NTAB; i++) ntab[i].idx = -1;
+
+    skip_ws(&sc);
+    if (sc.pos >= len || s[sc.pos] != '{') { sc.fallback = 1; goto done; }
+    sc.pos++;
+    skip_ws(&sc);
+    if (sc.pos < len && s[sc.pos] == '}') {
+        sc.pos++;
+        goto finish;
+    }
+
+    for (;;) {
+        /* ---- top-level key: canonical int literal, ascending ---- */
+        skip_ws(&sc);
+        Py_ssize_t kb, ke; int kesc;
+        if (!string_span(&sc, &kb, &ke, &kesc)) goto done;
+        if (kesc || ke == kb || ke - kb > 10) { sc.fallback = 1; goto done; }
+        long long slot = 0;
+        {
+            /* digits only, no leading zeros (except "0" itself) —
+             * anything else defers so int(key) semantics stay with
+             * the generic path */
+            if (s[kb] == '0' && ke - kb > 1) { sc.fallback = 1; goto done; }
+            for (Py_ssize_t i = kb; i < ke; i++) {
+                char c = s[i];
+                if (c < '0' || c > '9') { sc.fallback = 1; goto done; }
+                slot = slot * 10 + (c - '0');
+            }
+            if (slot > 0x7FFFFFFFLL || slot <= last_slot) {
+                sc.fallback = 1; goto done;
+            }
+            last_slot = slot;
+        }
+        skip_ws(&sc);
+        if (sc.pos >= len || s[sc.pos] != ':') { sc.fallback = 1; goto done; }
+        sc.pos++;
+        skip_ws(&sc);
+
+        /* ---- inner record object ---- */
+        if (sc.pos >= len || s[sc.pos] != '{') { sc.fallback = 1; goto done; }
+        sc.pos++;
+        long long item_lt = 0, item_val = 0;
+        int item_node = -1, item_tomb = 1, have_hlc = 0, have_value = 0;
+        skip_ws(&sc);
+        if (sc.pos < len && s[sc.pos] == '}') sc.pos++;
+        else for (;;) {
+            skip_ws(&sc);
+            Py_ssize_t mb, me; int mesc;
+            if (!string_span(&sc, &mb, &me, &mesc)) goto done;
+            if (mesc) { sc.fallback = 1; goto done; }
+            skip_ws(&sc);
+            if (sc.pos >= len || s[sc.pos] != ':') {
+                sc.fallback = 1; goto done;
+            }
+            sc.pos++;
+            skip_ws(&sc);
+            if (me - mb == 3 && memcmp(s + mb, "hlc", 3) == 0) {
+                Py_ssize_t hb, he; int hesc;
+                if (sc.pos >= len || s[sc.pos] != '"') {
+                    sc.fallback = 1; goto done;
+                }
+                if (!string_span(&sc, &hb, &he, &hesc)) goto done;
+                long long ms, counter;
+                if (hesc || he - hb < 31 || s[hb + 24] != '-' ||
+                    s[hb + 29] != '-' ||
+                    !parse_canonical_iso(s + hb, &ms) ||
+                    ms > 0x7FFFFFFFFFFFLL || ms < -0x800000000000LL ||
+                    !hex4(s + hb + 25, &counter)) {
+                    sc.fallback = 1; goto done;  /* non-canonical hlc */
+                }
+                have_hlc = 1;
+                item_lt = (ms << 16) | counter;
+                /* node id -> uniq index (open-address, span-keyed) */
+                {
+                    const char *np_ = s + hb + 30;
+                    Py_ssize_t nn = he - hb - 30;
+                    unsigned long long h = 1469598103934665603ULL;
+                    for (Py_ssize_t i = 0; i < nn; i++)
+                        h = (h ^ (unsigned char)np_[i])
+                            * 1099511628211ULL;
+                    Py_ssize_t probe = (Py_ssize_t)(h & (DENSE_NTAB - 1));
+                    item_node = -1;
+                    for (;;) {
+                        DenseNodeEnt *e = &ntab[probe];
+                        if (e->idx < 0) {
+                            Py_ssize_t u = PyList_GET_SIZE(uniq);
+                            if (u >= DENSE_MAX_NODES) {
+                                sc.fallback = 1; goto done;
+                            }
+                            PyObject *ns = PyUnicode_FromStringAndSize(
+                                np_, nn);
+                            if (!ns) goto done;
+                            if (PyList_Append(uniq, ns) < 0) {
+                                Py_DECREF(ns); goto done;
+                            }
+                            Py_DECREF(ns);
+                            e->p = np_; e->n = nn; e->idx = (int)u;
+                            item_node = (int)u;
+                            break;
+                        }
+                        if (e->n == nn &&
+                            memcmp(e->p, np_, (size_t)nn) == 0) {
+                            item_node = e->idx;
+                            break;
+                        }
+                        probe = (probe + 1) & (DENSE_NTAB - 1);
+                    }
+                }
+            } else if (me - mb == 5 &&
+                       memcmp(s + mb, "value", 5) == 0) {
+                have_value = 1;
+                if (sc.pos < len && s[sc.pos] == 'n') {
+                    if (!lit(&sc, "null", 4)) { sc.fallback = 1; goto done; }
+                    item_tomb = 1; item_val = 0;
+                } else {
+                    /* strict int64 literal; anything else defers */
+                    Py_ssize_t p = sc.pos;
+                    int neg = 0;
+                    if (p < len && s[p] == '-') { neg = 1; p++; }
+                    if (p >= len || s[p] < '0' || s[p] > '9') {
+                        sc.fallback = 1; goto done;
+                    }
+                    if (s[p] == '0' && p + 1 < len &&
+                        s[p + 1] >= '0' && s[p + 1] <= '9') {
+                        sc.fallback = 1; goto done;
+                    }
+                    unsigned long long acc = 0;
+                    while (p < len && s[p] >= '0' && s[p] <= '9') {
+                        unsigned long long d =
+                            (unsigned long long)(s[p] - '0');
+                        if (acc > (0xFFFFFFFFFFFFFFFFULL - d) / 10) {
+                            sc.fallback = 1; goto done;  /* overflow */
+                        }
+                        acc = acc * 10 + d;
+                        p++;
+                    }
+                    if (p < len && (s[p] == '.' || s[p] == 'e' ||
+                                    s[p] == 'E')) {
+                        sc.fallback = 1; goto done;  /* float literal */
+                    }
+                    /* int64 range check (generic path raises past it) */
+                    if (neg ? acc > 0x8000000000000000ULL
+                            : acc > 0x7FFFFFFFFFFFFFFFULL) {
+                        sc.fallback = 1; goto done;
+                    }
+                    item_val = neg ? (long long)(0ULL - acc)
+                                   : (long long)acc;
+                    item_tomb = 0;
+                    sc.pos = p;
+                }
+            } else {
+                /* unknown member: validate + discard */
+                PyObject *v = parse_json_value(&sc, kcache, 0);
+                if (!v) goto done;
+                Py_DECREF(v);
+            }
+            skip_ws(&sc);
+            if (sc.pos < len && s[sc.pos] == ',') { sc.pos++; continue; }
+            if (sc.pos < len && s[sc.pos] == '}') { sc.pos++; break; }
+            sc.fallback = 1;
+            goto done;
+        }
+        if (!have_hlc) { sc.fallback = 1; goto done; }
+        if (!have_value) { item_tomb = 1; item_val = 0; }
+
+        if (count == cap) {
+            Py_ssize_t ncap = cap ? cap * 2 : 1024;
+            int *ns_ = (int *)PyMem_Realloc(
+                slots, (size_t)ncap * sizeof(int));
+            if (ns_) slots = ns_;
+            long long *nl = ns_ ? (long long *)PyMem_Realloc(
+                lt, (size_t)ncap * sizeof(long long)) : NULL;
+            if (nl) lt = nl;
+            long long *nv = nl ? (long long *)PyMem_Realloc(
+                vals, (size_t)ncap * sizeof(long long)) : NULL;
+            if (nv) vals = nv;
+            int *ni = nv ? (int *)PyMem_Realloc(
+                nidx, (size_t)ncap * sizeof(int)) : NULL;
+            if (ni) nidx = ni;
+            unsigned char *nt = ni ? (unsigned char *)PyMem_Realloc(
+                tomb, (size_t)ncap) : NULL;
+            if (nt) tomb = nt;
+            if (!nt) { PyErr_NoMemory(); goto done; }
+            cap = ncap;
+        }
+        slots[count] = (int)slot;
+        lt[count] = item_lt;
+        vals[count] = item_val;
+        nidx[count] = item_node;
+        tomb[count] = (unsigned char)item_tomb;
+        if (!item_tomb) {
+            if (!have_val_range) {
+                vmin = vmax = item_val;
+                have_val_range = 1;
+            } else {
+                if (item_val < vmin) vmin = item_val;
+                if (item_val > vmax) vmax = item_val;
+            }
+        }
+        count++;
+
+        skip_ws(&sc);
+        if (sc.pos < len && s[sc.pos] == ',') { sc.pos++; continue; }
+        if (sc.pos < len && s[sc.pos] == '}') { sc.pos++; break; }
+        sc.fallback = 1;
+        goto done;
+    }
+
+finish:
+    skip_ws(&sc);
+    if (sc.pos != len) { sc.fallback = 1; goto done; }
+    {
+        PyObject *slot_buf = PyByteArray_FromStringAndSize(
+            (const char *)slots, count * (Py_ssize_t)sizeof(int));
+        PyObject *lt_buf = PyByteArray_FromStringAndSize(
+            (const char *)lt, count * (Py_ssize_t)sizeof(long long));
+        PyObject *nidx_buf = PyByteArray_FromStringAndSize(
+            (const char *)nidx, count * (Py_ssize_t)sizeof(int));
+        PyObject *val_buf = PyByteArray_FromStringAndSize(
+            (const char *)vals, count * (Py_ssize_t)sizeof(long long));
+        PyObject *tomb_buf = PyByteArray_FromStringAndSize(
+            (const char *)tomb, count);
+        PyObject *vmin_o = PyLong_FromLongLong(vmin);
+        PyObject *vmax_o = PyLong_FromLongLong(vmax);
+        if (slot_buf && lt_buf && nidx_buf && val_buf && tomb_buf &&
+            vmin_o && vmax_o)
+            result = PyTuple_Pack(8, slot_buf, lt_buf, nidx_buf, uniq,
+                                  val_buf, tomb_buf, vmin_o, vmax_o);
+        Py_XDECREF(slot_buf); Py_XDECREF(lt_buf); Py_XDECREF(nidx_buf);
+        Py_XDECREF(val_buf); Py_XDECREF(tomb_buf);
+        Py_XDECREF(vmin_o); Py_XDECREF(vmax_o);
+    }
+
+done:
+    for (int i = 0; i < NCACHE; i++) Py_XDECREF(kcache[i].obj);
+    PyMem_Free(slots); PyMem_Free(lt); PyMem_Free(vals);
+    PyMem_Free(nidx); PyMem_Free(tomb); PyMem_Free(ntab);
+    Py_XDECREF(uniq);
+    if (result) return result;
+    if (sc.fallback && !PyErr_Occurred()) Py_RETURN_NONE;
+    return NULL;
+}
+
 /* ================== host-runtime batch helpers ==================
  *
  * The vectorized backends keep key->slot maps and payload tables as
@@ -1521,6 +1820,8 @@ static PyMethodDef methods[] = {
      "Batch-format HLC components to wire strings."},
     {"parse_wire", parse_wire, METH_VARARGS,
      "One-pass columnar scan of a wire JSON payload."},
+    {"parse_wire_dense", parse_wire_dense, METH_O,
+     "Dense-model scan: int keys + int values to raw buffers."},
     {"format_wire", format_wire, METH_VARARGS,
      "Assemble a wire JSON payload from parallel columns."},
     {"dump_values", dump_values, METH_VARARGS,

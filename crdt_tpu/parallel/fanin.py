@@ -46,6 +46,7 @@ import jax.numpy as jnp
 from jax import P
 from jax.sharding import Mesh, NamedSharding
 
+from ..hlc import MAX_COUNTER, MAX_DRIFT, SHIFT
 from ..ops.dense import (DenseChangeset, DenseStore, reduce_replicas,
                          _NEG, _I32_NEG)
 from ..ops.merge import recv_guards
@@ -177,12 +178,7 @@ def _fanin_block(replica_axes: tuple, store: DenseStore,
     node_cand = jnp.where(best_lt == m1, best_node, _I32_NEG)
     m2 = jax.lax.pmax(node_cand, replica_axes)
     has = (best_lt == m1) & (best_node == m2)
-    # Flat rank across the replica axes, outer-major — the order the
-    # [R, N] changeset rows are laid out over the mesh, so the lowest
-    # flat rank is the earliest replica row (sequential-merge parity).
-    rank = jax.lax.axis_index(replica_axes[0])
-    for a in replica_axes[1:]:
-        rank = rank * jax.lax.axis_size(a) + jax.lax.axis_index(a)
+    rank = _flat_rank(replica_axes)
     winner_rank = jax.lax.pmin(jnp.where(has, rank, _BIG_RANK),
                                replica_axes)
     mine = has & (rank == winner_rank)
@@ -216,6 +212,147 @@ def _fanin_block(replica_axes: tuple, store: DenseStore,
     return new_store, ShardedFaninResult(
         new_canonical=new_canonical, win_count=win_count, win=win,
         any_bad=any_bad, any_dup=any_dup, any_drift=any_drift)
+
+
+def _flat_rank(replica_axes: tuple) -> jax.Array:
+    """Flat rank across the replica axes, outer-major — the order the
+    [R, N] changeset rows are laid out over the mesh, so the lowest
+    flat rank is the earliest replica row (sequential-merge parity)."""
+    rank = jax.lax.axis_index(replica_axes[0])
+    for a in replica_axes[1:]:
+        rank = rank * jax.lax.axis_size(a) + jax.lax.axis_index(a)
+    return rank
+
+
+def _pallas_fanin_block(replica_axes: tuple, chunk_rows: int,
+                        interpret: bool, store: DenseStore,
+                        cs: DenseChangeset, canonical_lt: jax.Array,
+                        local_node: jax.Array, wall_millis: jax.Array
+                        ) -> Tuple[DenseStore, ShardedFaninResult]:
+    """Per-device body with the Mosaic kernel doing the heavy reduce.
+
+    Each device runs `ops.pallas_merge.pallas_fanin_batch` to merge its
+    own [R_blk, N_blk] changeset rows into its (replica-replicated)
+    store shard — the 24× single-chip kernel, per shard. The partial
+    stores of a key column then differ only where a device adopted a
+    remote record, and the final store is their lexicographic
+    ``(lt, node)`` maximum: the same pmax → masked pmax → stable pmin
+    rank → one-hot psum reduction the XLA block uses, applied to 2
+    int64 + 1 int32 + 2 small lanes instead of the full R-row
+    changeset. Winner ``modified`` lanes are re-stamped with the
+    GLOBAL post-absorption canonical (the kernel's device-local stamp
+    is discarded), so lanes match the single-device executor
+    bit-for-bit.
+
+    Guard flags are the kernel contract's closed-form optimistic
+    superset (`pallas_fanin_batch` docstring): a local-node record
+    above the pre-merge canonical, or any record past the drift
+    threshold, pmaxed over the mesh. The model recomputes exactly on
+    host when one trips (`DenseCrdt._exact_guards`), so spurious flags
+    never reject a merge and raised exceptions keep first-offender
+    parity.
+    """
+    from ..ops.pallas_merge import (join_store, pallas_fanin_batch,
+                                    split_changeset, split_store)
+    all_axes = replica_axes + (KEY_AXIS,)
+
+    # --- closed-form guard bounds + canonical absorption: both ride
+    # ONE two-lane pmax over the whole mesh ---
+    masked_lt = jnp.where(cs.valid, cs.lt, _NEG)
+    local_max = jnp.max(masked_lt)
+    m_loc = jnp.max(jnp.where(cs.valid & (cs.node == local_node),
+                              cs.lt, _NEG))
+    g = jax.lax.pmax(jnp.stack([local_max, m_loc]), all_axes)
+    g_max, g_loc = g[0], g[1]
+    new_canonical = jnp.maximum(canonical_lt, g_max)
+    any_dup = g_loc > canonical_lt
+    thresh = ((wall_millis + MAX_DRIFT) << SHIFT) | MAX_COUNTER
+    any_drift = g_max > thresh
+    any_bad = any_dup | any_drift
+
+    # --- per-shard Mosaic kernel merge (store shard is replicated
+    # across the replica axes; each device folds only its own rows) ---
+    sst = split_store.__wrapped__(store)
+    scs = split_changeset.__wrapped__(cs)
+    out, pres = pallas_fanin_batch.__wrapped__(
+        sst, scs, canonical_lt, local_node, wall_millis,
+        chunk_rows=chunk_rows, interpret=interpret)
+    partial = join_store.__wrapped__(out)
+
+    # --- cross-device lexicographic (lt, node) max over the partial
+    # stores. Adoption in-kernel is strictly greater than the store
+    # record, so every partial >= the store record and the max IS the
+    # full join; ties pick the lowest flat rank (earliest replica
+    # rows — sequential-merge parity, matching the in-kernel strict
+    # compare that keeps the earliest row). ---
+    p_lt = jnp.where(partial.occupied, partial.lt, _NEG)
+    m1 = jax.lax.pmax(p_lt, replica_axes)
+    node_cand = jnp.where(p_lt == m1, partial.node, _I32_NEG)
+    m2 = jax.lax.pmax(node_cand, replica_axes)
+    has = (p_lt == m1) & (partial.node == m2)
+    rank = _flat_rank(replica_axes)
+    winner_rank = jax.lax.pmin(jnp.where(has, rank, _BIG_RANK),
+                               replica_axes)
+    mine = has & (rank == winner_rank)
+    g_val = jax.lax.psum(jnp.where(mine, partial.val, 0), replica_axes)
+    g_tomb = jax.lax.psum(
+        jnp.where(mine, partial.tomb, False).astype(jnp.int32),
+        replica_axes) > 0
+    # A slot was adopted iff the winning device's kernel adopted it
+    # (devices that kept the store lose the rank tie or the lex max).
+    win = jax.lax.psum(
+        jnp.where(mine, pres.win, False).astype(jnp.int32),
+        replica_axes) > 0
+
+    new_store = DenseStore(
+        lt=jnp.where(win, m1, store.lt),
+        node=jnp.where(win, m2, store.node),
+        val=jnp.where(win, g_val, store.val),
+        mod_lt=jnp.where(win, new_canonical, store.mod_lt),
+        mod_node=jnp.where(win, local_node, store.mod_node),
+        occupied=store.occupied | win,
+        tomb=jnp.where(win, g_tomb, store.tomb),
+    )
+    win_count = jax.lax.psum(jnp.sum(win).astype(jnp.int32), KEY_AXIS)
+    return new_store, ShardedFaninResult(
+        new_canonical=new_canonical, win_count=win_count, win=win,
+        any_bad=any_bad, any_dup=any_dup, any_drift=any_drift)
+
+
+def make_sharded_pallas_fanin(mesh: Mesh, *, chunk_rows: int = 8,
+                              interpret: bool = False):
+    """`make_sharded_fanin` with the per-device reduce running through
+    the Mosaic batch kernel (`_pallas_fanin_block`) — the single-chip
+    headline executor inside the multi-chip collective step.
+
+    Requirements beyond the XLA step: each device's key shard must be
+    a multiple of `ops.pallas_merge.TILE`, changeset replica rows must
+    pad to ``replica_extent(mesh) * chunk_rows``, and node ordinals
+    must fit the kernel's int16 wire lane (the model layer gates all
+    three — `ShardedDenseCrdt._use_pallas_sharded`). ``interpret=True``
+    runs the kernel in Pallas interpret mode for non-TPU meshes (the
+    virtual-CPU validation path).
+    """
+    from functools import partial
+    replica_axes = _replica_axes(mesh)
+    step = jax.shard_map(
+        partial(_pallas_fanin_block, replica_axes, chunk_rows, interpret),
+        mesh=mesh,
+        in_specs=(
+            DenseStore(*([P(KEY_AXIS)] * len(DenseStore._fields))),
+            DenseChangeset(*([P(replica_axes, KEY_AXIS)]
+                             * len(DenseChangeset._fields))),
+            P(), P(), P(),
+        ),
+        out_specs=(
+            DenseStore(*([P(KEY_AXIS)] * len(DenseStore._fields))),
+            ShardedFaninResult(
+                new_canonical=P(), win_count=P(), win=P(KEY_AXIS),
+                any_bad=P(), any_dup=P(), any_drift=P()),
+        ),
+        check_vma=False,
+    )
+    return jax.jit(step)
 
 
 def make_sharded_fanin(mesh: Mesh):

@@ -1345,3 +1345,125 @@ class TestValueWidth32:
         assert c.get(2) == 7            # in-range record merged
         assert c.get(1) is None         # overflow record skipped,
         assert not c.contains_slot(1)   # never truncated into place
+
+
+class TestColumnarWireIngest:
+    """The columnar merge core (`DenseCrdt._merge_columns`): merge_json
+    and merge_records route through decode_columns / records_to_columns
+    + the shared `recv_fold_columns` — no per-record Hlc.recv loop.
+    These pin the contracts the rewrite must preserve."""
+
+    def test_tick_parity_with_oracle_merge_json(self):
+        # Same wire payload, same CountingClock: the columnar ingest
+        # must draw exactly as many wall reads as the generic path, or
+        # injected-clock differentials silently diverge.
+        from crdt_tpu import MapCrdt
+        from crdt_tpu.testing import CountingClock
+        src = DenseCrdt("src", N, wall_clock=FakeClock(start=BASE))
+        src.put_batch([1, 5], [10, 50])
+        src.delete_batch([5])
+        wire = src.to_json()
+        cd, cm = CountingClock(start=BASE + 9), CountingClock(start=BASE + 9)
+        d = DenseCrdt("mm", N, wall_clock=cd)
+        m = MapCrdt("mm", wall_clock=cm)
+        d.merge_json(wire)
+        m.merge_json(wire, key_decoder=int)
+        assert cd.reads == cm.reads
+        assert (d.canonical_time.logical_time
+                == m.canonical_time.logical_time)
+        # Empty payloads too (routes through merge_many([])).
+        d.merge_json("{}")
+        m.merge_json("{}")
+        assert cd.reads == cm.reads
+        assert (d.canonical_time.logical_time
+                == m.canonical_time.logical_time)
+
+    def test_reject_leaves_clock_untouched(self):
+        # ADVICE r4: a value_width=32 replica rejecting an out-of-range
+        # record must NOT have advanced its canonical clock first.
+        from crdt_tpu import Hlc, Record
+        d = DenseCrdt("dd", N, wall_clock=FakeClock(start=BASE),
+                      value_width=32)
+        before = d.canonical_time
+        h = Hlc(BASE + 10, 0, "peer")
+        with pytest.raises(ValueError, match="int32"):
+            d.merge_records({3: Record(h, 2 ** 40, h)})
+        assert d.canonical_time == before and len(d) == 0
+        # Same for a non-int payload on the wire path.
+        from crdt_tpu import MapCrdt
+        m = MapCrdt("mm", wall_clock=FakeClock(start=BASE))
+        m.put(1, "text")
+        d64 = DenseCrdt("dd", N, wall_clock=FakeClock(start=BASE))
+        before = d64.canonical_time
+        with pytest.raises(TypeError):
+            d64.merge_json(m.to_json())
+        assert d64.canonical_time == before and len(d64) == 0
+        # And out-of-range slots.
+        src = DenseCrdt("src", N + 64, wall_clock=FakeClock(start=BASE))
+        src.put_batch([N + 3], [1])
+        before = d64.canonical_time
+        with pytest.raises(IndexError):
+            d64.merge_json(src.to_json())
+        assert d64.canonical_time == before and len(d64) == 0
+
+    def test_watch_events_on_merge_json(self):
+        src = DenseCrdt("src", N, wall_clock=FakeClock(start=BASE + 5))
+        src.put_batch([2, 7], [20, 70])
+        src.delete_batch([7])
+        d = make("dd")
+        whole = d.watch().record()
+        keyed = d.watch(slot=7).record()
+        d.merge_json(src.to_json())
+        assert sorted(whole.events) == [(2, 20), (7, None)]
+        assert keyed.events == [(7, None)]
+
+    @pytest.mark.parametrize("seed", range(3))
+    def test_fuzz_merge_json_matches_oracle(self, seed):
+        # Random multi-writer wire payloads (colliding millis, counter
+        # ties, tombstones) ingested via the columnar path must leave
+        # record-level state AND canonical identical to MapCrdt.
+        import random
+        from crdt_tpu import MapCrdt
+        rng = random.Random(seed)
+        writers = []
+        for nid in ("aa", "zz", "ba"):
+            w = DenseCrdt(nid, N,
+                          wall_clock=FakeClock(start=BASE + rng.randrange(5)))
+            for _ in range(rng.randrange(1, 4)):
+                slots = sorted(rng.sample(range(N), rng.randrange(1, 12)))
+                if rng.random() < 0.3:
+                    w.delete_batch(slots)
+                else:
+                    w.put_batch(slots,
+                                [rng.randrange(1000) for _ in slots])
+            writers.append(w)
+        d = DenseCrdt("hub", N, wall_clock=FakeClock(start=BASE + 40))
+        m = MapCrdt("hub", wall_clock=FakeClock(start=BASE + 40))
+        for w in writers:
+            wire = w.to_json()
+            d.merge_json(wire)
+            m.merge_json(wire, key_decoder=int)
+        assert (d.canonical_time.logical_time
+                == m.canonical_time.logical_time)
+        dm, mm = d.record_map(), m.record_map()
+        assert set(dm) == set(mm)
+        for k in dm:
+            assert dm[k].hlc == mm[k].hlc and dm[k].value == mm[k].value
+
+
+def test_bool_values_rejected_on_merge():
+    # bool is an int subclass; storing it as 0/1 under the peer's hlc
+    # would diverge forever (re-export says 1 where the peer says
+    # true). Both ingest paths must reject it.
+    from crdt_tpu import Hlc, MapCrdt, Record
+    h = Hlc(BASE + 5, 0, "peer")
+    d = make("dd")
+    with pytest.raises(TypeError, match="bool"):
+        d.merge_records({3: Record(h, True, h)})
+    assert len(d) == 0
+    m = MapCrdt("mm", wall_clock=FakeClock(start=BASE))
+    m.put(1, True)
+    d2 = make("dd")
+    with pytest.raises(TypeError, match="bool"):
+        d2.merge_json(m.to_json())
+    assert len(d2) == 0

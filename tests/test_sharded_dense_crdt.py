@@ -275,3 +275,194 @@ class TestGuardParity:
         assert (sharded.canonical_time.logical_time
                 == plain.canonical_time.logical_time)
         assert_occupied_lanes_equal(sharded, plain)
+
+
+class TestShardedPallas:
+    """ShardedDenseCrdt(executor="pallas-interpret") — the Mosaic
+    kernel running PER SHARD inside the shard_map collective step
+    (parallel.fanin._pallas_fanin_block). Must be lane-exact against
+    both the XLA sharded step and the single-device model."""
+
+    BASE = BASE + 500
+
+    def _n(self, k_shards):
+        from crdt_tpu.ops.pallas_merge import TILE
+        return TILE * k_shards
+
+    def _writers(self, n, seed):
+        import random
+        rng = random.Random(seed)
+        pool = ["aa", "az", "ba", "ca", "na", "pa", "za", "zz"]
+        rng.shuffle(pool)
+        writers = []
+        for nid in pool[:5]:
+            w = DenseCrdt(nid, n,
+                          wall_clock=FakeClock(start=BASE + rng.randrange(40)))
+            for _ in range(rng.randrange(1, 3)):
+                slots = sorted(rng.sample(range(n), rng.randrange(1, 40)))
+                if rng.random() < 0.3:
+                    w.delete_batch(slots)
+                else:
+                    w.put_batch(slots, [rng.randrange(100) for _ in slots])
+            writers.append(w)
+        return writers, rng
+
+    @pytest.mark.parametrize("mesh_shape", [(2, 4), (4, 2), (8, 1)])
+    @pytest.mark.parametrize("seed", range(2))
+    def test_fuzz_pallas_vs_plain(self, mesh_shape, seed):
+        mesh = make_fanin_mesh(*mesh_shape)
+        n = self._n(mesh_shape[1])
+        sharded = ShardedDenseCrdt("mm", n, mesh,
+                                   wall_clock=FakeClock(start=self.BASE),
+                                   executor="pallas-interpret")
+        plain = DenseCrdt("mm", n, wall_clock=FakeClock(start=self.BASE))
+        writers, rng = self._writers(n, seed * 31 + hash(mesh_shape) % 997)
+        half = rng.randrange(1, len(writers))
+        for group in (writers[:half], writers[half:]):
+            deltas = [w.export_delta() for w in group]
+            sharded.merge_many(list(deltas))
+            plain.merge_many(list(deltas))
+        assert (sharded.canonical_time.logical_time
+                == plain.canonical_time.logical_time)
+        assert sharded.stats.records_adopted == plain.stats.records_adopted
+        assert_occupied_lanes_equal(sharded, plain)
+        # modified lanes too: the pallas block re-stamps winners with
+        # the GLOBAL canonical outside the kernel — must match exactly
+        occ = np.asarray(sharded.store.occupied)
+        np.testing.assert_array_equal(
+            np.asarray(sharded.store.mod_lt)[occ],
+            np.asarray(plain.store.mod_lt)[occ])
+
+    def test_matches_xla_sharded_executor(self):
+        mesh = make_fanin_mesh(2, 4)
+        n = self._n(4)
+        ours = ShardedDenseCrdt("hub", n, mesh,
+                                wall_clock=FakeClock(start=self.BASE),
+                                executor="pallas-interpret")
+        xla = ShardedDenseCrdt("hub", n, mesh,
+                               wall_clock=FakeClock(start=self.BASE),
+                               executor="xla")
+        writers, _ = self._writers(n, 17)
+        deltas = [w.export_delta() for w in writers]
+        ours.merge_many(list(deltas))
+        xla.merge_many(list(deltas))
+        assert_occupied_lanes_equal(ours, xla)
+        assert ours.canonical_time == xla.canonical_time
+
+    def test_multislice_pallas(self):
+        from crdt_tpu.parallel import make_multislice_fanin_mesh
+        mesh = make_multislice_fanin_mesh(2, 2, 2)
+        n = self._n(2)
+        sharded = ShardedDenseCrdt("ns", n, mesh,
+                                   wall_clock=FakeClock(start=BASE),
+                                   executor="pallas-interpret")
+        plain = DenseCrdt("ns", n, wall_clock=FakeClock(start=BASE))
+        peer = DenseCrdt("peer", n, wall_clock=FakeClock(start=BASE + 3))
+        peer.put_batch([0, 3, n - 1], [5, 6, 7])
+        peer.delete_batch([3])
+        delta = peer.export_delta()
+        sharded.merge_many([delta])
+        plain.merge_many([delta])
+        assert_occupied_lanes_equal(sharded, plain)
+        assert sharded.canonical_time == plain.canonical_time
+
+    def test_guard_payload_parity(self):
+        # The pallas block's flags are the closed-form optimistic
+        # superset; a real trip must still raise with the sequential
+        # first-offender payload (exact host recompute).
+        mesh = make_fanin_mesh(2, 4)
+        n = self._n(4)
+        sharded = ShardedDenseCrdt("na", n, mesh,
+                                   wall_clock=FakeClock(start=BASE),
+                                   executor="pallas-interpret")
+        plain = DenseCrdt("na", n, wall_clock=FakeClock(start=BASE))
+        other = DenseCrdt("na", n, wall_clock=FakeClock(start=BASE + 50))
+        other.put_batch([3], [1])
+        delta = other.export_delta()
+        errs = []
+        for hub in (sharded, plain):
+            with pytest.raises(DuplicateNodeException) as ei:
+                hub.merge(*delta)
+            errs.append(ei.value)
+        assert errs[0].args == errs[1].args
+        assert (sharded.canonical_time.logical_time
+                == plain.canonical_time.logical_time)
+
+    def test_false_positive_cleared(self):
+        # A local-node record shielded by an earlier larger-lt record
+        # flags in the closed-form bound (it ignores shielding) but
+        # must be cleared by the exact recompute — merge proceeds.
+        import jax.numpy as jnp
+        from crdt_tpu.ops.dense import DenseChangeset
+        mesh = make_fanin_mesh(2, 4)
+        n = self._n(4)
+        sharded = ShardedDenseCrdt("m", n, mesh,
+                                   wall_clock=FakeClock(start=BASE + 99),
+                                   executor="pallas-interpret")
+        plain = DenseCrdt("m", n, wall_clock=FakeClock(start=BASE + 99))
+        lanes = {f: np.zeros((2, n), d) for f, d in
+                 (("lt", np.int64), ("node", np.int32), ("val", np.int64),
+                  ("tomb", bool), ("valid", bool))}
+        lanes["lt"][0, 0] = (BASE + 50) << 16
+        lanes["node"][0, 0] = 0
+        lanes["val"][0, 0] = 1
+        lanes["valid"][0, 0] = True
+        lanes["lt"][1, 0] = (BASE + 10) << 16
+        lanes["node"][1, 0] = 1
+        lanes["val"][1, 0] = 2
+        lanes["valid"][1, 0] = True
+        for hub in (sharded, plain):
+            cs = DenseChangeset(**{f: jnp.asarray(v)
+                                   for f, v in lanes.items()})
+            hub.merge(cs, ["zz", "m"])
+            assert hub.get(0) == 1
+        assert_occupied_lanes_equal(sharded, plain)
+
+    def test_watch_events_on_pallas_sharded_merge(self):
+        mesh = make_fanin_mesh(2, 4)
+        n = self._n(4)
+        hub = ShardedDenseCrdt("hub", n, mesh,
+                               wall_clock=FakeClock(start=BASE),
+                               executor="pallas-interpret")
+        w = DenseCrdt("w", n, wall_clock=FakeClock(start=BASE + 3))
+        w.put_batch([1, 9, n - 2], [11, 99, 333])
+        w.delete_batch([9])
+        s = hub.watch().record()
+        hub.merge(*w.export_delta())
+        assert s.events == [(1, 11), (9, None), (n - 2, 333)]
+
+    def test_misaligned_forced_pallas_rejected(self):
+        mesh = make_fanin_mesh(2, 4)
+        with pytest.raises(ValueError, match="key shards"):
+            ShardedDenseCrdt("x", self._n(4) + 4, mesh,
+                             executor="pallas-interpret")
+
+    def test_auto_stays_xla_off_tpu(self):
+        # "auto" on the CPU virtual mesh must keep the XLA fold even
+        # at aligned capacity (Mosaic lowers on TPU only; interpret is
+        # opt-in via executor=); forced modes route to the kernel.
+        mesh = make_fanin_mesh(2, 4)
+        n = self._n(4)
+        auto = ShardedDenseCrdt("a", n, mesh,
+                                wall_clock=FakeClock(start=BASE))
+        assert not auto._use_pallas_sharded()
+        forced = ShardedDenseCrdt("a", n, mesh,
+                                  wall_clock=FakeClock(start=BASE),
+                                  executor="pallas-interpret")
+        assert forced._use_pallas_sharded()
+
+    def test_value_width_32_masks_overflow(self):
+        # value_width=32 on the sharded-pallas route: merge_many's
+        # generic branch masks out-of-range records BEFORE dispatch,
+        # so the kernel never adopts them and the model raises.
+        mesh = make_fanin_mesh(2, 4)
+        n = self._n(4)
+        hub = ShardedDenseCrdt("hub", n, mesh,
+                               wall_clock=FakeClock(start=BASE),
+                               executor="pallas-interpret",
+                               value_width=32)
+        w = DenseCrdt("w", n, wall_clock=FakeClock(start=BASE + 3))
+        w.put_batch([0, 1], [5, 2 ** 40])
+        with pytest.raises(ValueError, match="int32"):
+            hub.merge(*w.export_delta())
+        assert hub.get(0) is None and hub.get(1) is None
