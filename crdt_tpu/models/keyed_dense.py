@@ -39,6 +39,14 @@ class KeyedDenseCrdt(Crdt[K, int]):
     wrapped model's ``n_slots`` (grow the dense model for more). The
     adapter emits the wrapped model's change events re-keyed, so
     `watch` filters by KEY, not slot.
+
+    Caveat (mixing surfaces): slots written through the raw ``.dense``
+    surface that this adapter never interned surface in
+    `record_map`/`map`/watch events keyed by their int slot index.
+    An adapter whose USER keys are ints can therefore collide with
+    those raw-slot keys (user key ``5`` vs raw slot ``5`` are
+    indistinguishable in a dict). Use int user keys or raw ``.dense``
+    writes — not both on one adapter.
     """
 
     def __init__(self, dense: DenseCrdt):
@@ -82,9 +90,14 @@ class KeyedDenseCrdt(Crdt[K, int]):
         if slot is None:
             slot = len(self._slot_keys)
             if slot >= self._dense.n_slots:
-                raise IndexError(
-                    f"adapter is out of slots ({self._dense.n_slots}); "
-                    "grow() the dense model first")
+                # The reference map grows without bound
+                # (map_crdt.dart:10); mirror it by doubling the dense
+                # capacity. Doubling preserves tile alignment and mesh
+                # key-shard divisibility, and the dense `grow()`
+                # revalidates both for forced executors — a failure
+                # there surfaces as its descriptive ValueError rather
+                # than a hard capacity wall here.
+                self._dense.grow(max(self._dense.n_slots * 2, 1))
             self._key_to_slot[key] = slot
             self._slot_keys.append(key)
         return slot

@@ -64,12 +64,20 @@ def load() -> Optional[object]:
             # upgrades don't accumulate .so files without bound.
             # Unlinking a file another process has dlopen'd is safe on
             # POSIX (the mapping holds the inode); best-effort only.
+            # Only reap AGED files: two long-lived processes running
+            # different source versions would otherwise delete each
+            # other's fresh binary and recompile on every load
+            # (load-after-unlink is the unsafe half).
+            import time
+            cutoff = time.time() - 24 * 3600
             for name in os.listdir(here):
                 if (name.startswith("_hlccodec_")
                         and name.endswith(suffix)
                         and name != os.path.basename(so)):
                     try:
-                        os.unlink(os.path.join(here, name))
+                        path = os.path.join(here, name)
+                        if os.path.getmtime(path) < cutoff:
+                            os.unlink(path)
                     except OSError:
                         pass
         spec = importlib.util.spec_from_file_location(

@@ -207,6 +207,11 @@ def send_step(lt: jax.Array, wall_millis: jax.Array):
     ms = lt >> SHIFT
     stay = ms >= wall_millis
     overflow = stay & ((lt & MAX_COUNTER) == MAX_COUNTER)
-    new_lt = jnp.where(stay, lt + 1, wall_millis << SHIFT)
+    # Clamp on overflow: lt + 1 would carry into the millis field
+    # (millis+1, counter 0) and thread a wrapped canonical through the
+    # rest of a pipelined window — the host path raises WITHOUT
+    # mutating, so the flushed clock must match what it leaves behind.
+    new_lt = jnp.where(overflow, lt,
+                       jnp.where(stay, lt + 1, wall_millis << SHIFT))
     drift = ms - wall_millis > MAX_DRIFT
     return new_lt, overflow, drift

@@ -26,6 +26,15 @@ class ChangeEvent(NamedTuple):
 _ANY_KEY = object()   # sentinel: stream not filtered to a single key
 
 
+class _EventBatch(NamedTuple):
+    """A recorded batch held UNMATERIALIZED in a stream buffer: a 1M
+    merge with a recording subscriber must not allocate 1M ChangeEvent
+    objects on the merge path — `events` expands batches on read
+    (inspection-time cost, not merge-time)."""
+    keys: Any
+    values: Any
+
+
 class ChangeStream:
     """A filtered view over a :class:`ChangeHub`.
 
@@ -59,12 +68,15 @@ class ChangeStream:
             token[0](event)
 
     def _emit_many(self, keys, values) -> None:
-        """Batch emission: an unfiltered recording-only stream extends
-        its buffer in one C-level pass (no per-event Python); anything
-        with a predicate or callbacks takes the per-event path."""
+        """Batch emission: an unfiltered recording-only stream appends
+        ONE batch marker (zero per-event work on the merge path; the
+        `events` read expands it); anything with a predicate or
+        callbacks takes the per-event path. Batches are retained by
+        reference — the `ChangeHub.add_batch` contract requires
+        callers to hand over snapshots they will not mutate."""
         if self._predicate is None and not self._callbacks:
             if self._recording:
-                self._buffer.extend(map(ChangeEvent, keys, values))
+                self._buffer.append(_EventBatch(keys, values))
             return
         for k, v in zip(keys, values):
             self._emit(ChangeEvent(k, v))
@@ -95,7 +107,13 @@ class ChangeStream:
 
     @property
     def events(self) -> List[ChangeEvent]:
-        return list(self._buffer)
+        out: List[ChangeEvent] = []
+        for item in self._buffer:
+            if type(item) is _EventBatch:
+                out.extend(map(ChangeEvent, item.keys, item.values))
+            else:
+                out.append(item)
+        return out
 
     def where(self, predicate: Callable[[ChangeEvent], bool]
               ) -> "ChangeStream":
@@ -242,7 +260,13 @@ class ChangeHub:
         ``get`` answers a key AT MOST ONCE per batch; callers whose
         batch may repeat a key (raw slot arrays, not dict-keyed
         payloads) must pass ``get=None`` so keyed streams see every
-        occurrence like everyone else."""
+        occurrence like everyone else.
+
+        Ownership: materialized ``(keys, values)`` may be RETAINED by
+        recording streams (expanded lazily on ``events`` reads) —
+        callers hand over snapshots they will not mutate afterwards
+        (every in-tree caller builds fresh lists or passes decode
+        products that are never written again)."""
         mat = None
         for stream in list(self._streams):
             if not (stream._recording or stream._callbacks):

@@ -157,3 +157,31 @@ def test_record_map_survives_raw_dense_writes():
     assert kc.map == {"x": 1, 10: 5}
     assert set(kc.record_map()) == {"x", 10}
     assert '"10"' in kc.to_json()
+
+
+def test_adapter_auto_grows_past_capacity():
+    """VERDICT r4 item 7: interning past n_slots grows the wrapped
+    model (map_crdt.dart:10's unbounded growth) instead of raising."""
+    kc = KeyedDenseCrdt(DenseCrdt("abc", 4, wall_clock=FakeClock()))
+    kc.put_all({f"k{i}": i for i in range(11)})   # 4 -> 8 -> 16 slots
+    assert kc.dense.n_slots == 16
+    assert kc.map == {f"k{i}": i for i in range(11)}
+    # Records kept their slots across the growth.
+    assert kc.get_record("k0").value == 0
+    # The pallas-forced executor keeps its tile alignment on growth.
+    from crdt_tpu.ops.pallas_merge import TILE
+    kp = KeyedDenseCrdt(DenseCrdt("abc", TILE, wall_clock=FakeClock(),
+                                  executor="pallas-interpret"))
+    kp.put_all({f"k{i}": 1 for i in range(TILE + 1)})
+    assert kp.dense.n_slots == 2 * TILE
+
+
+def test_adapter_growth_syncs_with_fixed_peer():
+    """A grown adapter still syncs with a peer at the original
+    capacity (narrower changesets pad on ingest)."""
+    from crdt_tpu.models.dense_crdt import sync_dense
+    a = KeyedDenseCrdt(DenseCrdt("na", 2, wall_clock=FakeClock()))
+    b = DenseCrdt("nb", 8, wall_clock=FakeClock(start=1_700_000_000_050))
+    a.put_all({f"k{i}": i * 10 for i in range(5)})   # grows to 8
+    sync_dense(a.dense, b)
+    assert b.get(0) == 0 and b.get(4) == 40

@@ -92,3 +92,33 @@ class TestMergeStep:
         assert int(max_logical_time(store)) == h.logical_time
         mask = np.asarray(delta_mask(store, jnp.int64((BASE + 7) << 16)))
         assert mask[2] and mask.sum() == 1  # inclusive bound
+
+
+class TestSendStep:
+    """Device-side Hlc.send (`ops.merge.send_step`) — used by the
+    pipelined window's final bump."""
+
+    def test_counter_increments_and_millis_advances(self):
+        import jax.numpy as jnp
+        from crdt_tpu.hlc import SHIFT
+        from crdt_tpu.ops.merge import send_step
+        base = 1_700_000_000_000
+        lt, ovf, drift = send_step(jnp.int64(base << SHIFT),
+                                   jnp.int64(base))
+        assert int(lt) == (base << SHIFT) + 1 and not bool(ovf)
+        lt, ovf, drift = send_step(jnp.int64(base << SHIFT),
+                                   jnp.int64(base + 5))
+        assert int(lt) == (base + 5) << SHIFT and not bool(ovf)
+
+    def test_overflow_clamps_instead_of_wrapping(self):
+        # ADVICE r4: on counter overflow lt+1 would carry into the
+        # millis field; the host path raises WITHOUT mutating, so the
+        # device bump must leave the clock where the host would.
+        import jax.numpy as jnp
+        from crdt_tpu.hlc import MAX_COUNTER, SHIFT
+        from crdt_tpu.ops.merge import send_step
+        base = 1_700_000_000_000
+        full = (base << SHIFT) | MAX_COUNTER
+        lt, ovf, drift = send_step(jnp.int64(full), jnp.int64(base))
+        assert bool(ovf)
+        assert int(lt) == full          # clamped, not millis+1
