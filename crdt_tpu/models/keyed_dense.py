@@ -35,8 +35,9 @@ class KeyedDenseCrdt(Crdt[K, int]):
     >>> kc.put("x", 1); kc.map
     {'x': 1}
 
-    Key→slot interning is first-come sequential; capacity is the
-    wrapped model's ``n_slots`` (grow the dense model for more). The
+    Key→slot interning is first-come sequential; interning past the
+    wrapped model's ``n_slots`` auto-grows it by doubling (the
+    reference map's unbounded growth, map_crdt.dart:10). The
     adapter emits the wrapped model's change events re-keyed, so
     `watch` filters by KEY, not slot.
 

@@ -37,7 +37,8 @@ from .dense import (DenseStore, DenseChangeset, FaninResult,
 from .pallas_merge import (SplitStore, SplitChangeset, PallasFaninResult,
                            pallas_fanin_batch, pallas_fanin_step,
                            pallas_fanin_stream, split_store,
-                           split_changeset, join_store, TILE)
+                           split_changeset, join_store, tile_changeset,
+                           TILE)
 
 __all__ = [
     "NodeTable", "pack_logical_time", "unpack_logical_time",
@@ -48,5 +49,6 @@ __all__ = [
     "dense_max_logical_time", "store_to_changeset",
     "SplitStore", "SplitChangeset", "PallasFaninResult",
     "pallas_fanin_batch", "pallas_fanin_step", "pallas_fanin_stream",
-    "split_store", "split_changeset", "join_store", "TILE",
+    "split_store", "split_changeset", "join_store", "tile_changeset",
+    "TILE",
 ]

@@ -229,17 +229,27 @@ def _fanin_stream_kernel(exact_guards, advance_clock, narrow_val,
          st_mhi, st_mlo, st_mnode,
          o_hi, o_lo, o_node, o_vhi, o_vlo, o_tomb,
          o_mhi, o_mlo, o_mnode,
-         win_ref, dup_ref, drift_ref) = refs
+         win_ref, dup_ref, drift_ref, *acc_refs) = refs
     else:
         (cs_hi, cs_lo, cs_node, cs_vhi, cs_vlo, cs_tomb,
          st_hi, st_lo, st_node, st_vhi, st_vlo, st_tomb,
          st_mhi, st_mlo, st_mnode,
          o_hi, o_lo, o_node, o_vhi, o_vlo, o_tomb,
          o_mhi, o_mlo, o_mnode,
-         win_ref, dup_ref, drift_ref) = refs
+         win_ref, dup_ref, drift_ref, *acc_refs) = refs
+    if not advance_clock:
+        # Batch-only vector accumulators (unused outputs are not free:
+        # three extra resident blocks measurably slowed the stream).
+        bm_hi_ref, bm_lo_ref, dupacc_ref = acc_refs
     rb = pl.program_id(0)
     c = pl.program_id(1)
     first = c == 0
+
+    @pl.when((rb == 0) & first)
+    def _init():
+        # Before ANY accumulation below (grid steps run in order).
+        dup_ref[0, 0] = jnp.int32(0)
+        drift_ref[0, 0] = jnp.int32(0)
 
     canon_hi = scalars_ref[0]
     canon_lo = scalars_ref[1].astype(jnp.uint32)
@@ -257,10 +267,10 @@ def _fanin_stream_kernel(exact_guards, advance_clock, narrow_val,
         # (= canon_0 at c == 0).
         nc_hi, nc_lo = _max64(canon_hi, canon_lo,
                               *_add_off64(bmax_hi, bmax_lo, off))
-    else:
-        # One logical merge: every chunk stamps winners with the
-        # union-final canonical (ops.dense.fanin_stream semantics).
-        nc_hi, nc_lo = _max64(canon_hi, canon_lo, bmax_hi, bmax_lo)
+    # else (batch): the union-final canonical is computed FROM this
+    # kernel's own basemax output; winners' modified lanes are stamped
+    # by the wrapper's cheap post-pass (the kernel carries the store's
+    # mod lanes through untouched).
 
     b_hi = jnp.where(first, st_hi[...], o_hi[...])
     b_lo = jnp.where(first, st_lo[...], o_lo[...])
@@ -283,49 +293,129 @@ def _fanin_stream_kernel(exact_guards, advance_clock, narrow_val,
         acc_dup = jnp.zeros(b_hi.shape, jnp.int32)
         acc_drift = jnp.zeros(b_hi.shape, jnp.int32)
 
-    for r in range(cs_hi.shape[0]):  # static unroll over replica rows
-        hi0 = cs_hi[r]
-        lo0 = cs_lo[r]
-        # Narrow wire lanes widen on load: compares are int32 either
-        # way, so (lt, node) semantics are untouched.
-        node = cs_node[r].astype(jnp.int32)
-        if advance_clock:
-            # Advance the chunk clock on real lanes only: the NEG
-            # sentinel must stay the unique minimum (its lo is 0, so a
-            # masked offset also never carries into hi).
-            lo = lo0 + jnp.where(hi0 == NEG_HI, jnp.uint32(0), off)
-            hi = hi0 + (lo < lo0).astype(jnp.int32)
-        else:
-            hi, lo = hi0, lo0
+    if advance_clock:
+        # Sequential row walk. Exact guards NEED the running cummax
+        # chain; the fast-guard replay stream ALSO keeps the chain —
+        # its cs block is VMEM-resident across chunks (compute-bound),
+        # and there the chain's smaller live set beats the tournament's
+        # ILP (measured 72 vs 57 B merges/s on the stream row).
+        for r in range(cs_hi.shape[0]):
+            hi0 = cs_hi[r]
+            lo0 = cs_lo[r]
+            # Narrow wire lanes widen on load: compares are int32
+            # either way, so (lt, node) semantics are untouched.
+            node = cs_node[r].astype(jnp.int32)
+            if advance_clock:
+                # Advance the chunk clock on real lanes only: the NEG
+                # sentinel must stay the unique minimum (its lo is 0,
+                # so a masked offset also never carries into hi).
+                lo = lo0 + jnp.where(hi0 == NEG_HI, jnp.uint32(0), off)
+                hi = hi0 + (lo < lo0).astype(jnp.int32)
+            else:
+                hi, lo = hi0, lo0
 
-        if exact_guards:
-            slow = _lex_gt(hi, lo, jnp.int32(0),
-                           run_hi, run_lo, jnp.int32(0))
-            dup = slow & (node == local_node)
-            drift = (slow & ~dup &
-                     _lex_gt(hi, lo, jnp.int32(0),
-                             thresh_hi, thresh_lo, jnp.int32(0)))
-            acc_dup = acc_dup | dup.astype(jnp.int32)
-            acc_drift = acc_drift | drift.astype(jnp.int32)
-            run_hi = jnp.where(slow, hi, run_hi)
-            run_lo = jnp.where(slow, lo, run_lo)
+            if exact_guards:
+                slow = _lex_gt(hi, lo, jnp.int32(0),
+                               run_hi, run_lo, jnp.int32(0))
+                dup = slow & (node == local_node)
+                drift = (slow & ~dup &
+                         _lex_gt(hi, lo, jnp.int32(0),
+                                 thresh_hi, thresh_lo, jnp.int32(0)))
+                acc_dup = acc_dup | dup.astype(jnp.int32)
+                acc_drift = acc_drift | drift.astype(jnp.int32)
+                run_hi = jnp.where(slow, hi, run_hi)
+                run_lo = jnp.where(slow, lo, run_lo)
 
-        gt = _lex_gt(hi, lo, node, b_hi, b_lo, b_node)
-        b_hi = jnp.where(gt, hi, b_hi)
-        b_lo = jnp.where(gt, lo, b_lo)
-        b_node = jnp.where(gt, node, b_node)
-        if narrow_val:
-            v = cs_v32[r]
-            # sign-extend into the store's 64-bit payload: hi word is
-            # the sign fill; lo word the int32 bits (signed->unsigned
-            # convert is modular in XLA, i.e. a bit-preserving wrap)
-            b_vhi = jnp.where(gt, v >> 31, b_vhi)
-            b_vlo = jnp.where(gt, v.astype(jnp.uint32), b_vlo)
-        else:
-            b_vhi = jnp.where(gt, cs_vhi[r], b_vhi)
-            b_vlo = jnp.where(gt, cs_vlo[r], b_vlo)
-        b_tomb = jnp.where(gt, cs_tomb[r].astype(jnp.int32), b_tomb)
+            gt = _lex_gt(hi, lo, node, b_hi, b_lo, b_node)
+            b_hi = jnp.where(gt, hi, b_hi)
+            b_lo = jnp.where(gt, lo, b_lo)
+            b_node = jnp.where(gt, node, b_node)
+            if narrow_val:
+                v = cs_v32[r]
+                # sign-extend into the store's 64-bit payload: hi word
+                # is the sign fill; lo word the int32 bits (signed->
+                # unsigned convert is modular, a bit-preserving wrap)
+                b_vhi = jnp.where(gt, v >> 31, b_vhi)
+                b_vlo = jnp.where(gt, v.astype(jnp.uint32), b_vlo)
+            else:
+                b_vhi = jnp.where(gt, cs_vhi[r], b_vhi)
+                b_vlo = jnp.where(gt, cs_vlo[r], b_vlo)
+            b_tomb = jnp.where(gt, cs_tomb[r].astype(jnp.int32), b_tomb)
+            win = win | gt
+    else:
+        # No in-kernel guard work: reduce the rows as a TOURNAMENT
+        # TREE instead of a sequential running-best chain. Same op
+        # count, but pair merges at each level are independent, so
+        # Mosaic can hide the whole VPU cost behind the DMA — measured
+        # 7.4 -> ~20 B merges/s on the distinct batch row (the
+        # same-layout pure-copy ceiling; docs/PERF.md round 5).
+        # Tie-break parity: pairs are (lower row, higher row) and the
+        # higher row wins only on STRICT (lt, node) greatership, so
+        # the lowest replica row survives ties at every level —
+        # exactly the sequential chain's stable order (associative,
+        # so the bracket shape doesn't matter).
+        items = []
+        dup_any = None
+        for r in range(cs_hi.shape[0]):
+            hi = cs_hi[r]
+            lo = cs_lo[r]
+            node = cs_node[r].astype(jnp.int32)
+            # Batch self-reduction (see below): dup candidates are
+            # local-node records above the pre-merge canonical — the
+            # closed-form bound, evaluated while the rows are already
+            # VMEM-resident instead of as a separate XLA sweep over
+            # the whole changeset. Accumulated as a VECTOR mask
+            # (elementwise OR per row) — per-row scalar reduces
+            # measurably stall the VPU.
+            row_dup = ((node == local_node) &
+                       _lex_gt(hi, lo, jnp.int32(0),
+                               canon_hi, canon_lo, jnp.int32(0)))
+            dup_any = (row_dup if dup_any is None
+                       else dup_any | row_dup)
+            if narrow_val:
+                v = cs_v32[r]
+                vhi, vlo = v >> 31, v.astype(jnp.uint32)
+            else:
+                vhi, vlo = cs_vhi[r], cs_vlo[r]
+            items.append((hi, lo, node, vhi, vlo,
+                          cs_tomb[r].astype(jnp.int32)))
+        while len(items) > 1:
+            nxt = []
+            for i in range(0, len(items) - 1, 2):
+                a, b = items[i], items[i + 1]
+                gt = _lex_gt(b[0], b[1], b[2], a[0], a[1], a[2])
+                nxt.append(tuple(jnp.where(gt, bb, aa)
+                                 for aa, bb in zip(a, b)))
+            if len(items) % 2:
+                nxt.append(items[-1])
+            items = nxt
+        w = items[0]
+        gt = _lex_gt(w[0], w[1], w[2], b_hi, b_lo, b_node)
+        b_hi = jnp.where(gt, w[0], b_hi)
+        b_lo = jnp.where(gt, w[1], b_lo)
+        b_node = jnp.where(gt, w[2], b_node)
+        b_vhi = jnp.where(gt, w[3], b_vhi)
+        b_vlo = jnp.where(gt, w[4], b_vlo)
+        b_tomb = jnp.where(gt, w[5], b_tomb)
         win = win | gt
+        # The canonical/flag reductions fold INTO this kernel (the
+        # rows are right here in VMEM; the XLA sweeps they replace
+        # cost more than the whole join) — but as ELEMENTWISE vector
+        # accumulators, never in-kernel cross-lane reduces (those
+        # stall the VPU measurably; the wrapper reduces the one
+        # accumulated block in XLA):
+        # - dupacc: per-position OR of the dup-candidate masks;
+        # - bm: per-position (hi, lo) max64 of the per-key winners
+        #   across grid steps.
+        init = (rb == 0) & first
+        prev_hi = jnp.where(init, jnp.int32(NEG_HI), bm_hi_ref[...])
+        prev_lo = jnp.where(init, jnp.uint32(0), bm_lo_ref[...])
+        take = ((w[0] > prev_hi) |
+                ((w[0] == prev_hi) & (w[1] > prev_lo)))
+        bm_hi_ref[...] = jnp.where(take, w[0], prev_hi)
+        bm_lo_ref[...] = jnp.where(take, w[1], prev_lo)
+        prev_dup = jnp.where(init, jnp.int32(0), dupacc_ref[...])
+        dupacc_ref[...] = prev_dup | dup_any.astype(jnp.int32)
 
     o_hi[...] = b_hi
     o_lo[...] = b_lo
@@ -336,15 +426,16 @@ def _fanin_stream_kernel(exact_guards, advance_clock, narrow_val,
     m_hi = jnp.where(first, st_mhi[...], o_mhi[...])
     m_lo = jnp.where(first, st_mlo[...], o_mlo[...])
     m_node = jnp.where(first, st_mnode[...], o_mnode[...])
-    o_mhi[...] = jnp.where(win, nc_hi, m_hi)
-    o_mlo[...] = jnp.where(win, nc_lo, m_lo)
-    o_mnode[...] = jnp.where(win, local_node, m_node)
+    if advance_clock:
+        o_mhi[...] = jnp.where(win, nc_hi, m_hi)
+        o_mlo[...] = jnp.where(win, nc_lo, m_lo)
+        o_mnode[...] = jnp.where(win, local_node, m_node)
+    else:
+        # Batch: stamped post-kernel (nc needs this kernel's basemax).
+        o_mhi[...] = m_hi
+        o_mlo[...] = m_lo
+        o_mnode[...] = m_node
     win_ref[...] = win_prev | win.astype(jnp.int32)
-
-    @pl.when((rb == 0) & first)
-    def _init():
-        dup_ref[0, 0] = jnp.int32(0)
-        drift_ref[0, 0] = jnp.int32(0)
 
     if exact_guards:
         dup_ref[0, 0] = dup_ref[0, 0] | jnp.max(acc_dup)
@@ -372,6 +463,29 @@ _STREAM_LANE = 1024
 
 def _stream_tile_lane(n: int) -> int:
     return _STREAM_LANE if n % (_SB * _STREAM_LANE) == 0 else _LANE
+
+
+def tile_changeset(scs, lane: int = _LANE):
+    """Pre-tile split wire lanes to the kernel's resident
+    ``(r, n//lane, lane)`` layout. A TPU reshape across tile
+    boundaries is a physical relayout copy (~2.4 GB for the 1M×128
+    batch — comparable to the join's own HBM traffic, measured ~7 ms
+    of the old 15 ms call); batches that LIVE in HBM between merges
+    should be stored pre-tiled so each merge doesn't re-pay it. 2-D
+    lanes remain accepted by every kernel wrapper (the reshape then
+    happens in-jit, where it can fuse with a producing split)."""
+    r, n = scs.hi.shape
+    if n % (_SB * lane):
+        raise ValueError(f"n={n} not tileable at lane={lane}")
+    return type(scs)(*(l.reshape(r, n // lane, lane) for l in scs))
+
+
+def _cs_shape(cs) -> Tuple[int, int]:
+    """(r, n) for 2-D or pre-tiled 3-D changeset lanes."""
+    if cs.hi.ndim == 3:
+        r, rows, lane = cs.hi.shape
+        return r, rows * lane
+    return cs.hi.shape
 
 
 @partial(jax.jit, static_argnames=("interpret",))
@@ -426,7 +540,7 @@ def pallas_fanin_stream(store: SplitStore, cs: SplitChangeset,
     ``win`` is the OR across chunks (slots adopted at least once);
     ``new_canonical`` is the post-final-chunk canonical time.
     """
-    r, n = cs.hi.shape
+    r, n = _cs_shape(cs)
     if n % TILE:  # ValueError, not assert: must survive `python -O`
         raise ValueError(f"n_slots={n} not a multiple of TILE={TILE}")
     if not 0 < n_chunks < (1 << 15):  # c << 16 must fit int32
@@ -512,7 +626,7 @@ def _launch_stream_grid(exact_guards, advance_clock, store, cs,
     scalar stack, block specs, reshapes, out shapes, store aliasing.
     The two wrappers differ only in the kernel's static flags, the
     changeset block geometry/index map, and the tile lane width."""
-    r, n = cs.hi.shape
+    r, n = _cs_shape(cs)
     rows = n // lane
     canon_hi, canon_lo = _split64(canonical_lt)
     thresh_hi, thresh_lo = _split64(
@@ -531,13 +645,31 @@ def _launch_stream_grid(exact_guards, advance_clock, store, cs,
                              memory_space=pltpu.SMEM)
 
     st2d = [ln.reshape(rows, lane) for ln in store]
-    cs3d = [ln.reshape(r, rows, lane) for ln in cs]
+    if cs.hi.ndim == 3 and cs.hi.shape[2] == lane:
+        cs3d = list(cs)    # pre-tiled: no per-call relayout copy
+    else:
+        if cs.hi.ndim == 3:   # tiled at another lane width: flatten
+            cs = type(cs)(*(ln.reshape(r, n) for ln in cs))
+        cs3d = [ln.reshape(r, rows, lane) for ln in cs]
 
     out_shapes = (
         [jax.ShapeDtypeStruct((rows, lane), ln.dtype) for ln in st2d] +
         [jax.ShapeDtypeStruct((rows, lane), jnp.int32),   # win (OR)
          jax.ShapeDtypeStruct((1, 1), jnp.int32),         # any_dup
          jax.ShapeDtypeStruct((1, 1), jnp.int32)])        # any_drift
+    out_specs = [st_spec] * 9 + [st_spec, flag_spec, flag_spec]
+    if not advance_clock:
+        # Batch-mode vector accumulators: ONE (_SB, lane) block shared
+        # by every grid step (constant index map; TPU grids run
+        # sequentially). Batch-only — unused resident outputs are not
+        # free (three extra blocks measurably slowed the stream).
+        acc_spec = pl.BlockSpec((_SB, lane),
+                                lambda i, c: (_i32(0), _i32(0)),
+                                memory_space=pltpu.VMEM)
+        out_shapes += [jax.ShapeDtypeStruct((_SB, lane), jnp.int32),
+                       jax.ShapeDtypeStruct((_SB, lane), jnp.uint32),
+                       jax.ShapeDtypeStruct((_SB, lane), jnp.int32)]
+        out_specs += [acc_spec] * 3
 
     n_cs = len(cs3d)   # 6 wide lanes, 5 in value-ref (narrow) mode
     return pl.pallas_call(
@@ -547,7 +679,7 @@ def _launch_stream_grid(exact_guards, advance_clock, store, cs,
         in_specs=([pl.BlockSpec((7,), lambda i, c: (_i32(0),),
                                 memory_space=pltpu.SMEM)] +
                   [cs_spec] * n_cs + [st_spec] * 9),
-        out_specs=tuple([st_spec] * 9 + [st_spec, flag_spec, flag_spec]),
+        out_specs=tuple(out_specs),
         out_shape=tuple(out_shapes),
         input_output_aliases={1 + n_cs + j: j for j in range(9)},
         interpret=interpret,
@@ -603,7 +735,7 @@ def pallas_fanin_batch(store: SplitStore, cs: SplitChangeset,
 
     ``r`` must be a multiple of ``chunk_rows`` (pad with invalid rows)
     and ``n_slots`` a multiple of ``TILE``."""
-    r, n = cs.hi.shape
+    r, n = _cs_shape(cs)
     if n % TILE:  # ValueError, not assert: must survive `python -O`
         raise ValueError(f"n_slots={n} not a multiple of TILE={TILE}")
     if r % chunk_rows:
@@ -611,26 +743,46 @@ def pallas_fanin_batch(store: SplitStore, cs: SplitChangeset,
                          f"chunk_rows={chunk_rows} (pad with invalid rows)")
     n_chunks = r // chunk_rows
 
-    m_hi = jnp.max(cs.hi)
-    m_lo = jnp.max(jnp.where(cs.hi == m_hi, cs.lo, 0))
-    # Chunk c reads row group c — the block index map's only difference
-    # from the replay stream.
+    # No XLA pre-reductions: basemax and the dup bound come OUT of the
+    # kernel (the rows are resident in VMEM there anyway; separate XLA
+    # sweeps over the [R, N] lanes cost more than the whole join —
+    # docs/PERF.md round 5). Chunk c reads row group c — the block
+    # index map's only difference from the replay stream.
     outs = _launch_stream_grid(
         False, False, store, cs, canonical_lt, local_node, wall_millis,
-        m_hi, m_lo, cs_block_rows=chunk_rows,
+        jnp.int32(0), jnp.uint32(0), cs_block_rows=chunk_rows,
         cs_index_map=lambda i, c: (c, jnp.int32(i), jnp.int32(0)),
         n_chunks=n_chunks, interpret=interpret)
 
+    # Reduce the kernel's one accumulated (_SB, lane) block here in
+    # XLA (4096 elements — negligible next to the lanes themselves).
+    acc_hi, acc_lo, dupacc = outs[12], outs[13], outs[14]
+    bm_hi = jnp.max(acc_hi)
+    bm_lo = jnp.max(jnp.where(acc_hi == bm_hi, acc_lo, 0))
+    basemax = _join64(bm_hi, bm_lo)
     thresh = ((wall_millis + MAX_DRIFT) << SHIFT) | MAX_COUNTER
-    new_canonical = jnp.maximum(canonical_lt, _join64(m_hi, m_lo))
-    new_store = SplitStore(*(o.reshape(n) for o in outs[:9]))
+    new_canonical = jnp.maximum(canonical_lt, basemax)
+    win2d = outs[9]
+    # Winners' modified stamp as a cheap elementwise post-pass over the
+    # three mod lanes only (the kernel carried the store's through):
+    # nc wasn't known until the kernel's own basemax came back.
+    nc_hi, nc_lo = _split64(new_canonical)
+    winb = win2d > 0
+    mod_hi = jnp.where(winb, nc_hi, outs[6])
+    mod_lo = jnp.where(winb, nc_lo, outs[7])
+    mod_node = jnp.where(winb, local_node, outs[8])
+    new_store = SplitStore(*(
+        o.reshape(n) for o in
+        (outs[0], outs[1], outs[2], outs[3], outs[4], outs[5],
+         mod_hi, mod_lo, mod_node)))
 
     # Optimistic superset flags (no offsets, so the c=0 bound covers
-    # every chunk): a local-node record above the pre-merge canonical,
-    # or any record past the drift threshold.
+    # every chunk): a local-node record above the pre-merge canonical
+    # (OR-accumulated in-kernel), or any record past the drift
+    # threshold.
     return new_store, PallasFaninResult(
         new_canonical=new_canonical,
-        win=outs[9].reshape(n).astype(bool),
-        any_dup=_max_local_lt(cs, local_node) > canonical_lt,
-        any_drift=_join64(m_hi, m_lo) > thresh,
+        win=win2d.reshape(n).astype(bool),
+        any_dup=jnp.max(dupacc) > 0,
+        any_drift=basemax > thresh,
     )

@@ -448,8 +448,10 @@ def test_scatter_payload_rejects_non_int64_buffers(codec):
 
 def test_stale_so_siblings_reaped():
     """Content-hash .so naming must not accumulate one stale binary per
-    source update: a successful build unlinks siblings with a different
-    tag (the current one survives)."""
+    source update: a successful build unlinks AGED siblings with a
+    different tag (fresh ones are spared — two live processes on
+    different source versions must not delete each other's binaries
+    and recompile forever; ADVICE r4). The current tag survives."""
     import os
     import sysconfig
 
@@ -459,6 +461,13 @@ def test_stale_so_siblings_reaped():
     stale = os.path.join(here, f"_hlccodec_{'0' * 12}{suffix}")
     with open(stale, "wb") as f:
         f.write(b"not a real so")
+    two_days = 2 * 24 * 3600
+    import time as _time
+    old = _time.time() - two_days
+    os.utime(stale, (old, old))
+    fresh = os.path.join(here, f"_hlccodec_{'f' * 12}{suffix}")
+    with open(fresh, "wb") as f:
+        f.write(b"not a real so either")
     try:
         import importlib
 
@@ -473,12 +482,14 @@ def test_stale_so_siblings_reaped():
         try:
             mod2 = n2.load()
             assert mod2 is not None
-            assert not os.path.exists(stale)
+            assert not os.path.exists(stale)     # aged: reaped
+            assert os.path.exists(fresh)         # fresh: spared
         finally:
             importlib.reload(n2)
     finally:
-        if os.path.exists(stale):
-            os.unlink(stale)
+        for leftover in (stale, fresh):
+            if os.path.exists(leftover):
+                os.unlink(leftover)
 
 
 def test_decode_columns_deferred_item_curated_overflow(codec, monkeypatch):
