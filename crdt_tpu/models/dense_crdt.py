@@ -1130,22 +1130,42 @@ class DenseCrdt:
         flags — `_exact_guards` recomputes on a trip because the
         result carries no first-offender fields)."""
         from ..ops.pallas_merge import model_fanin_batch
-        cs = pad_replica_rows(cs, self.STREAM_CHUNK_ROWS)
+        r = cs.lt.shape[0]
+        chunk = self._kernel_chunk_rows(r)
+        if chunk < r:
+            cs = pad_replica_rows(cs, chunk)
         new_store, pres, seen, voverflow = model_fanin_batch(
             self._store, cs, canonical, local, jnp.int64(wall),
-            chunk_rows=self.STREAM_CHUNK_ROWS,
+            chunk_rows=chunk,
             interpret=self._executor == "pallas-interpret",
             value_width=self._value_width)
         self.stats.add_seen_lazy(seen)
         if self._value_width == 32:
             self._pending_val_overflow = voverflow
-        res = FaninResult(
+        return new_store, self._pallas_result(pres)
+
+    def _kernel_chunk_rows(self, r: int) -> int:
+        """Chunk sizing for the batch kernel: small changesets (the
+        common gossip delta) take ``chunk_rows=r`` and skip the row
+        padding entirely — the eager pad concatenate writes chunk_rows
+        full-width lanes (~24 ms for 8×1M on the proxied chip), more
+        than the whole merge. Cost: each distinct r ≤ 8 compiles its
+        own kernel once (bounded at 8 shapes; steady gossip reuses
+        one), which the padding saving repays within a handful of
+        merges."""
+        return r if r <= self.STREAM_CHUNK_ROWS else self.STREAM_CHUNK_ROWS
+
+    @staticmethod
+    def _pallas_result(pres) -> FaninResult:
+        """Adapt a `PallasFaninResult` (optimistic superset flags, no
+        first-offender fields) to the model-layer FaninResult shape —
+        `_exact_guards` recomputes on host when a flag trips."""
+        return FaninResult(
             new_canonical=pres.new_canonical,
             win_count=jnp.sum(pres.win).astype(jnp.int32),
             win=pres.win,
             any_bad=pres.any_dup | pres.any_drift,
             first_bad=None, first_is_dup=None, canonical_at_fail=None)
-        return new_store, res
 
     def _exact_guards(self, cs: DenseChangeset, res, wall: int):
         """Exact r-major sequential guard diagnostics (the visit order
@@ -1266,7 +1286,17 @@ class DenseCrdt:
 
         voverflow, self._pending_val_overflow = \
             self._pending_val_overflow, None
+        self._finish_merge(new_store, res, voverflow, wall, lambda: cs)
 
+    def _finish_merge(self, new_store, res, voverflow, wall: int,
+                      cs_for_exact: Callable[[], DenseChangeset]) -> None:
+        """Shared post-dispatch tail for changeset merges
+        (`merge_many` / `merge_split`): the pipelined accumulation OR
+        the one batched fetch + value-overflow reject + exact-guard
+        recompute + store swap + stats + watch + final send bump.
+        ``cs_for_exact`` lazily produces the WIDE changeset for the
+        failure-path guard recompute (pre-split callers only pay the
+        reconstruction when a flag actually trips)."""
         if self._pipe is not None:
             # Pipelined tail: nothing leaves the device. Guard flags
             # OR-accumulate; the canonical threads through the device
@@ -1309,6 +1339,7 @@ class DenseCrdt:
                 "replica (or payload-table indices) for such data")
 
         if bool(any_bad):
+            cs = cs_for_exact()
             exact = self._exact_guards(cs, res, wall)
             if exact is not None:
                 self._raise_guard(cs, exact, wall)
@@ -1322,6 +1353,97 @@ class DenseCrdt:
         self._canonical_time = Hlc.send(
             Hlc.from_logical_time(int(new_canonical), self._node_id),
             millis=self._wall_clock())
+
+    # --- pre-split interchange (the kernel wire form, round 5) ---
+
+    def export_split_delta(self, since: Optional[Hlc] = None,
+                           tiled: bool = True):
+        """Outbound changeset in the KERNEL WIRE FORM — split 32-bit
+        lanes (`ops.pallas_merge.SplitChangeset`, or the narrow
+        value-ref lanes on a ``value_width=32`` replica), pre-tiled to
+        the kernel's resident layout when the capacity allows. What
+        `merge_split` consumes with ZERO per-merge conversion: gossip
+        peers exchanging this form skip both the int64 split and the
+        tile relayout copy on every merge (each measured comparable to
+        the join itself — docs/PERF.md round 5). Returns
+        ``(split_changeset, node_ids)``."""
+        from ..ops.pallas_merge import (TILE, split_changeset,
+                                        split_changeset_narrow,
+                                        tile_changeset)
+        cs, ids = self.export_delta(since)
+        if self._value_width == 32:
+            # Values were range-checked on every ingest path; the
+            # overflow flag is structurally False here.
+            scs, _ = split_changeset_narrow(cs)
+        else:
+            scs = split_changeset(cs)
+        if tiled and self.n_slots % TILE == 0:
+            scs = tile_changeset(scs)
+        return scs, ids
+
+    def merge_split(self, scs, node_ids: Sequence[Any]) -> None:
+        """Fan-in a PRE-SPLIT (optionally pre-tiled) changeset — the
+        zero-conversion counterpart of ``merge(cs, node_ids)`` for
+        peers exchanging `export_split_delta`'s wire form. Semantics
+        (guards, value-width enforcement, pipelined windows, watch,
+        stats, clock) are identical to the wide path; on executors
+        without the Mosaic kernel the lanes are joined back to wide
+        form and merged through ``merge`` (correct, just without the
+        conversion saving). The changeset must cover exactly
+        ``n_slots`` (capacity adaptation needs the wide path)."""
+        from ..ops.pallas_merge import (_cs_shape, model_fanin_split,
+                                        pad_split_rows, split_to_wide)
+        r, n = _cs_shape(scs)
+        if n != self.n_slots:
+            raise ValueError(
+                f"pre-split changeset covers {n} slots but this "
+                f"replica holds {self.n_slots}; use merge() (the wide "
+                "path pads/refuses capacity mismatches)")
+        if not self._use_pallas():
+            return self.merge(split_to_wide(scs), node_ids)
+        from ..ops.pallas_merge import MAX_NODE_ORDINAL
+        if len(self._table) + len(node_ids) > MAX_NODE_ORDINAL:
+            # int16 node lane ceiling (pre-intern upper bound; the
+            # wide path routes >32k-ordinal tables to the XLA fold).
+            return self.merge(split_to_wide(scs), node_ids)
+        self.stats.merges += 1
+        self._intern_ids(node_ids)
+        # Ordinal remap happens IN-JIT (model_fanin_split's node_map
+        # gather) — eager remap ops cost a dispatch round trip each.
+        node_map = np.fromiter(
+            (self._table.ordinal(nid) for nid in node_ids),
+            np.int16, count=len(node_ids))
+        # Shared small-delta chunk sizing (`_kernel_chunk_rows`): skip
+        # the (expensive, eager) row padding whenever r fits one chunk.
+        chunk = self._kernel_chunk_rows(r)
+        if chunk < r:
+            scs = pad_split_rows(scs, chunk)
+        wall = self._wall_clock()
+        with merge_annotation("crdt_tpu.dense_merge"):
+            new_store, pres, seen, voverflow = model_fanin_split(
+                self._store, scs, jnp.asarray(node_map),
+                self._canonical_lt(),
+                jnp.int32(self._table.ordinal(self._node_id)),
+                jnp.int64(wall), chunk_rows=chunk,
+                interpret=self._executor == "pallas-interpret",
+                value_width=self._value_width)
+        self.stats.add_seen_lazy(seen)
+        res = self._pallas_result(pres)
+
+        def wide_for_exact():
+            # Failure path only: reconstruct wide lanes AND apply the
+            # ordinal remap (the hot path remapped in-jit, so ``scs``
+            # still carries peer ordinals).
+            wide = split_to_wide(scs)
+            table = jnp.asarray(node_map, jnp.int32)
+            idx = jnp.clip(wide.node, 0, len(node_map) - 1)
+            return wide._replace(
+                node=jnp.where(wide.valid, table[idx], 0))
+
+        self._finish_merge(
+            new_store, res,
+            voverflow if self._value_width == 32 else None, wall,
+            wide_for_exact)
 
     def _pipe_send_bump(self, wall: int) -> None:
         """The final crdt.dart:93 send bump, on device, flags

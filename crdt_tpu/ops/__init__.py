@@ -38,7 +38,8 @@ from .pallas_merge import (SplitStore, SplitChangeset, PallasFaninResult,
                            pallas_fanin_batch, pallas_fanin_step,
                            pallas_fanin_stream, split_store,
                            split_changeset, join_store, tile_changeset,
-                           TILE)
+                           model_fanin_split, pad_split_rows,
+                           split_to_wide, TILE)
 
 __all__ = [
     "NodeTable", "pack_logical_time", "unpack_logical_time",
@@ -50,5 +51,5 @@ __all__ = [
     "SplitStore", "SplitChangeset", "PallasFaninResult",
     "pallas_fanin_batch", "pallas_fanin_step", "pallas_fanin_stream",
     "split_store", "split_changeset", "join_store", "tile_changeset",
-    "TILE",
+    "model_fanin_split", "pad_split_rows", "split_to_wide", "TILE",
 ]

@@ -488,6 +488,50 @@ def _cs_shape(cs) -> Tuple[int, int]:
     return cs.hi.shape
 
 
+def pad_split_rows(cs, multiple: int):
+    """Pad a split (2-D or tiled 3-D) changeset's replica axis with
+    INVALID rows (hi=NEG_HI, node=I16_NEG, zeros elsewhere) up to a
+    multiple — the split-lane counterpart of `ops.dense.
+    pad_replica_rows`, for callers feeding `pallas_fanin_batch`'s
+    chunk_rows requirement with pre-split wire data."""
+    r = cs.hi.shape[0]
+    pad = (-r) % multiple
+    if not pad:
+        return cs
+    out = {}
+    for f in cs._fields:
+        lane = getattr(cs, f)
+        fill = NEG_HI if f == "hi" else (I16_NEG if f == "node" else 0)
+        out[f] = jnp.concatenate([
+            lane, jnp.full((pad,) + lane.shape[1:], fill, lane.dtype)])
+    return type(cs)(**out)
+
+
+@jax.jit
+def split_to_wide(cs) -> DenseChangeset:
+    """Reconstruct wide `DenseChangeset` lanes from split wire lanes
+    (either width) — the exact inverse of `split_changeset`[`_narrow`]
+    up to the masked content of invalid entries (which no consumer
+    reads). Used by the model layer's non-kernel fallback and the
+    failure-path exact guard recompute."""
+    r, n = _cs_shape(cs)
+    flat = type(cs)(*(l.reshape(r, n) if l.ndim == 3 else l
+                      for l in cs))
+    valid = flat.hi != NEG_HI
+    lt = jnp.where(valid, _join64(flat.hi, flat.lo), 0)
+    if isinstance(cs, NarrowSplitChangeset):
+        val = flat.val.astype(jnp.int64)
+    else:
+        val = _join64(flat.val_hi, flat.val_lo)
+    return DenseChangeset(
+        lt=lt,
+        node=jnp.where(valid, flat.node.astype(jnp.int32), 0),
+        val=val,
+        tomb=flat.tomb.astype(bool),
+        valid=valid,
+    )
+
+
 @partial(jax.jit, static_argnames=("interpret",))
 def pallas_fanin_step(store: SplitStore, cs: SplitChangeset,
                       canonical_lt: jax.Array, local_node: jax.Array,
@@ -713,6 +757,53 @@ def model_fanin_batch(store, cs, canonical_lt, local_node, wall_millis,
     sst = split_store.__wrapped__(store)
     out, res = pallas_fanin_batch.__wrapped__(
         sst, scs, canonical_lt, local_node, wall_millis,
+        chunk_rows=chunk_rows, interpret=interpret)
+    return join_store.__wrapped__(out), res, seen, val_overflow
+
+
+@partial(jax.jit,
+         static_argnames=("chunk_rows", "interpret", "value_width"))
+def model_fanin_split(store, cs, node_map, canonical_lt, local_node,
+                      wall_millis, *, chunk_rows: int = 16,
+                      interpret: bool = False, value_width: int = 64):
+    """`model_fanin_batch` for a PRE-SPLIT (optionally pre-tiled)
+    changeset — the zero-conversion gossip path: peers exchange the
+    kernel wire form (`DenseCrdt.export_split_delta`) and the merge
+    skips the per-call split/tile entirely.
+
+    ``node_map`` (int16[peer_table_len]) rewrites the changeset's
+    node ordinals into the local table IN-JIT (each eager dispatch is
+    a host round trip on proxied backends; pass the identity map when
+    tables already match — the gather fuses away to a copy).
+
+    Same return contract as `model_fanin_batch`:
+    ``(new_store, PallasFaninResult, seen, val_overflow)``. A
+    value_width=32 replica receiving WIDE split lanes masks records
+    whose payload is not a sign-extension of its low word (invalid,
+    never truncated) and flags ``val_overflow``; narrow lanes fit by
+    construction."""
+    idx = jnp.clip(cs.node, 0, node_map.shape[0] - 1).astype(jnp.int32)
+    cs = cs._replace(node=jnp.where(
+        cs.node == jnp.int16(I16_NEG), jnp.int16(I16_NEG),
+        node_map[idx]))
+    if value_width == 32 and not isinstance(cs, NarrowSplitChangeset):
+        fits = cs.val_hi == (
+            cs.val_lo.astype(jnp.int32) >> 31)
+        val_overflow = jnp.any((cs.hi != NEG_HI) & ~fits)
+        inval = ~fits
+        # Full sentinel masking (hi AND lo AND node): a half-masked
+        # entry with hi=NEG_HI but a nonzero lo would beat an empty
+        # store slot's (NEG_HI, 0) in the strict lex compare.
+        cs = cs._replace(
+            hi=jnp.where(inval, jnp.int32(NEG_HI), cs.hi),
+            lo=jnp.where(inval, jnp.uint32(0), cs.lo),
+            node=jnp.where(inval, jnp.int16(I16_NEG), cs.node))
+    else:
+        val_overflow = jnp.asarray(False)
+    seen = jnp.sum(cs.hi != NEG_HI)
+    sst = split_store.__wrapped__(store)
+    out, res = pallas_fanin_batch.__wrapped__(
+        sst, cs, canonical_lt, local_node, wall_millis,
         chunk_rows=chunk_rows, interpret=interpret)
     return join_store.__wrapped__(out), res, seen, val_overflow
 

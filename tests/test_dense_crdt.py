@@ -1467,3 +1467,183 @@ def test_bool_values_rejected_on_merge():
     with pytest.raises(TypeError, match="bool"):
         d2.merge_json(m.to_json())
     assert len(d2) == 0
+
+
+class TestSplitInterchange:
+    """Pre-split changesets as a first-class interchange
+    (`export_split_delta` / `merge_split`): zero-conversion gossip in
+    the kernel wire form, semantics identical to the wide path."""
+
+    NP = None  # set in setup: TILE-aligned capacity
+
+    @classmethod
+    def setup_class(cls):
+        from crdt_tpu.ops.pallas_merge import TILE
+        cls.NP = TILE
+
+    def _pair(self, value_width=64):
+        a = DenseCrdt("na", self.NP, executor="pallas-interpret",
+                      wall_clock=FakeClock(start=BASE),
+                      value_width=value_width)
+        b = DenseCrdt("na", self.NP, executor="pallas-interpret",
+                      wall_clock=FakeClock(start=BASE),
+                      value_width=value_width)
+        return a, b
+
+    @pytest.mark.parametrize("value_width", [64, 32])
+    @pytest.mark.parametrize("tiled", [True, False])
+    def test_matches_wide_path(self, value_width, tiled):
+        via_split, via_wide = self._pair(value_width)
+        w = DenseCrdt("w", self.NP, value_width=value_width,
+                      wall_clock=FakeClock(start=BASE + 5))
+        w.put_batch([0, 7, self.NP - 1], [10, -70, 99])
+        w.delete_batch([7])
+        scs, ids = w.export_split_delta(tiled=tiled)
+        via_split.merge_split(scs, ids)
+        via_wide.merge(*w.export_delta())
+        np.testing.assert_array_equal(
+            np.asarray(via_split.store.occupied),
+            np.asarray(via_wide.store.occupied))
+        occ = np.asarray(via_wide.store.occupied)
+        for lane in ("lt", "node", "val", "tomb", "mod_lt"):
+            np.testing.assert_array_equal(
+                np.asarray(getattr(via_split.store, lane))[occ],
+                np.asarray(getattr(via_wide.store, lane))[occ],
+                err_msg=lane)
+        assert via_split.canonical_time == via_wide.canonical_time
+        assert (via_split.stats.records_adopted
+                == via_wide.stats.records_adopted == 3)
+        assert via_split.get(7) is None and via_split.get(0) == 10
+
+    def test_ordinal_remap(self):
+        # Receiver with a DIFFERENT interning history: peer ordinals
+        # must remap through the node table like the wide path.
+        rcv = DenseCrdt("zz", self.NP, executor="pallas-interpret",
+                        wall_clock=FakeClock(start=BASE),
+                        node_ids=["m1", "m2", "zz"])
+        w = DenseCrdt("aa", self.NP, wall_clock=FakeClock(start=BASE + 5))
+        w.put_batch([3], [30])
+        scs, ids = w.export_split_delta()
+        rcv.merge_split(scs, ids)
+        assert rcv.get(3) == 30
+        assert rcv._table.id_of(int(rcv.store.node[3])) == "aa"
+
+    def test_guard_parity_with_wide_path(self):
+        via_split, via_wide = self._pair()
+        w = DenseCrdt("na", self.NP,
+                      wall_clock=FakeClock(start=BASE + 50))
+        w.put_batch([1], [1])
+        scs, ids = w.export_split_delta()
+        errs = []
+        for rcv, do in ((via_split,
+                         lambda: via_split.merge_split(scs, ids)),
+                        (via_wide,
+                         lambda: via_wide.merge(*w.export_delta()))):
+            with pytest.raises(DuplicateNodeException) as ei:
+                do()
+            errs.append(ei.value)
+        assert errs[0].args == errs[1].args
+        assert (via_split.canonical_time.logical_time
+                == via_wide.canonical_time.logical_time)
+        assert len(via_split) == 0
+
+    def test_value_width_32_rejects_wide_overflow(self):
+        # A value-ref replica receiving WIDE split lanes with an
+        # out-of-range payload: rejected whole, replica untouched.
+        from crdt_tpu.ops.pallas_merge import split_changeset
+        rcv = DenseCrdt("na", self.NP, executor="pallas-interpret",
+                        wall_clock=FakeClock(start=BASE),
+                        value_width=32)
+        w = DenseCrdt("w", self.NP, wall_clock=FakeClock(start=BASE + 5))
+        w.put_batch([0, 1], [5, 2 ** 40])
+        cs, ids = w.export_delta()
+        with pytest.raises(ValueError, match="int32"):
+            rcv.merge_split(split_changeset(cs), ids)
+        assert len(rcv) == 0
+
+    def test_capacity_mismatch_refused(self):
+        rcv = DenseCrdt("na", self.NP, executor="pallas-interpret",
+                        wall_clock=FakeClock(start=BASE))
+        w = DenseCrdt("w", self.NP * 2,
+                      wall_clock=FakeClock(start=BASE + 5))
+        w.put_batch([1], [1])
+        scs, ids = w.export_split_delta(tiled=False)
+        with pytest.raises(ValueError, match="merge"):
+            rcv.merge_split(scs, ids)
+
+    def test_xla_fallback_joins_to_wide(self):
+        # Non-kernel executors merge via the wide path — correct,
+        # just without the conversion saving.
+        rcv = DenseCrdt("na", 64, executor="xla",
+                        wall_clock=FakeClock(start=BASE))
+        w = DenseCrdt("w", 64, wall_clock=FakeClock(start=BASE + 5))
+        w.put_batch([2], [22])
+        w.delete_batch([2])
+        from crdt_tpu.ops.pallas_merge import split_changeset
+        cs, ids = w.export_delta()
+        rcv.merge_split(split_changeset(cs), ids)
+        assert rcv.get(2) is None and rcv.contains_slot(2)
+        assert rcv.is_deleted(2)
+
+    def test_pipelined_window(self):
+        via_split, via_wide = self._pair()
+        writers = []
+        for i, nid in enumerate(("w1", "w2", "w3")):
+            w = DenseCrdt(nid, self.NP,
+                          wall_clock=FakeClock(start=BASE + 3 + i))
+            w.put_batch([i, 10 + i], [i * 10, i * 100])
+            writers.append(w)
+        with via_split.pipelined():
+            for w in writers:
+                via_split.merge_split(*w.export_split_delta())
+        with via_wide.pipelined():
+            for w in writers:
+                via_wide.merge(*w.export_delta())
+        occ = np.asarray(via_wide.store.occupied)
+        np.testing.assert_array_equal(
+            np.asarray(via_split.store.occupied), occ)
+        for lane in ("lt", "val", "mod_lt"):
+            np.testing.assert_array_equal(
+                np.asarray(getattr(via_split.store, lane))[occ],
+                np.asarray(getattr(via_wide.store, lane))[occ],
+                err_msg=lane)
+        assert via_split.canonical_time == via_wide.canonical_time
+
+    @pytest.mark.parametrize("rows", [10, 17])
+    def test_multirow_split_pads_and_matches(self, rows):
+        # r > STREAM_CHUNK_ROWS exercises pad_split_rows (sentinel
+        # fills per lane, 2-D and tiled forms) through the kernel —
+        # single-writer exports never reach it.
+        import jax.numpy as jnp
+        from crdt_tpu.ops.pallas_merge import (split_changeset,
+                                               tile_changeset)
+        from crdt_tpu.ops.dense import DenseChangeset
+        rng = np.random.default_rng(rows)
+        n = self.NP
+        lt = ((BASE + rng.integers(0, 50, (rows, n))) << 16) \
+            + rng.integers(0, 4, (rows, n))
+        cs = DenseChangeset(
+            lt=jnp.asarray(lt, jnp.int64),
+            node=jnp.asarray(rng.integers(0, 3, (rows, n)), jnp.int32),
+            val=jnp.asarray(rng.integers(0, 1000, (rows, n)), jnp.int64),
+            tomb=jnp.asarray(rng.random((rows, n)) < 0.3),
+            valid=jnp.asarray(rng.random((rows, n)) < 0.7),
+        )
+        ids = ["pa", "pb", "pc"]
+        via_split, via_wide = self._pair()
+        scs = split_changeset(cs)
+        if rows % 2:   # alternate forms across the parametrization
+            scs = tile_changeset(scs)
+        via_split.merge_split(scs, ids)
+        via_wide.merge(cs, ids)
+        occ = np.asarray(via_wide.store.occupied)
+        np.testing.assert_array_equal(
+            np.asarray(via_split.store.occupied), occ)
+        for lane in ("lt", "node", "val", "tomb", "mod_lt"):
+            np.testing.assert_array_equal(
+                np.asarray(getattr(via_split.store, lane))[occ],
+                np.asarray(getattr(via_wide.store, lane))[occ],
+                err_msg=lane)
+        assert via_split.canonical_time == via_wide.canonical_time
+        assert (via_split.stats.records_adopted
+                == via_wide.stats.records_adopted)
