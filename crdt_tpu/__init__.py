@@ -33,7 +33,7 @@ from .models.dense_crdt import (DenseCrdt, PipelinedGuardError,
 from .models.keyed_dense import KeyedDenseCrdt
 from .models.sqlite_crdt import SqliteCrdt
 from .sync import sync, sync_json
-from .net import SyncServer, sync_over_tcp
+from .net import SyncServer, sync_dense_over_tcp, sync_over_tcp
 from .checkpoint import load_dense, load_json, save_dense, save_json
 
 __version__ = "0.5.0"
@@ -46,6 +46,6 @@ __all__ = [
     "ChangeStream", "MapCrdt", "TpuMapCrdt", "DenseCrdt",
     "ShardedDenseCrdt", "KeyedDenseCrdt", "PipelinedGuardError",
     "sync_dense", "SqliteCrdt",
-    "sync", "sync_json", "SyncServer", "sync_over_tcp",
+    "sync", "sync_json", "SyncServer", "sync_dense_over_tcp", "sync_over_tcp",
     "load_dense", "load_json", "save_dense", "save_json",
 ]

@@ -337,3 +337,131 @@ def test_connection_deadline_bounds_mid_frame_trickle():
             assert dropped_at < 2.0, (
                 f"server held a trickling connection {dropped_at:.1f}s "
                 "past a 0.3s deadline")
+
+
+class TestDenseBinarySync:
+    """Binary split-lane sync (`push_dense`/`delta_dense` +
+    `sync_dense_over_tcp`): dense peers exchange the kernel wire form
+    as raw frames; the JSON ops stay the universal interop path."""
+
+    BASE = 1_700_000_000_000
+
+    def _dense(self, node, start_off=0, n=64):
+        from crdt_tpu import DenseCrdt
+        return DenseCrdt(node, n,
+                         wall_clock=FakeClock(start=self.BASE + start_off))
+
+    def test_round_converges_and_watermark(self):
+        from crdt_tpu.net import SyncServer, sync_dense_over_tcp
+        a = self._dense("na")
+        b = self._dense("nb", 5)
+        a.put_batch([1, 3], [10, 30])
+        b.put_batch([2], [20])
+        b.delete_batch([2])
+        with SyncServer(b) as server:
+            wm = sync_dense_over_tcp(a, server.host, server.port)
+            # second round with the watermark: only newer records move
+            b.put_batch([7], [70])
+            sync_dense_over_tcp(a, server.host, server.port, since=wm)
+        for c in (a, b):
+            assert c.get(1) == 10 and c.get(3) == 30
+            assert c.get(2) is None and c.is_deleted(2)
+            assert c.get(7) == 70
+
+    def test_matches_json_sync_lane_exact(self):
+        from crdt_tpu.net import (SyncServer, sync_dense_over_tcp,
+                                  sync_over_tcp)
+        srv_bin = self._dense("srv")
+        srv_json = self._dense("srv")
+        cl_bin = self._dense("cl", 3)
+        cl_json = self._dense("cl", 3)
+        for cl in (cl_bin, cl_json):
+            cl.put_batch([0, 9], [5, 95])
+        for srv in (srv_bin, srv_json):
+            srv.put_batch([4], [44])
+        with SyncServer(srv_bin) as s1, SyncServer(srv_json) as s2:
+            sync_dense_over_tcp(cl_bin, s1.host, s1.port)
+            sync_over_tcp(cl_json, s2.host, s2.port, key_decoder=int)
+        import numpy as np
+        occ = np.asarray(cl_json.store.occupied)
+        np.testing.assert_array_equal(
+            np.asarray(cl_bin.store.occupied), occ)
+        for lane in ("lt", "val", "tomb"):
+            np.testing.assert_array_equal(
+                np.asarray(getattr(cl_bin.store, lane))[occ],
+                np.asarray(getattr(cl_json.store, lane))[occ],
+                err_msg=lane)
+        # Canonical clocks are NOT asserted equal: the JSON round's
+        # merge_json spends its decode-stamp wall read (the reference
+        # contract), while merge_split reads like merge() — one fewer
+        # tick under an injected clock. Both must dominate every
+        # record they absorbed.
+        for cl in (cl_bin, cl_json):
+            assert (cl.canonical_time.logical_time
+                    >= int(np.asarray(cl.store.lt)[occ].max()))
+
+    def test_non_dense_server_rejects_gracefully(self):
+        from crdt_tpu import MapCrdt
+        from crdt_tpu.net import SyncServer, sync_dense_over_tcp
+        m = MapCrdt("mm", wall_clock=FakeClock(start=self.BASE))
+        a = self._dense("na")
+        a.put_batch([0], [1])
+        with SyncServer(m) as server:
+            with pytest.raises(ConnectionError, match="rejected"):
+                sync_dense_over_tcp(a, server.host, server.port)
+        assert m.map == {}        # replica untouched
+
+    def test_malformed_meta_rejected(self):
+        import socket as socket_mod
+        from crdt_tpu.net import (SyncServer, recv_frame, send_frame,
+                                  send_bytes_frame)
+        b = self._dense("nb")
+        with SyncServer(b) as server:
+            with socket_mod.create_connection(
+                    (server.host, server.port), timeout=10) as sock:
+                sock.settimeout(10)
+                # dtype smuggling: 'object' must be refused
+                send_frame(sock, {"op": "push_dense", "node_ids": ["x"],
+                                  "meta": {"form": "split", "lanes": [
+                                      [f, "object", [1, 64]]
+                                      for f in ("hi", "lo", "node",
+                                                "val_hi", "val_lo",
+                                                "tomb")]}})
+                send_bytes_frame(sock, [b"\0" * 64])
+                reply = recv_frame(sock)
+                assert reply and reply.get("ok") is False
+                assert reply["error"] == "ValueError"
+        assert len(b) == 0
+
+    def test_frame_size_mismatch_rejected(self):
+        import socket as socket_mod
+        from crdt_tpu.net import (SyncServer, recv_frame, send_frame,
+                                  send_bytes_frame, _pack_split)
+        a = self._dense("na")
+        a.put_batch([0], [1])
+        scs, ids = a.export_split_delta(tiled=False)
+        meta, bufs = _pack_split(scs)
+        b = self._dense("nb")
+        with SyncServer(b) as server:
+            with socket_mod.create_connection(
+                    (server.host, server.port), timeout=10) as sock:
+                sock.settimeout(10)
+                send_frame(sock, {"op": "push_dense", "meta": meta,
+                                  "node_ids": list(ids)})
+                # truncated binary frame
+                send_bytes_frame(sock, [bytes(bufs[0])[:-4]])
+                reply = recv_frame(sock)
+                assert reply and reply.get("ok") is False
+        assert len(b) == 0
+
+    def test_value_ref_narrow_lanes_roundtrip(self):
+        from crdt_tpu import DenseCrdt
+        from crdt_tpu.net import SyncServer, sync_dense_over_tcp
+        a = DenseCrdt("na", 64, value_width=32,
+                      wall_clock=FakeClock(start=self.BASE))
+        b = DenseCrdt("nb", 64, value_width=32,
+                      wall_clock=FakeClock(start=self.BASE + 5))
+        a.put_batch([3], [-33])
+        with SyncServer(b) as server:
+            sync_dense_over_tcp(a, server.host, server.port)
+        assert b.get(3) == -33
