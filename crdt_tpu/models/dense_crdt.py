@@ -67,9 +67,11 @@ class _PipeState:
     """Device-resident clock state threaded across a pipelined window."""
 
     __slots__ = ("canonical", "any_bad", "overflow", "drift",
-                 "val_overflow", "first_flag_idx", "merges")
+                 "val_overflow", "first_flag_idx", "merges",
+                 "exact", "ex_have", "ex_dup", "ex_lt", "ex_caf",
+                 "ex_wall")
 
-    def __init__(self, canonical_lt: int):
+    def __init__(self, canonical_lt: int, exact: bool = False):
         self.canonical = jnp.int64(canonical_lt)
         self.any_bad = jnp.asarray(False)
         self.overflow = jnp.asarray(False)
@@ -80,6 +82,18 @@ class _PipeState:
         # start at the right batch instead of replaying the window.
         self.first_flag_idx = jnp.int32(-1)
         self.merges = 0
+        # exact mode: the first offender's own fields, accumulated on
+        # device in sequential visit order (recv_guards per merge,
+        # seeded with the threaded canonical — identical flags to the
+        # unpipelined path, no supersets). ex_wall is the OFFENDING
+        # merge's wall read, captured alongside ex_lt so exception
+        # payloads can't pair one merge's record with another's wall.
+        self.exact = exact
+        self.ex_have = jnp.asarray(False)
+        self.ex_dup = jnp.asarray(False)
+        self.ex_lt = jnp.int64(0)       # offending record's logicalTime
+        self.ex_caf = jnp.int64(0)      # canonical just before it
+        self.ex_wall = jnp.int64(0)     # that merge's wall read
 
     def note(self, flags, idx: Optional[int] = None) -> None:
         """Attribute freshly-raised flags to window slot ``idx``
@@ -88,6 +102,17 @@ class _PipeState:
         newly = ((self.first_flag_idx < 0) & flags).astype(jnp.bool_)
         self.first_flag_idx = jnp.where(newly, jnp.int32(i),
                                         self.first_flag_idx)
+
+
+@jax.jit
+def _pipe_exact_guards(lt, node, valid, canonical_lt, local_node, wall):
+    """One exact recv-guard pass for a pipelined merge (the r-major
+    running-cummax semantics of `ops.merge.recv_guards`, seeded with
+    the THREADED device canonical — flag-identical to the unpipelined
+    path) plus the offender's logicalTime, fetched in-jit."""
+    any_b, first_bad, first_is_dup, caf = recv_guards(
+        lt, node, valid, canonical_lt, local_node, wall)
+    return any_b, lt.reshape(-1)[first_bad], first_is_dup, caf
 
 
 class DenseCrdt:
@@ -182,7 +207,7 @@ class DenseCrdt:
         return jnp.int64(self._canonical_time.logical_time)
 
     @contextmanager
-    def pipelined(self):
+    def pipelined(self, exact_guards: bool = False):
         """Zero-host-sync merge window: inside it, ``merge`` /
         ``merge_many`` thread the canonical clock as a DEVICE scalar
         (the final send bump runs on device, `ops.merge.send_step`)
@@ -220,30 +245,46 @@ class DenseCrdt:
         Store lanes and the canonical clock are bit-identical to the
         same merges issued unpipelined (differentially tested).
         Local writes (`put_batch` etc.) are refused inside the
-        window — they need the host clock."""
+        window — they need the host clock.
+
+        ``exact_guards=True`` trades one extra device pass per merge
+        (the r-major running-cummax `recv_guards`, seeded with the
+        threaded canonical — flag-identical to the unpipelined path)
+        for EXACT diagnostics: no spurious flags, and the flush raises
+        the reference's own typed exceptions
+        (`DuplicateNodeException`/`ClockDriftException`) with the
+        unpipelined payloads, naming the offending merge. The window
+        contract is unchanged in one respect: merges have already
+        LANDED when the flush raises (optimistic application)."""
         if self._pipe is not None:
             raise RuntimeError("pipelined() windows do not nest")
         import sys as _sys
-        self._pipe = _PipeState(self._canonical_time.logical_time)
+        self._pipe = _PipeState(self._canonical_time.logical_time,
+                                exact=exact_guards)
         try:
             yield self
         finally:
             pipe, self._pipe = self._pipe, None
-            lt, any_bad, overflow, drift, val_ovf, first_idx = \
-                jax.device_get(
-                    (pipe.canonical, pipe.any_bad, pipe.overflow,
-                     pipe.drift, pipe.val_overflow,
-                     pipe.first_flag_idx))
+            (lt, any_bad, overflow, drift, val_ovf, first_idx,
+             ex_have, ex_dup, ex_lt, ex_caf, ex_wall) = jax.device_get(
+                (pipe.canonical, pipe.any_bad, pipe.overflow,
+                 pipe.drift, pipe.val_overflow, pipe.first_flag_idx,
+                 pipe.ex_have, pipe.ex_dup, pipe.ex_lt, pipe.ex_caf,
+                 pipe.ex_wall))
             self._canonical_time = Hlc.from_logical_time(
                 int(lt), self._node_id)
-            if ((bool(any_bad) or bool(overflow) or bool(drift)
-                    or bool(val_ovf))
-                    and _sys.exc_info()[0] is None):
-                # Never shadow an in-flight exception from the window
-                # body — the guard report matters less than the error
-                # that actually interrupted the caller.
+            # Never shadow an in-flight exception from the window
+            # body — the guard report matters less than the error
+            # that actually interrupted the caller. (A bare `return`
+            # here would SWALLOW it: finally-block semantics.)
+            in_flight = _sys.exc_info()[0] is not None
+            def _coarse_report(include_recv: bool) -> None:
                 kinds = [k for k, f in (
-                    ("recv-guard (duplicate-node or drift)", any_bad),
+                    ("recv-guard (duplicate-node or drift)",
+                     any_bad and include_recv),
+                    ("recv-guard (exact: "
+                     + ("duplicate-node" if bool(ex_dup) else "drift")
+                     + ")", pipe.exact and ex_have),
                     ("send counter overflow", overflow),
                     ("send drift", drift),
                     ("value-ref overflow (records with values past "
@@ -254,9 +295,41 @@ class DenseCrdt:
                     f"guards tripped in pipelined window: "
                     f"{', '.join(kinds)}; first flagged at merge "
                     f"#{int(first_idx)} of {pipe.merges} (0-based, "
-                    "window order); possibly spurious (superset "
-                    "flags) — re-run from that batch unpipelined for "
-                    "the exact diagnosis")
+                    "window order)"
+                    + ("" if pipe.exact else
+                       "; possibly spurious (superset flags) — re-run "
+                       "from that batch unpipelined for the exact "
+                       "diagnosis, or open the window with "
+                       "exact_guards=True"))
+
+            if not in_flight:
+                if not pipe.exact:
+                    if (bool(any_bad) or bool(overflow) or bool(drift)
+                            or bool(val_ovf)):
+                        _coarse_report(include_recv=True)
+                else:
+                    # Exact-mode priority mirrors the unpipelined
+                    # in-merge ordering: a value-overflow rejects
+                    # before guard handling (its "records were
+                    # SKIPPED" report must never be eaten by a typed
+                    # raise); the recv guard preempts the send bump
+                    # (send flags on an offending merge are a
+                    # consequence of optimistic application, not the
+                    # diagnosis).
+                    if bool(val_ovf):
+                        _coarse_report(include_recv=False)
+                    if bool(ex_have):
+                        # The unpipelined exception types and payloads
+                        # (the merges are already in the store —
+                        # window contract); ex_wall is the offending
+                        # merge's own wall read.
+                        if bool(ex_dup):
+                            raise DuplicateNodeException(
+                                str(self._node_id))
+                        raise ClockDriftException(int(ex_lt) >> 16,
+                                                  int(ex_wall))
+                    if bool(overflow) or bool(drift):
+                        _coarse_report(include_recv=False)
 
     # --- local ops: one send per batch (crdt.dart:39-54) ---
 
@@ -1289,25 +1362,56 @@ class DenseCrdt:
         self._finish_merge(new_store, res, voverflow, wall, lambda: cs)
 
     def _finish_merge(self, new_store, res, voverflow, wall: int,
-                      cs_for_exact: Callable[[], DenseChangeset]) -> None:
+                      cs_for_exact: Callable[[], DenseChangeset],
+                      guard_lanes: Optional[Callable] = None) -> None:
         """Shared post-dispatch tail for changeset merges
         (`merge_many` / `merge_split`): the pipelined accumulation OR
         the one batched fetch + value-overflow reject + exact-guard
         recompute + store swap + stats + watch + final send bump.
         ``cs_for_exact`` lazily produces the WIDE changeset for the
-        failure-path guard recompute (pre-split callers only pay the
-        reconstruction when a flag actually trips)."""
+        failure-path guard recompute — outside exact-mode windows,
+        pre-split callers only pay the reconstruction when a flag
+        actually trips. In an ``exact_guards`` window the guard lanes
+        are needed EVERY merge; ``guard_lanes`` (a thunk returning
+        ``(lt, node, valid)``) lets such callers supply just the three
+        lanes the guards read instead of the full wide changeset."""
         if self._pipe is not None:
             # Pipelined tail: nothing leaves the device. Guard flags
             # OR-accumulate; the canonical threads through the device
             # send bump; the adopted counter drains lazily.
             pipe = self._pipe
-            new_flags = res.any_bad
+            if pipe.exact:
+                # One exact pass (cost: the running-cummax sweep the
+                # fast kernels skip, plus — for pre-split callers —
+                # the guard-lane reconstruction), seeded with the
+                # threaded pre-merge canonical. The executor's
+                # superset flags are superseded entirely.
+                if guard_lanes is not None:
+                    g_lt, g_node, g_valid = guard_lanes()
+                else:
+                    cs = cs_for_exact()
+                    g_lt, g_node, g_valid = cs.lt, cs.node, cs.valid
+                any_b, bad_lt, first_is_dup, caf = _pipe_exact_guards(
+                    g_lt, g_node, g_valid, pipe.canonical,
+                    jnp.int32(self._table.ordinal(self._node_id)),
+                    jnp.int64(wall))
+                newly = (~pipe.ex_have) & any_b
+                pipe.ex_dup = jnp.where(newly, first_is_dup,
+                                        pipe.ex_dup)
+                pipe.ex_lt = jnp.where(newly, bad_lt, pipe.ex_lt)
+                pipe.ex_caf = jnp.where(newly, caf, pipe.ex_caf)
+                pipe.ex_wall = jnp.where(newly, jnp.int64(wall),
+                                         pipe.ex_wall)
+                pipe.ex_have = pipe.ex_have | any_b
+                recv_flag = any_b
+            else:
+                recv_flag = res.any_bad
+            new_flags = recv_flag
             if voverflow is not None:
                 pipe.val_overflow = pipe.val_overflow | voverflow
                 new_flags = new_flags | voverflow
             pipe.note(new_flags)
-            pipe.any_bad = pipe.any_bad | res.any_bad
+            pipe.any_bad = pipe.any_bad | recv_flag
             pipe.merges += 1
             self._store = new_store
             self.stats.add_adopted_lazy(res.win_count)
@@ -1392,7 +1496,8 @@ class DenseCrdt:
         conversion saving). The changeset must cover exactly
         ``n_slots`` (capacity adaptation needs the wide path)."""
         from ..ops.pallas_merge import (_cs_shape, model_fanin_split,
-                                        pad_split_rows, split_to_wide)
+                                        pad_split_rows,
+                                        split_guard_lanes, split_to_wide)
         r, n = _cs_shape(scs)
         if n != self.n_slots:
             raise ValueError(
@@ -1443,7 +1548,9 @@ class DenseCrdt:
         self._finish_merge(
             new_store, res,
             voverflow if self._value_width == 32 else None, wall,
-            wide_for_exact)
+            wide_for_exact,
+            guard_lanes=lambda: split_guard_lanes(
+                scs.hi, scs.lo, scs.node, jnp.asarray(node_map)))
 
     def _pipe_send_bump(self, wall: int) -> None:
         """The final crdt.dart:93 send bump, on device, flags

@@ -508,6 +508,21 @@ def pad_split_rows(cs, multiple: int):
 
 
 @jax.jit
+def split_guard_lanes(hi, lo, node, node_map):
+    """Just the three lanes recv guards read — ``(lt, node, valid)``
+    with LOCAL ordinals — from split wire lanes, without
+    reconstructing the payload (exact-guard pipelined windows need
+    these every merge; `split_to_wide` would rebuild all five)."""
+    r = hi.shape[0]
+    hi2 = hi.reshape(r, -1)
+    valid = hi2 != NEG_HI
+    lt = _join64(hi2, lo.reshape(r, -1))
+    idx = jnp.clip(node.reshape(r, -1), 0,
+                   node_map.shape[0] - 1).astype(jnp.int32)
+    return lt, node_map.astype(jnp.int32)[idx], valid
+
+
+@jax.jit
 def split_to_wide(cs) -> DenseChangeset:
     """Reconstruct wide `DenseChangeset` lanes from split wire lanes
     (either width) — the exact inverse of `split_changeset`[`_narrow`]

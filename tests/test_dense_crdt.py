@@ -7,7 +7,8 @@ import jax.numpy as jnp
 
 from crdt_tpu import ClockDriftException, DuplicateNodeException, Hlc
 from crdt_tpu.checkpoint import load_dense, save_dense
-from crdt_tpu.models.dense_crdt import DenseCrdt, sync_dense
+from crdt_tpu.models.dense_crdt import (DenseCrdt, PipelinedGuardError,
+                                         sync_dense)
 from crdt_tpu.testing import FakeClock
 
 N = 64
@@ -1647,3 +1648,145 @@ class TestSplitInterchange:
         assert via_split.canonical_time == via_wide.canonical_time
         assert (via_split.stats.records_adopted
                 == via_wide.stats.records_adopted)
+
+
+class TestPipelinedExactGuards:
+    """`pipelined(exact_guards=True)`: one recv_guards pass per merge,
+    seeded with the threaded canonical — flag-identical to the
+    unpipelined path; the flush raises the reference's typed
+    exceptions with unpipelined payloads, never spuriously."""
+
+    def _np(self):
+        from crdt_tpu.ops.pallas_merge import TILE
+        return TILE
+
+    def test_real_dup_raises_typed_with_parity(self):
+        n = self._np()
+        other = DenseCrdt("na", n, wall_clock=FakeClock(start=BASE + 50))
+        other.put_batch([3], [1])
+        delta = other.export_delta()
+        plain = DenseCrdt("na", n, executor="pallas-interpret",
+                          wall_clock=FakeClock(start=BASE))
+        with pytest.raises(DuplicateNodeException) as plain_err:
+            plain.merge(*delta)
+        piped = DenseCrdt("na", n, executor="pallas-interpret",
+                          wall_clock=FakeClock(start=BASE))
+        with pytest.raises(DuplicateNodeException) as piped_err:
+            with piped.pipelined(exact_guards=True):
+                piped.merge(*delta)
+        assert piped_err.value.args == plain_err.value.args
+        # Window contract: the merge has LANDED when the flush raises.
+        assert piped.get(3) == 1
+
+    def test_drift_payload_parity(self):
+        from crdt_tpu import ClockDriftException
+        n = self._np()
+        far = DenseCrdt("far", n,
+                        wall_clock=FakeClock(start=BASE + 200_000))
+        far.put_batch([2], [9])
+        delta = far.export_delta()
+        plain = DenseCrdt("hub", n, executor="pallas-interpret",
+                          wall_clock=FakeClock(start=BASE + 99))
+        with pytest.raises(ClockDriftException) as plain_err:
+            plain.merge(*delta)
+        piped = DenseCrdt("hub", n, executor="pallas-interpret",
+                          wall_clock=FakeClock(start=BASE + 99))
+        with pytest.raises(ClockDriftException) as piped_err:
+            with piped.pipelined(exact_guards=True):
+                piped.merge(*delta)
+        assert piped_err.value.args == plain_err.value.args
+
+    def test_shielded_record_not_spurious(self):
+        # A local-node record shielded by an earlier larger-lt record:
+        # the fast kernels flag it (superset) — a COARSE window raises
+        # PipelinedGuardError, the EXACT window completes clean, like
+        # the unpipelined path.
+        import jax.numpy as jnp
+        from crdt_tpu.ops.dense import DenseChangeset
+        n = self._np()
+
+        def changeset():
+            lanes = {f: np.zeros((2, n), d) for f, d in
+                     (("lt", np.int64), ("node", np.int32),
+                      ("val", np.int64), ("tomb", bool),
+                      ("valid", bool))}
+            lanes["lt"][0, 0] = (BASE + 50) << 16
+            lanes["node"][0, 0] = 0
+            lanes["val"][0, 0] = 1
+            lanes["valid"][0, 0] = True
+            lanes["lt"][1, 0] = (BASE + 10) << 16
+            lanes["node"][1, 0] = 1
+            lanes["val"][1, 0] = 2
+            lanes["valid"][1, 0] = True
+            return DenseChangeset(**{f: jnp.asarray(v)
+                                     for f, v in lanes.items()})
+
+        coarse = DenseCrdt("m", n, executor="pallas-interpret",
+                           wall_clock=FakeClock(start=BASE + 99))
+        with pytest.raises(PipelinedGuardError):
+            with coarse.pipelined():
+                coarse.merge(changeset(), ["zz", "m"])
+        exact = DenseCrdt("m", n, executor="pallas-interpret",
+                          wall_clock=FakeClock(start=BASE + 99))
+        with exact.pipelined(exact_guards=True):
+            exact.merge(changeset(), ["zz", "m"])     # no raise
+        assert exact.get(0) == 1
+
+    def test_clean_window_matches_coarse(self):
+        # Same clean merges through both modes: bit-identical lanes
+        # and canonical (the exact pass is diagnostics-only).
+        n = self._np()
+        writers = []
+        for i, nid in enumerate(("w1", "w2")):
+            w = DenseCrdt(nid, n,
+                          wall_clock=FakeClock(start=BASE + 3 + i))
+            w.put_batch([i, 20 + i], [i * 7, i * 11])
+            writers.append(w)
+        outs = []
+        for kwargs in ({}, {"exact_guards": True}):
+            c = DenseCrdt("hub", n, executor="pallas-interpret",
+                          wall_clock=FakeClock(start=BASE))
+            with c.pipelined(**kwargs):
+                for w in writers:
+                    c.merge(*w.export_delta())
+            outs.append(c)
+        a, b = outs
+        assert a.canonical_time == b.canonical_time
+        np.testing.assert_array_equal(np.asarray(a.store.lt),
+                                      np.asarray(b.store.lt))
+        np.testing.assert_array_equal(np.asarray(a.store.mod_lt),
+                                      np.asarray(b.store.mod_lt))
+
+    def test_merge_split_in_exact_window(self):
+        n = self._np()
+        other = DenseCrdt("na", n, wall_clock=FakeClock(start=BASE + 50))
+        other.put_batch([5], [55])
+        scs, ids = other.export_split_delta()
+        piped = DenseCrdt("na", n, executor="pallas-interpret",
+                          wall_clock=FakeClock(start=BASE))
+        with pytest.raises(DuplicateNodeException):
+            with piped.pipelined(exact_guards=True):
+                piped.merge_split(scs, ids)
+        clean = DenseCrdt("rcv", n, executor="pallas-interpret",
+                          wall_clock=FakeClock(start=BASE))
+        with clean.pipelined(exact_guards=True):
+            clean.merge_split(scs, ids)
+        assert clean.get(5) == 55
+
+    def test_value_overflow_report_not_eaten_by_typed_raise(self):
+        # Review repro: merge #0 trips value-ref overflow, merge #1 a
+        # real drift — the "records were SKIPPED" report must surface
+        # (the typed raise would silently eat the data-loss signal).
+        n = self._np()
+        big = DenseCrdt("big", n, wall_clock=FakeClock(start=BASE + 5))
+        big.put_batch([0], [2 ** 40])
+        far = DenseCrdt("far", n,
+                        wall_clock=FakeClock(start=BASE + 200_000))
+        far.put_batch([2], [9])
+        hub = DenseCrdt("hub", n, executor="pallas-interpret",
+                        wall_clock=FakeClock(start=BASE + 99),
+                        value_width=32)
+        with pytest.raises(PipelinedGuardError, match="SKIPPED"):
+            with hub.pipelined(exact_guards=True):
+                hub.merge(*big.export_delta())
+                hub.merge(*far.export_delta())
