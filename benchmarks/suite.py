@@ -228,6 +228,50 @@ def bench_payload_wire_int_tpu_map(n_keys=1 << 20, repeats=1):
         best, path="wire-json-columnar")
 
 
+def bench_gossip_interchange(n_keys=1 << 20, loops=12):
+    """Round-5 interchange claim: single-row gossip merges through the
+    pre-split kernel wire form (`merge_split`) vs wide-lane `merge` —
+    the split path skips the per-merge int64 split and tile relayout.
+    Run in ONE process back-to-back so proxy variance hits both."""
+    import time as _time
+
+    import jax
+    import jax.numpy as jnp
+
+    from bench import make_changeset
+    from crdt_tpu import DenseCrdt
+    ids = [f"n{i}" for i in range(9)]
+    w = DenseCrdt("w", n_keys, node_ids=ids,
+                  wall_clock=FakeClock(start=_MILLIS))
+    w.merge(make_changeset(128, n_keys, seed=0), ids)
+    scs, sids = w.export_split_delta()
+    wcs, wids = w.export_delta()
+    jax.block_until_ready((scs, wcs))
+    merges = int(jnp.sum(wcs.valid))
+    peers = sorted(set(ids + ["rcv", "w"]))
+
+    def run(fn):
+        rcv = DenseCrdt("rcv", n_keys, node_ids=peers)
+        with rcv.pipelined():
+            fn(rcv)
+            fn(rcv)          # warm
+        rcv = DenseCrdt("rcv", n_keys, node_ids=peers)
+        t0 = _time.perf_counter()
+        with rcv.pipelined():
+            for _ in range(loops):
+                fn(rcv)
+        return (_time.perf_counter() - t0) / loops
+
+    wide_s = run(lambda r: r.merge(wcs, wids))
+    split_s = run(lambda r: r.merge_split(scs, sids))
+    out = result_dict(
+        f"gossip_split_interchange_{n_keys}key_merges_per_sec", merges,
+        split_s, path="merge_split-pre-tiled")
+    out["wide_merge_per_sec"] = round(merges / wide_s, 1)
+    out["speedup_vs_wide"] = round(wide_s / split_s, 2)
+    return out
+
+
 def bench_dense_to_json(n_slots=1 << 20, repeats=3):
     """1M-slot full wire export on the dense model (the interop contract
     crdt.dart:124-135 at dense scale): lane-direct C-codec formatting."""
@@ -361,6 +405,7 @@ def main():
     # the identical payload (VERDICT r4 item 3's "≥ TpuMapCrdt" bar).
     emit(bench_payload_wire_dense)
     emit(bench_payload_wire_int_tpu_map)
+    emit(bench_gossip_interchange)
     emit(bench_dense_to_json)
     emit(bench_tpu_map_to_json)
 

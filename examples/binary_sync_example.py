@@ -1,0 +1,60 @@
+"""Dense replicas syncing in the KERNEL WIRE FORM over TCP.
+
+The JSON wire (crdt_json.dart:8-37) is the universal interop path;
+between two DENSE replicas it is also ~5× more bytes than the data
+deserves. This example runs the same anti-entropy round
+(test/map_crdt_test.dart:273-279 semantics) through
+`sync_dense_over_tcp`: the delta crosses the socket as ONE raw binary
+frame of split 32-bit lanes — the exact form the Mosaic merge kernel
+consumes (`DenseCrdt.export_split_delta` / `merge_split`) — so neither
+side runs a text codec or a lane conversion.
+
+The same `SyncServer` keeps answering the JSON ops too: a third,
+non-dense replica joins the mesh over plain `sync_over_tcp` at the
+end.
+
+Run: python examples/binary_sync_example.py
+"""
+
+from crdt_tpu import (DenseCrdt, MapCrdt, SyncServer,
+                      sync_dense_over_tcp, sync_over_tcp)
+
+N_SLOTS = 256
+
+
+def main() -> None:
+    # Two dense replicas; the server side hosts `b`.
+    a = DenseCrdt("alice", N_SLOTS)
+    b = DenseCrdt("bob", N_SLOTS)
+    a.put_batch([1, 2], [10, 20])
+    b.put_batch([3], [30])
+    b.delete_batch([3])
+
+    with SyncServer(b) as server:
+        # Round 1: full exchange in raw binary lanes. The returned
+        # watermark makes the NEXT round's pull an inclusive delta.
+        watermark = sync_dense_over_tcp(a, server.host, server.port,
+                                        timeout=120)
+        print("after binary round:",
+              {s: a.get(s) for s in (1, 2, 3)},
+              "| tombstone at 3:", a.is_deleted(3))
+
+        # Round 2: only records modified since the watermark move.
+        b.put_batch([7], [70])
+        sync_dense_over_tcp(a, server.host, server.port,
+                            since=watermark, timeout=120)
+        print("after delta round: slot 7 =", a.get(7))
+
+        # A record-dict replica joins over the JSON ops — same server,
+        # same state, different backend family and wire form.
+        m = MapCrdt("mapper")
+        sync_over_tcp(m, server.host, server.port, key_decoder=int)
+        print("JSON peer sees:", dict(sorted(m.map.items())))
+
+    assert a.get(1) == 10 and a.get(7) == 70 and a.is_deleted(3)
+    assert m.map == {1: 10, 2: 20, 7: 70}
+    print("binary + JSON peers converged")
+
+
+if __name__ == "__main__":
+    main()
