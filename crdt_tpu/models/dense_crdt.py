@@ -858,11 +858,8 @@ class DenseCrdt:
                 self._check_slots(slots)
                 self._check_value_width(np.array([vmin, vmax], np.int64))
                 self._intern_ids(uniq)
-                ords = {nid: i for i, nid
-                        in enumerate(self._table.ids())}
-                node = np.fromiter((ords[u] for u in uniq), np.int32,
-                                   count=len(uniq))[
-                                       np.frombuffer(nibuf, np.int32)]
+                node = self._table.encode(uniq)[
+                    np.frombuffer(nibuf, np.int32)]
                 self._merge_validated(
                     slots, np.frombuffer(ltbuf, np.int64), node,
                     np.frombuffer(vbuf, np.int64),
@@ -914,8 +911,7 @@ class DenseCrdt:
                           np.int64, count=k)
         self._check_value_width(val)
         self._intern_ids(set(node_ids))
-        ords = {nid: i for i, nid in enumerate(self._table.ids())}
-        node = np.fromiter((ords[n] for n in node_ids), np.int32, count=k)
+        node = self._table.encode(node_ids)
         self._merge_validated(slots, lt, node, val, tomb)
 
     def _merge_validated(self, slots: np.ndarray, lt: np.ndarray,

@@ -1230,6 +1230,48 @@ done:
  * interpreter dispatch — at 1M records the ensure-slots loop alone
  * is ~1.8 s of a 3.2 s wire merge. */
 
+/* ordinals(node_ids: list, omap: dict) -> bytearray of int32
+ * Batched ordinal lookup: out[i] = omap[node_ids[i]]. An identity
+ * memo skips the dict probe for consecutive repeats (the wire
+ * scanners dedup node strings, so runs share one object). KeyError
+ * on a missing id, like the Python dict lookup it replaces. */
+static PyObject *ordinals(PyObject *self, PyObject *args) {
+    PyObject *ids, *omap;
+    if (!PyArg_ParseTuple(args, "O!O!", &PyList_Type, &ids,
+                          &PyDict_Type, &omap)) return NULL;
+    Py_ssize_t m = PyList_GET_SIZE(ids);
+    PyObject *buf = PyByteArray_FromStringAndSize(
+        NULL, m * (Py_ssize_t)sizeof(int32_t));
+    if (!buf) return NULL;
+    int32_t *out = (int32_t *)PyByteArray_AS_STRING(buf);
+    PyObject *prev = NULL;
+    int32_t prev_ord = 0;
+    for (Py_ssize_t i = 0; i < m; i++) {
+        PyObject *k = PyList_GET_ITEM(ids, i);
+        if (k == prev) {
+            out[i] = prev_ord;
+            continue;
+        }
+        PyObject *v = PyDict_GetItemWithError(omap, k);
+        if (!v) {
+            if (!PyErr_Occurred())
+                PyErr_SetObject(PyExc_KeyError, k);
+            Py_DECREF(buf);
+            return NULL;
+        }
+        long o = PyLong_AsLong(v);
+        if (o == -1 && PyErr_Occurred()) {
+            Py_DECREF(buf);
+            return NULL;
+        }
+        out[i] = (int32_t)o;
+        prev = k;
+        prev_ord = (int32_t)o;
+    }
+    return buf;
+}
+
+
 /* ensure_slots(key_to_slot: dict, keys: list, start: int)
  * -> (bytearray of int64 slots, new_keys: list)
  * Get-or-insert each key; fresh keys take consecutive slots from
@@ -1828,6 +1870,8 @@ static PyMethodDef methods[] = {
      "Batch compact-JSON text for a value column."},
     {"ensure_slots", ensure_slots, METH_VARARGS,
      "Batch get-or-insert of keys into a key->slot dict."},
+    {"ordinals", ordinals, METH_VARARGS,
+     "Batched int32 dict lookups for node ordinals."},
     {"none_mask", none_mask, METH_O,
      "uint8 mask of None entries in a list."},
     {"scatter_payload", scatter_payload, METH_VARARGS,
