@@ -207,6 +207,64 @@ def validate_model_soak(n_keys, rounds, seed=0):
           "after every round)")
 
 
+def validate_merge_split(n_keys, seed=0):
+    """`merge_split` (pre-split interchange, tiled and untiled, wide
+    and value-ref lanes) vs the wide `merge` path ON THE CHIP — the
+    interpret-mode differentials re-run against real Mosaic output."""
+    import random
+    from crdt_tpu import DenseCrdt
+    from crdt_tpu.ops.pallas_merge import split_changeset
+    from crdt_tpu.testing import FakeClock
+    rng = random.Random(seed)
+    BASE = _MILLIS
+    for value_width, tiled in ((64, True), (64, False), (32, True)):
+        writers = []
+        for i, nid in enumerate(("wa", "wb", "wc")):
+            w = DenseCrdt(nid, n_keys, value_width=value_width,
+                          wall_clock=FakeClock(start=BASE + 1 + i))
+            slots = rng.sample(range(n_keys), 200)
+            w.put_batch(slots, [rng.randrange(1 << 30) for _ in slots])
+            w.delete_batch(rng.sample(slots, 40))
+            writers.append(w)
+        via_split = DenseCrdt("hub", n_keys, value_width=value_width,
+                              wall_clock=FakeClock(start=BASE + 50))
+        via_wide = DenseCrdt("hub", n_keys, value_width=value_width,
+                             wall_clock=FakeClock(start=BASE + 50))
+        for w in writers:
+            scs, ids = w.export_split_delta(tiled=tiled)
+            via_split.merge_split(scs, ids)
+            via_wide.merge(*w.export_delta())
+        assert_lanes_equal(via_split.store, via_wide.store,
+                           f"merge_split vw={value_width} tiled={tiled}")
+        assert (via_split.canonical_time.logical_time
+                == via_wide.canonical_time.logical_time)
+    # multi-row (r > chunk) padded form through the real kernel
+    rows = 12
+    lt = ((_MILLIS + np.random.default_rng(seed).integers(
+        0, 50, (rows, n_keys))) << SHIFT)
+    from crdt_tpu.ops.dense import DenseChangeset
+    rng2 = np.random.default_rng(seed + 1)
+    cs = DenseChangeset(
+        lt=jnp.asarray(lt, jnp.int64),
+        node=jnp.asarray(rng2.integers(0, 3, (rows, n_keys)), jnp.int32),
+        val=jnp.asarray(rng2.integers(0, 1 << 40, (rows, n_keys)),
+                        jnp.int64),
+        tomb=jnp.asarray(rng2.random((rows, n_keys)) < 0.3),
+        valid=jnp.asarray(rng2.random((rows, n_keys)) < 0.7))
+    ids = ["pa", "pb", "pc"]
+    a = DenseCrdt("hub", n_keys, wall_clock=FakeClock(start=_MILLIS + 99))
+    b = DenseCrdt("hub", n_keys, wall_clock=FakeClock(start=_MILLIS + 99))
+    a.merge_split(split_changeset(cs), ids)
+    b.merge(cs, ids)
+    assert_lanes_equal(a.store, b.store, "merge_split multirow")
+    # the one condition unique to this section: padding rows must not
+    # leak their sentinels into the canonical either
+    assert (a.canonical_time.logical_time
+            == b.canonical_time.logical_time)
+    print("PASS merge_split (tiled/untiled, wide/valref, multirow "
+          "padded == wide merge on chip)")
+
+
 def main():
     from crdt_tpu.ops.pallas_merge import TILE
     ap = argparse.ArgumentParser()
@@ -225,6 +283,7 @@ def main():
         validate_stream(args.keys, n_chunks=4, seed=seed)
         validate_batch(args.keys, seed)
     validate_model(args.keys)
+    validate_merge_split(args.keys)
     if args.soak:
         validate_model_soak(args.keys, args.soak, seed=args.soak_seed)
     print("ALL PASS")
