@@ -69,13 +69,20 @@ def make_changeset(rc: int, n: int, seed: int, tomb_ratio: float = 0.3,
     )
 
 
-def make_changeset_fast(rc: int, n: int, seed: int) -> DenseChangeset:
+from functools import partial as _partial
+
+
+@_partial(jax.jit, static_argnames=("rc", "n"))
+def make_changeset_fast(rc: int, n: int, seed) -> DenseChangeset:
     """`make_changeset` defaults from ONE uint32 random draw per lane
-    pair — ~5× cheaper generation for the e2e rows, where input
-    manufacture sits INSIDE the timed loop (the 1024 distinct batches
-    cannot be HBM-resident at once) and would otherwise dominate the
-    number. Same distributions: ~1000-ms millis spread, 4 counter
-    values, 8 writers, ~30% tombstones, ~80% fill."""
+    pair, as ONE fused jit — for the e2e rows, where input manufacture
+    sits INSIDE the timed loop (the 1024 distinct batches cannot be
+    HBM-resident at once) and would otherwise dominate the number.
+    Jitting matters as much as the single draw: the eager form
+    dispatched ~15 separate 128M-element ops and MATERIALIZED every
+    intermediate to HBM (~225 ms/batch vs ~25 fused). Same
+    distributions: ~1000-ms millis spread, 4 counter values, 8
+    writers, ~30% tombstones, ~80% fill."""
     bits = jax.random.bits(jax.random.key(seed), (2, rc, n), jnp.uint32)
     b1 = bits[0]
     b2 = bits[1]

@@ -1331,6 +1331,49 @@ class DenseCrdt:
         cs = parts[0] if len(parts) == 1 else DenseChangeset(
             *(jnp.concatenate([getattr(p, f) for p in parts])
               for f in DenseChangeset._fields))
+        pipe = self._pipe
+        if pipe is not None and not pipe.exact and self._use_pallas():
+            # Coarse pipelined Mosaic merges run as ONE dispatch
+            # (merge + flag accumulation + send bump fused): the
+            # separate bookkeeping ops each cost a host round trip on
+            # remote-proxied backends and were the dominant share of
+            # the north-star e2e pass. Exact-guard windows keep the
+            # stepwise path (their guard pass needs the wide lanes).
+            from ..ops.pallas_merge import pipelined_model_step
+            r = cs.lt.shape[0]
+            chunk = self._kernel_chunk_rows(r)
+            if chunk < r:
+                cs = pad_replica_rows(cs, chunk)
+            # Both wall reads up front (absorption + send bump): same
+            # count and sequence as the unfused path, so injected
+            # clocks tick identically.
+            wall_merge = self._wall_clock()
+            wall_send = self._wall_clock()
+            with merge_annotation("crdt_tpu.dense_merge"):
+                (new_store, new_canon, any_bad, overflow, drift,
+                 val_ovf, first_idx, win_count, win, seen) = \
+                    pipelined_model_step(
+                        self._store, cs, pipe.canonical, pipe.any_bad,
+                        pipe.overflow, pipe.drift, pipe.val_overflow,
+                        pipe.first_flag_idx,
+                        jnp.int32(self._table.ordinal(self._node_id)),
+                        jnp.int64(wall_merge), jnp.int64(wall_send),
+                        jnp.int32(pipe.merges),
+                        chunk_rows=chunk,
+                        interpret=self._executor == "pallas-interpret",
+                        value_width=self._value_width)
+            pipe.canonical = new_canon
+            pipe.any_bad = any_bad
+            pipe.overflow = overflow
+            pipe.drift = drift
+            pipe.val_overflow = val_ovf
+            pipe.first_flag_idx = first_idx
+            pipe.merges += 1
+            self._store = self._postprocess_store(new_store)
+            self.stats.add_seen_lazy(seen)
+            self.stats.add_adopted_lazy(win_count)
+            self._emit_merge_wins(new_store, win)
+            return
         if not self._use_pallas():
             # The Mosaic route folds BOTH of these into its single
             # fused dispatch (`model_fanin_batch`); the other
@@ -1519,6 +1562,40 @@ class DenseCrdt:
         chunk = self._kernel_chunk_rows(r)
         if chunk < r:
             scs = pad_split_rows(scs, chunk)
+        pipe = self._pipe
+        if pipe is not None and not pipe.exact:
+            # Coarse window: one fused dispatch, like the wide path —
+            # else the zero-conversion interchange would be the SLOWER
+            # pipelined route (the bookkeeping dispatches cost more
+            # than the merge at gossip shapes).
+            from ..ops.pallas_merge import pipelined_model_step_split
+            wall_merge = self._wall_clock()
+            wall_send = self._wall_clock()
+            with merge_annotation("crdt_tpu.dense_merge"):
+                (new_store, new_canon, any_bad, overflow, drift,
+                 val_ovf, first_idx, win_count, win, seen) = \
+                    pipelined_model_step_split(
+                        self._store, scs, jnp.asarray(node_map),
+                        pipe.canonical, pipe.any_bad, pipe.overflow,
+                        pipe.drift, pipe.val_overflow,
+                        pipe.first_flag_idx,
+                        jnp.int32(self._table.ordinal(self._node_id)),
+                        jnp.int64(wall_merge), jnp.int64(wall_send),
+                        jnp.int32(pipe.merges), chunk_rows=chunk,
+                        interpret=self._executor == "pallas-interpret",
+                        value_width=self._value_width)
+            pipe.canonical = new_canon
+            pipe.any_bad = any_bad
+            pipe.overflow = overflow
+            pipe.drift = drift
+            pipe.val_overflow = val_ovf
+            pipe.first_flag_idx = first_idx
+            pipe.merges += 1
+            self._store = self._postprocess_store(new_store)
+            self.stats.add_seen_lazy(seen)
+            self.stats.add_adopted_lazy(win_count)
+            self._emit_merge_wins(new_store, win)
+            return
         wall = self._wall_clock()
         with merge_annotation("crdt_tpu.dense_merge"):
             new_store, pres, seen, voverflow = model_fanin_split(

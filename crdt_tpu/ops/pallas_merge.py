@@ -823,6 +823,76 @@ def model_fanin_split(store, cs, node_map, canonical_lt, local_node,
     return join_store.__wrapped__(out), res, seen, val_overflow
 
 
+@partial(jax.jit,
+         static_argnames=("chunk_rows", "interpret", "value_width"))
+def pipelined_model_step(store, cs, canonical, any_bad, overflow,
+                         drift, val_ovf, first_idx, local_node,
+                         wall_merge, wall_send, merge_idx, *,
+                         chunk_rows: int = 16, interpret: bool = False,
+                         value_width: int = 64):
+    """One COARSE pipelined merge as a single dispatch: the fused
+    model merge (`model_fanin_batch`) plus the window bookkeeping the
+    model layer otherwise runs as separate eager ops — flag
+    OR-accumulation, first-flag attribution, and the final send bump
+    (`ops.merge.send_step`). On remote-proxied backends each separate
+    dispatch is a host round trip; at the north-star e2e shape the
+    bookkeeping dispatches were costing more than the merge itself.
+
+    ``wall_merge`` is the absorption-phase wall read, ``wall_send``
+    the send bump's — two reads, like the unfused path, so injected
+    clocks tick identically. Returns the full window-state update:
+    ``(new_store, new_canonical, any_bad, overflow, drift,
+    val_overflow, first_flag_idx, win_count, win, seen)``."""
+    new_store, pres, seen, voverflow = model_fanin_batch.__wrapped__(
+        store, cs, canonical, local_node, wall_merge,
+        chunk_rows=chunk_rows, interpret=interpret,
+        value_width=value_width)
+    return _pipelined_tail(new_store, pres, seen, voverflow,
+                           value_width, any_bad, overflow, drift,
+                           val_ovf, first_idx, merge_idx, wall_send)
+
+
+@partial(jax.jit,
+         static_argnames=("chunk_rows", "interpret", "value_width"))
+def pipelined_model_step_split(store, cs, node_map, canonical, any_bad,
+                               overflow, drift, val_ovf, first_idx,
+                               local_node, wall_merge, wall_send,
+                               merge_idx, *, chunk_rows: int = 16,
+                               interpret: bool = False,
+                               value_width: int = 64):
+    """`pipelined_model_step` for PRE-SPLIT changesets (`merge_split`
+    in a coarse window) — the interchange path gets the same
+    one-dispatch treatment, else fusing only the wide path would make
+    the zero-conversion gossip route the slower of the two."""
+    new_store, pres, seen, voverflow = model_fanin_split.__wrapped__(
+        store, cs, node_map, canonical, local_node, wall_merge,
+        chunk_rows=chunk_rows, interpret=interpret,
+        value_width=value_width)
+    return _pipelined_tail(new_store, pres, seen, voverflow,
+                           value_width, any_bad, overflow, drift,
+                           val_ovf, first_idx, merge_idx, wall_send)
+
+
+def _pipelined_tail(new_store, pres, seen, voverflow, value_width,
+                    any_bad, overflow, drift, val_ovf, first_idx,
+                    merge_idx, wall_send):
+    """Shared in-jit window bookkeeping: flag OR-accumulation,
+    first-flag attribution, and the final send bump."""
+    from .merge import send_step
+    recv_flag = pres.any_dup | pres.any_drift
+    new_flags = recv_flag | (voverflow if value_width == 32
+                             else jnp.asarray(False))
+    newly = (first_idx < 0) & new_flags
+    first_idx = jnp.where(newly, merge_idx, first_idx)
+    new_lt, s_ovf, s_drift = send_step.__wrapped__(pres.new_canonical,
+                                                   wall_send)
+    newly2 = (first_idx < 0) & (s_ovf | s_drift)
+    first_idx = jnp.where(newly2, merge_idx, first_idx)
+    return (new_store, new_lt, any_bad | recv_flag, overflow | s_ovf,
+            drift | s_drift, val_ovf | voverflow, first_idx,
+            jnp.sum(pres.win).astype(jnp.int32), pres.win, seen)
+
+
 @partial(jax.jit, static_argnames=("chunk_rows", "interpret"))
 def pallas_fanin_batch(store: SplitStore, cs: SplitChangeset,
                        canonical_lt: jax.Array, local_node: jax.Array,
