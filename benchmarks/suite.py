@@ -250,11 +250,7 @@ def bench_gossip_interchange(n_keys=1 << 20, loops=12):
     merges = int(jnp.sum(wcs.valid))
     peers = sorted(set(ids + ["rcv", "w"]))
 
-    def run(fn):
-        rcv = DenseCrdt("rcv", n_keys, node_ids=peers)
-        with rcv.pipelined():
-            fn(rcv)
-            fn(rcv)          # warm
+    def run_once(fn):
         rcv = DenseCrdt("rcv", n_keys, node_ids=peers)
         t0 = _time.perf_counter()
         with rcv.pipelined():
@@ -262,8 +258,20 @@ def bench_gossip_interchange(n_keys=1 << 20, loops=12):
                 fn(rcv)
         return (_time.perf_counter() - t0) / loops
 
-    wide_s = run(lambda r: r.merge(wcs, wids))
-    split_s = run(lambda r: r.merge_split(scs, sids))
+    wide_fn = lambda r: r.merge(wcs, wids)          # noqa: E731
+    split_fn = lambda r: r.merge_split(scs, sids)   # noqa: E731
+    for fn in (wide_fn, split_fn):                  # warm both paths
+        rcv = DenseCrdt("rcv", n_keys, node_ids=peers)
+        with rcv.pipelined():
+            fn(rcv)
+            fn(rcv)
+    # INTERLEAVED best-of: these windows are host-enqueue-bound on the
+    # proxied chip and single-shot timings swing 2-3x with RPC jitter —
+    # alternating reps hit both paths with the same weather.
+    wide_s = split_s = float("inf")
+    for _ in range(3):
+        wide_s = min(wide_s, run_once(wide_fn))
+        split_s = min(split_s, run_once(split_fn))
     out = result_dict(
         f"gossip_split_interchange_{n_keys}key_merges_per_sec", merges,
         split_s, path="merge_split-pre-tiled")
