@@ -33,8 +33,13 @@ from .models.dense_crdt import (DenseCrdt, PipelinedGuardError,
 from .models.keyed_dense import KeyedDenseCrdt
 from .models.sqlite_crdt import SqliteCrdt
 from .sync import sync, sync_json
-from .net import SyncServer, sync_dense_over_tcp, sync_over_tcp
-from .checkpoint import load_dense, load_json, save_dense, save_json
+from .net import (SyncError, SyncProtocolError, SyncServer,
+                  SyncTransportError, WireTally, sync_dense_over_tcp,
+                  sync_over_tcp)
+from .checkpoint import (load_dense, load_gossip_state, load_json,
+                         save_dense, save_gossip_state, save_json)
+from .gossip import (BreakerPolicy, CircuitBreaker, GossipNode, Peer,
+                     RetryPolicy)
 
 __version__ = "0.5.0"
 
@@ -47,5 +52,8 @@ __all__ = [
     "ShardedDenseCrdt", "KeyedDenseCrdt", "PipelinedGuardError",
     "sync_dense", "SqliteCrdt",
     "sync", "sync_json", "SyncServer", "sync_dense_over_tcp", "sync_over_tcp",
+    "SyncError", "SyncTransportError", "SyncProtocolError", "WireTally",
+    "GossipNode", "Peer", "RetryPolicy", "BreakerPolicy", "CircuitBreaker",
     "load_dense", "load_json", "save_dense", "save_json",
+    "load_gossip_state", "save_gossip_state",
 ]

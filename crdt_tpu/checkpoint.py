@@ -62,6 +62,54 @@ def load_json(cls: Type[Crdt], node_id: Any, path: str,
     return cls(node_id, seed=records, wall_clock=wall_clock, **kwargs)
 
 
+_GOSSIP_STATE_MAGIC = "crdt_tpu/gossip-state@1"
+
+
+def save_gossip_state(path: str, node_id: Any,
+                      watermarks: dict) -> None:
+    """Durable per-peer watermark table for the gossip runtime
+    (`crdt_tpu.gossip.GossipNode`): ``{peer name: Hlc}``, written
+    atomically (tmp + rename, same discipline as the snapshots above)
+    so a crash mid-write leaves the previous state intact.
+
+    The watermark is the only state a restarted node needs to resume
+    DELTA sync instead of re-pulling full peer state — the replica
+    contents themselves persist through :func:`save_json` /
+    :func:`load_json` (or a durable backend like `SqliteCrdt`).
+    ``node_id`` is recorded so a state file restored onto the wrong
+    node is rejected instead of silently skipping records."""
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump({"magic": _GOSSIP_STATE_MAGIC,
+                   "node_id": str(node_id),
+                   "watermarks": {str(name): str(hlc)
+                                  for name, hlc in watermarks.items()
+                                  if hlc is not None}}, f)
+    os.replace(tmp, path)
+
+
+def load_gossip_state(path: str, node_id: Any) -> dict:
+    """Load a watermark table saved by :func:`save_gossip_state`;
+    ``{}`` when the file does not exist (cold start). Raises
+    ``ValueError`` on a foreign file or another node's state —
+    resuming from someone else's watermarks would skip records."""
+    from .hlc import Hlc
+
+    if not os.path.exists(path):
+        return {}
+    with open(path) as f:
+        state = json.load(f)
+    if not isinstance(state, dict) \
+            or state.get("magic") != _GOSSIP_STATE_MAGIC:
+        raise ValueError(f"not a gossip state file: {path}")
+    if state.get("node_id") != str(node_id):
+        raise ValueError(
+            f"{path} holds watermarks for node "
+            f"{state.get('node_id')!r}, not {node_id!r}")
+    return {name: Hlc.parse(mark)
+            for name, mark in state.get("watermarks", {}).items()}
+
+
 _DENSE_MAGIC_V1 = "crdt_tpu/dense-store@1"
 _DENSE_MAGIC = "crdt_tpu/dense-store@2"
 

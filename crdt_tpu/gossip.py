@@ -1,0 +1,382 @@
+"""Resilient gossip runtime: long-running anti-entropy over flaky links.
+
+The reference's replication story assumes a cooperative, always-up
+peer — its example mocks the remote with a function returning a JSON
+string (example/crdt_example.dart:21-25) — and :func:`sync_over_tcp`
+inherits that: one socket error aborts the round and nothing retries.
+This module turns the one-shot round into a runtime that keeps
+converging through drops, delays, truncations and crashes:
+
+- **Bounded retry** with exponential backoff + FULL jitter on
+  transport faults. Rounds are idempotent lattice joins, so replaying
+  one is always safe; jitter spreads uncoordinated replicas retrying
+  a shared peer instead of synchronizing them into a thundering herd.
+- A per-peer **circuit breaker**: open after N consecutive failed
+  rounds, half-open probe after a cool-down, close again on success —
+  a dead peer costs one probe per reset window, not a retry storm.
+- **Graceful wire-form degradation**: peers start on the dense binary
+  form when the local replica speaks it, and downgrade (sticky) to
+  the universal JSON path the moment the peer rejects a dense op.
+- **Durable watermarks** (`checkpoint.save_gossip_state`): the
+  per-peer delta watermark survives a crash, so a restarted node
+  resumes DELTA sync instead of re-pulling full peer state. (The
+  replica contents persist separately — `checkpoint.save_json` /
+  `load_json`, or a durable backend like `SqliteCrdt`.)
+- **Per-peer counters** (`utils.stats.PeerSyncStats`): rounds,
+  retries, fallbacks, pull kinds, bytes, breaker transitions — a
+  fault-injection soak can prove its faults actually fired.
+
+Time sources are injectable (``clock``/``sleep``/``rng``) so tests
+drive the breaker and backoff deterministically; production uses the
+defaults. The fault-injection counterpart lives in
+`crdt_tpu.testing_faults` (a TCP proxy that drops, delays, truncates,
+corrupts and duplicates on a seeded schedule).
+"""
+
+from __future__ import annotations
+
+import random
+import threading
+import time
+from dataclasses import dataclass
+from typing import Any, Callable, Dict, Optional
+
+from .checkpoint import load_gossip_state, save_gossip_state
+from .crdt import Crdt
+from .hlc import Hlc
+from .net import (SyncProtocolError, SyncServer, SyncTransportError,
+                  WireTally, sync_dense_over_tcp, sync_over_tcp)
+from .utils.stats import PeerSyncStats
+
+
+@dataclass(frozen=True)
+class RetryPolicy:
+    """Bounded retry with exponential backoff and full jitter:
+    ``sleep = uniform(0, min(max_delay, base_delay * 2**attempt))``.
+    Full jitter (rather than equal or decorrelated) because gossiping
+    replicas share peers — a deterministic backoff ladder would march
+    every client of a briefly-down peer back in lockstep."""
+
+    max_attempts: int = 4      # total tries per round, first included
+    base_delay: float = 0.05   # seconds; the cap grows base * 2^n
+    max_delay: float = 2.0
+
+    def delay(self, attempt: int, rng: random.Random) -> float:
+        """Backoff before retry ``attempt`` (1-based)."""
+        return rng.uniform(0.0, min(self.max_delay,
+                                    self.base_delay * (2 ** attempt)))
+
+
+@dataclass(frozen=True)
+class BreakerPolicy:
+    failure_threshold: int = 5   # consecutive failed ROUNDS to open
+    reset_timeout: float = 30.0  # seconds open before one probe
+
+
+class CircuitBreaker:
+    """CLOSED → (N consecutive round failures) → OPEN →
+    (reset_timeout elapses) → HALF_OPEN → one probe round →
+    success: CLOSED / failure: OPEN again.
+
+    Failures are counted per ROUND (after the retry budget is spent),
+    not per attempt — a peer that needs one retry per round is slow,
+    not down, and must not trip the breaker. Transitions are counted
+    into the owning peer's :class:`PeerSyncStats`."""
+
+    CLOSED = "closed"
+    OPEN = "open"
+    HALF_OPEN = "half_open"
+
+    def __init__(self, policy: BreakerPolicy,
+                 clock: Callable[[], float] = time.monotonic,
+                 stats: Optional[PeerSyncStats] = None):
+        self.policy = policy
+        self._clock = clock
+        self._stats = stats
+        self.state = self.CLOSED
+        self.failures = 0          # consecutive, resets on success
+        self._opened_at = 0.0
+
+    def allow(self) -> bool:
+        """May a round be attempted now? Flips OPEN → HALF_OPEN when
+        the cool-down has elapsed (the probe is the caller's round)."""
+        if self.state == self.OPEN:
+            if self._clock() - self._opened_at \
+                    < self.policy.reset_timeout:
+                return False
+            self.state = self.HALF_OPEN
+            if self._stats is not None:
+                self._stats.breaker_half_open += 1
+        return True
+
+    def record_success(self) -> None:
+        self.failures = 0
+        if self.state != self.CLOSED:
+            self.state = self.CLOSED
+            if self._stats is not None:
+                self._stats.breaker_closed += 1
+
+    def record_failure(self) -> None:
+        self.failures += 1
+        if self.state == self.HALF_OPEN \
+                or (self.state == self.CLOSED
+                    and self.failures >= self.policy.failure_threshold):
+            self.state = self.OPEN
+            self._opened_at = self._clock()
+            if self._stats is not None:
+                self._stats.breaker_opened += 1
+
+
+class Peer:
+    """One gossip neighbour: address, current wire mode, delta
+    watermark, breaker, counters. ``name`` is the durable identity the
+    watermark persists under — keep it stable across restarts."""
+
+    def __init__(self, name: str, host: str, port: int, *,
+                 dense: bool,
+                 breaker: CircuitBreaker,
+                 stats: PeerSyncStats,
+                 watermark: Optional[Hlc] = None):
+        self.name = name
+        self.host = host
+        self.port = port
+        self.dense = dense            # sticky: downgraded on rejection
+        self.breaker = breaker
+        self.stats = stats
+        self.watermark = watermark
+        self.last_error: Optional[Exception] = None
+
+    def __repr__(self) -> str:
+        return (f"Peer({self.name!r}, {self.host}:{self.port}, "
+                f"{'dense' if self.dense else 'json'}, "
+                f"breaker={self.breaker.state}, "
+                f"watermark={self.watermark})")
+
+
+# Protocol codes that mean "this peer does not speak the dense wire
+# form" — downgrade to JSON and retry the round immediately. Any other
+# rejection (e.g. a clock guard) would fail identically on JSON, so it
+# is terminal for the round. "rejected" is the default code replies
+# from pre-taxonomy servers map to.
+_DENSE_FALLBACK_CODES = frozenset(
+    {"dense_rejected", "unknown_op", "rejected"})
+
+
+class GossipNode:
+    """A replica + its :class:`SyncServer` + a set of :class:`Peer`s,
+    run as a resilient long-lived gossip participant.
+
+    >>> node = GossipNode(crdt, state_path="/var/lib/app/gossip.json")
+    >>> node.add_peer("b", "10.0.0.2", 7000)
+    >>> node.start(gossip_interval=1.0)   # background anti-entropy
+    ... # or drive rounds yourself:
+    >>> node.sync_peer("b")               # 'ok' | 'skipped' | 'failed'
+    >>> node.stop()
+
+    Local writes from other threads must hold :attr:`lock` (the
+    server's replica lock) — the same contract as `SyncServer`.
+    `sync_peer`/`run_round` themselves are not re-entrant; drive them
+    from one thread (the built-in loop, or your own)."""
+
+    def __init__(self, crdt: Crdt, host: str = "127.0.0.1",
+                 port: int = 0, *,
+                 state_path: Optional[str] = None,
+                 retry: Optional[RetryPolicy] = None,
+                 breaker: Optional[BreakerPolicy] = None,
+                 prefer_dense: Optional[bool] = None,
+                 round_timeout: float = 30.0,
+                 key_encoder=None, value_encoder=None,
+                 key_decoder=None, value_decoder=None,
+                 rng: Optional[random.Random] = None,
+                 clock: Callable[[], float] = time.monotonic,
+                 sleep: Callable[[float], None] = time.sleep,
+                 **server_kwargs):
+        self.crdt = crdt
+        self.retry = retry or RetryPolicy()
+        self.breaker_policy = breaker or BreakerPolicy()
+        # Dense binary wire form only when the local replica speaks it.
+        self.prefer_dense = (hasattr(crdt, "export_split_delta")
+                             if prefer_dense is None else prefer_dense)
+        self.round_timeout = round_timeout
+        self._codecs = dict(key_encoder=key_encoder,
+                            value_encoder=value_encoder,
+                            key_decoder=key_decoder,
+                            value_decoder=value_decoder)
+        self._rng = rng or random.Random()
+        self._clock = clock
+        self._sleep = sleep
+        self.server = SyncServer(crdt, host, port,
+                                 **self._codecs, **server_kwargs)
+        self.peers: Dict[str, Peer] = {}
+        self._state_path = state_path
+        # Crash resume: watermarks persisted by a previous incarnation
+        # seed add_peer — the first round after restart is a DELTA
+        # pull, not a full re-pull.
+        self._saved_marks = ({} if state_path is None else
+                             load_gossip_state(state_path,
+                                               crdt.node_id))
+        self._gossip_thread: Optional[threading.Thread] = None
+        self._stop = threading.Event()
+
+    # --- topology ---
+
+    @property
+    def host(self) -> str:
+        return self.server.host
+
+    @property
+    def port(self) -> int:
+        return self.server.port
+
+    @property
+    def lock(self) -> threading.Lock:
+        """The replica lock (the server's): hold it around any local
+        write from outside the gossip thread."""
+        return self.server.lock
+
+    def add_peer(self, name: str, host: str, port: int,
+                 dense: Optional[bool] = None) -> Peer:
+        """Register (or re-address) a peer. A persisted watermark for
+        ``name`` is resumed; ``dense`` overrides the node-level wire
+        preference for this peer."""
+        stats = PeerSyncStats()
+        peer = Peer(
+            name, host, port,
+            dense=self.prefer_dense if dense is None else dense,
+            breaker=CircuitBreaker(self.breaker_policy,
+                                   clock=self._clock, stats=stats),
+            stats=stats,
+            watermark=self._saved_marks.get(name))
+        self.peers[name] = peer
+        return peer
+
+    # --- lifecycle ---
+
+    def start(self, gossip_interval: Optional[float] = None
+              ) -> "GossipNode":
+        """Serve the replica; with ``gossip_interval`` also run
+        `run_round` on a background loop every that many seconds."""
+        self.server.start()
+        if gossip_interval is not None:
+            self._stop.clear()
+
+            def loop() -> None:
+                while not self._stop.is_set():
+                    self.run_round()
+                    self._stop.wait(gossip_interval)
+
+            self._gossip_thread = threading.Thread(target=loop,
+                                                   daemon=True)
+            self._gossip_thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._gossip_thread is not None:
+            self._gossip_thread.join(timeout=60)
+            self._gossip_thread = None
+        self.server.stop()
+
+    def __enter__(self) -> "GossipNode":
+        return self.start()
+
+    def __exit__(self, *exc) -> None:
+        self.stop()
+
+    # --- rounds ---
+
+    def run_round(self) -> Dict[str, str]:
+        """One gossip sweep: sync every peer once, in a shuffled order
+        (uncoordinated nodes must not all visit peers in registration
+        order). Returns ``{peer name: outcome}``."""
+        names = list(self.peers)
+        self._rng.shuffle(names)
+        return {name: self.sync_peer(name) for name in names}
+
+    def sync_peer(self, name: str) -> str:
+        """One resilient anti-entropy round against a peer.
+
+        Returns ``'ok'`` (round completed, watermark advanced and
+        persisted), ``'skipped'`` (breaker open — no network attempt),
+        or ``'failed'`` (retry budget exhausted on transport faults,
+        or the peer rejected the round; see ``peer.last_error``).
+        Failures never raise — a long-running mesh must keep gossiping
+        with its healthy peers."""
+        peer = self.peers[name]
+        if not peer.breaker.allow():
+            peer.stats.skipped += 1
+            return "skipped"
+        was_full = peer.watermark is None
+        attempt = 0
+        while True:
+            try:
+                mark = self._one_round(peer)
+            except SyncProtocolError as e:
+                if peer.dense and e.code in _DENSE_FALLBACK_CODES:
+                    # The peer doesn't speak the dense wire form:
+                    # downgrade (sticky) and rerun on the universal
+                    # JSON path. Not a link fault — no backoff, and
+                    # the retry budget is untouched.
+                    peer.stats.fallbacks += 1
+                    peer.dense = False
+                    continue
+                return self._round_failed(peer, e)
+            except SyncTransportError as e:
+                attempt += 1
+                if attempt >= self.retry.max_attempts:
+                    return self._round_failed(peer, e)
+                peer.stats.retries += 1
+                peer.last_error = e
+                self._sleep(self.retry.delay(attempt, self._rng))
+                continue
+            if was_full:
+                peer.stats.full_pulls += 1
+            else:
+                peer.stats.delta_pulls += 1
+            peer.stats.rounds_ok += 1
+            peer.last_error = None
+            peer.breaker.record_success()
+            peer.watermark = mark
+            self._persist()
+            return "ok"
+
+    def _one_round(self, peer: Peer) -> Hlc:
+        """One wire round in the peer's current form, byte-tallied."""
+        tally = WireTally()
+        try:
+            if peer.dense:
+                return sync_dense_over_tcp(
+                    self.crdt, peer.host, peer.port,
+                    since=peer.watermark, timeout=self.round_timeout,
+                    lock=self.server.lock, tally=tally)
+            return sync_over_tcp(
+                self.crdt, peer.host, peer.port,
+                since=peer.watermark, timeout=self.round_timeout,
+                lock=self.server.lock, tally=tally, **self._codecs)
+        finally:
+            peer.stats.bytes_sent += tally.sent
+            peer.stats.bytes_received += tally.received
+
+    def _round_failed(self, peer: Peer, exc: Exception) -> str:
+        peer.last_error = exc
+        peer.stats.rounds_failed += 1
+        peer.breaker.record_failure()
+        return "failed"
+
+    def _persist(self) -> None:
+        if self._state_path is not None:
+            save_gossip_state(
+                self._state_path, self.crdt.node_id,
+                {name: p.watermark for name, p in self.peers.items()})
+
+    # --- observability ---
+
+    def stats_snapshot(self) -> Dict[str, Dict[str, Any]]:
+        """Per-peer counter snapshot plus breaker state — cheap, no
+        replica access, safe to poll from a monitoring thread."""
+        return {name: {**p.stats.as_dict(),
+                       "breaker": p.breaker.state,
+                       "dense": p.dense,
+                       "watermark": None if p.watermark is None
+                       else str(p.watermark)}
+                for name, p in self.peers.items()}

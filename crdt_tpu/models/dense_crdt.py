@@ -852,18 +852,29 @@ class DenseCrdt:
                 if not k:
                     self.merge_many([])
                     return
+                slots = np.frombuffer(sbuf, np.int32)
+                lt = np.frombuffer(ltbuf, np.int64)
+                ni = np.frombuffer(nibuf, np.int32)
+                val = np.frombuffer(vbuf, np.int64)
+                tomb = np.frombuffer(tbuf, np.uint8).astype(bool)
+                keep = self._last_wins_keep(slots)
+                if keep is not None:
+                    # Duplicate literal wire keys: collapse last-wins
+                    # (decode-dict parity) before anything counts or
+                    # validates the dropped occurrences.
+                    slots, lt, ni, val, tomb = (
+                        slots[keep], lt[keep], ni[keep], val[keep],
+                        tomb[keep])
+                    k = len(slots)
                 self.stats.merges += 1
                 self.stats.add_seen_lazy(k)
-                slots = np.frombuffer(sbuf, np.int32)
                 self._check_slots(slots)
-                self._check_value_width(np.array([vmin, vmax], np.int64))
+                self._check_value_width(
+                    np.array([vmin, vmax], np.int64)
+                    if keep is None else val)
                 self._intern_ids(uniq)
-                node = self._table.encode(uniq)[
-                    np.frombuffer(nibuf, np.int32)]
-                self._merge_validated(
-                    slots, np.frombuffer(ltbuf, np.int64), node,
-                    np.frombuffer(vbuf, np.int64),
-                    np.frombuffer(tbuf, np.uint8).astype(bool))
+                node = self._table.encode(uniq)[ni]
+                self._merge_validated(slots, lt, node, val, tomb)
                 return
         keys, lt, nodes, values = crdt_json.decode_columns(
             json_str, key_decoder=key_decoder or int,
@@ -874,6 +885,22 @@ class DenseCrdt:
         self._merge_columns(np.asarray(keys, np.int64), lt, nodes,
                             values)
 
+    @staticmethod
+    def _last_wins_keep(slots: np.ndarray) -> Optional[np.ndarray]:
+        """Indices keeping the LAST occurrence per duplicate slot (in
+        payload order), or None when already unique. Distinct wire
+        keys may decode to ONE slot ("5" and "05" under the int key
+        decoder); the legacy decode-dict collapsed those last-wins
+        BEFORE the merge ever saw them, and the scatter/wide joins
+        require unique slots — XLA scatter with duplicate indices has
+        backend-dependent winner order."""
+        k = len(slots)
+        # First occurrence in the reversed view = last in the payload.
+        _, idx = np.unique(slots[::-1], return_index=True)
+        if len(idx) == k:
+            return None
+        return np.sort(k - 1 - idx)
+
     def _merge_columns(self, slots: np.ndarray, lt: np.ndarray,
                        node_ids: List[Any], values: List[Any]) -> None:
         """The shared O(k) columnar merge core (`merge_records` /
@@ -881,7 +908,14 @@ class DenseCrdt:
         with ``slots``/``node_ids``/``values``. Every validation runs
         BEFORE the first clock mutation (and before the absorption
         wall read — the legacy visit order under a counting clock), so
-        a rejected payload leaves the replica untouched."""
+        a rejected payload leaves the replica untouched. Duplicate
+        slots collapse last-wins first — dropped occurrences are never
+        seen, validated, or counted, exactly like the decode dict."""
+        keep = self._last_wins_keep(slots)
+        if keep is not None:
+            slots, lt = slots[keep], lt[keep]
+            node_ids = [node_ids[i] for i in keep]
+            values = [values[i] for i in keep]
         k = len(slots)
         self.stats.merges += 1
         # add_seen_lazy (host int here): `records_seen +=` would drain
@@ -959,6 +993,11 @@ class DenseCrdt:
             def value_at(i):
                 return None if tomb[i] else int(val[i])
 
+            # Both callers (`_merge_columns` and the C wire-scan path)
+            # collapse duplicate slots last-wins before reaching here,
+            # so a queried slot matches AT MOST one payload entry —
+            # the get callback can never answer with a losing
+            # occurrence's value (ChangeHub.add_batch's contract).
             self._hub.add_batch(
                 lambda: ([int(slots[i]) for i in widx],
                          [value_at(i) for i in widx]),

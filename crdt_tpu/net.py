@@ -31,6 +31,15 @@ ops above remain the universal interop path.
     server -> client  {"meta": {...lanes...}}
     server -> client  <raw binary frame>
 
+Error replies carry a structured ``code`` ("merge_rejected",
+"delta_failed", "dense_rejected", "unknown_op") plus the server-side
+exception name/detail. Client-side, the sync functions raise a split
+taxonomy: :class:`SyncTransportError` for link faults (retryable —
+rounds are idempotent) and :class:`SyncProtocolError` for peer
+rejections (fatal; for dense ops, fall back to the JSON path). The
+gossip runtime (`crdt_tpu.gossip`) keys its retry/backoff/breaker
+and dense→JSON fallback decisions off exactly this split.
+
 Threading model: replicas are single-threaded state machines (same
 contract as the reference's isolate model — see SqliteCrdt's notes).
 The server serializes ALL replica access through :attr:`SyncServer.lock`;
@@ -58,18 +67,77 @@ from .hlc import Hlc
 MAX_FRAME_BYTES = 1 << 30
 
 
-def send_frame(sock: socket.socket, obj: Any) -> None:
+class SyncError(ConnectionError):
+    """A sync round failed. Subclasses split the taxonomy the gossip
+    runtime retries on: transport faults (retryable — the lattice join
+    is idempotent, replaying a round is always safe) vs. protocol
+    rejections (fatal — the peer understood the round and refused it,
+    so replaying the same bytes cannot succeed). Kept a
+    `ConnectionError` so pre-taxonomy callers' handlers still fire."""
+
+
+class SyncTransportError(SyncError):
+    """The LINK failed: refused/reset connection, timeout, EOF
+    mid-frame, framing violation, or a reply desynchronized from the
+    request stream. Nothing says the peer rejected the round — retry
+    with backoff."""
+
+
+class SyncProtocolError(SyncError):
+    """The PEER rejected the round: a clock guard tripped, the op is
+    unknown, or the dense wire form is unsupported/incompatible.
+    ``code`` is the server's structured reason (see
+    :class:`SyncServer`), ``error``/``detail`` the exception it maps
+    from. Do not retry; for dense ops, fall back to the universal
+    JSON path."""
+
+    def __init__(self, message: str, code: str = "rejected",
+                 error: Optional[str] = None,
+                 detail: Optional[str] = None):
+        super().__init__(message)
+        self.code = code
+        self.error = error
+        self.detail = detail
+
+    @classmethod
+    def from_reply(cls, what: str, reply: Any) -> "SyncProtocolError":
+        """Build from a server error reply, preserving the legacy
+        message shape (tests match on 'rejected: ...ExceptionName')."""
+        code, error, detail = "rejected", None, None
+        if isinstance(reply, dict):
+            code = reply.get("code", code)
+            error = reply.get("error")
+            detail = reply.get("detail")
+        return cls(f"{what}: {reply!r}", code=code, error=error,
+                   detail=detail)
+
+
+class WireTally:
+    """Mutable per-round byte counters (frame headers included) the
+    sync functions fill when given one — the gossip runtime's per-peer
+    ``bytes_sent``/``bytes_received`` accounting."""
+
+    __slots__ = ("sent", "received")
+
+    def __init__(self) -> None:
+        self.sent = 0
+        self.received = 0
+
+
+def send_frame(sock: socket.socket, obj: Any,
+               tally: Optional[WireTally] = None) -> None:
     """One JSON frame — the raw framing plus a dumps."""
-    send_bytes_frame(sock, [json.dumps(obj).encode()])
+    send_bytes_frame(sock, [json.dumps(obj).encode()], tally)
 
 
 def recv_frame(sock: socket.socket,
-               deadline: Optional[float] = None) -> Optional[Any]:
+               deadline: Optional[float] = None,
+               tally: Optional[WireTally] = None) -> Optional[Any]:
     """Receive one JSON frame; ``deadline`` (a ``time.monotonic()``
     value) bounds the WHOLE frame, not just each chunk — a peer
     trickling bytes inside the per-recv socket timeout cannot stretch
     past it."""
-    body = recv_bytes_frame(sock, deadline)
+    body = recv_bytes_frame(sock, deadline, tally)
     return None if body is None else json.loads(body)
 
 
@@ -97,7 +165,8 @@ def _recv_exact(sock: socket.socket, n: int,
     return bytes(buf)
 
 
-def send_bytes_frame(sock: socket.socket, bufs) -> None:
+def send_bytes_frame(sock: socket.socket, bufs,
+                     tally: Optional[WireTally] = None) -> None:
     """One length-prefixed RAW frame from a list of buffers — sent
     piecewise, never concatenated (a 100 MB delta must not allocate a
     second copy)."""
@@ -108,10 +177,13 @@ def send_bytes_frame(sock: socket.socket, bufs) -> None:
     sock.sendall(struct.pack(">I", total))
     for b in bufs:
         sock.sendall(b)
+    if tally is not None:
+        tally.sent += 4 + total
 
 
 def recv_bytes_frame(sock: socket.socket,
-                     deadline: Optional[float] = None
+                     deadline: Optional[float] = None,
+                     tally: Optional[WireTally] = None
                      ) -> Optional[bytes]:
     """Receive one RAW frame (no JSON decode)."""
     head = _recv_exact(sock, 4, deadline)
@@ -121,7 +193,10 @@ def recv_bytes_frame(sock: socket.socket,
     if n > MAX_FRAME_BYTES:
         raise ValueError(f"peer announced a {n}-byte frame (cap "
                          f"{MAX_FRAME_BYTES}); corrupt stream?")
-    return _recv_exact(sock, n, deadline)
+    body = _recv_exact(sock, n, deadline)
+    if body is not None and tally is not None:
+        tally.received += 4 + n
+    return body
 
 
 # Exact lane dtypes per split form — anything else from a peer is a
@@ -224,11 +299,17 @@ class SyncServer:
                  port: int = 0,
                  key_encoder=None, value_encoder=None,
                  key_decoder=None, value_decoder=None,
-                 max_ops: int = 1000, conn_deadline: float = 300.0):
+                 max_ops: int = 1000, conn_deadline: float = 300.0,
+                 io_timeout: float = 30.0):
         self.crdt = crdt
         self.lock = threading.Lock()
         self._max_ops = max_ops
         self._conn_deadline = conn_deadline
+        # Per-recv socket timeout AND the bound on a push_dense
+        # continuation frame: a client that announces a binary frame
+        # and never sends it holds the single-connection endpoint for
+        # at most this long, not until conn_deadline.
+        self._io_timeout = io_timeout
         # codec passthrough, mirroring sync.sync_json: replicas with
         # custom-typed keys/values need the same coders over TCP
         self._kenc, self._venc = key_encoder, value_encoder
@@ -303,7 +384,7 @@ class SyncServer:
                     self._active = None
 
     def _handle(self, conn: socket.socket) -> None:
-        conn.settimeout(30)
+        conn.settimeout(self._io_timeout)
         import time as _time
         deadline = _time.monotonic() + self._conn_deadline
         ops = 0
@@ -333,6 +414,7 @@ class SyncServer:
                     # clock guards (duplicate node, drift) reject the
                     # push; the server survives and tells the client
                     self._reply(conn, {"ok": False,
+                                       "code": "merge_rejected",
                                        "error": type(e).__name__,
                                        "detail": str(e)})
                     return
@@ -349,15 +431,22 @@ class SyncServer:
                             value_encoder=self._venc)
                 except Exception as e:
                     # e.g. an unparseable `since` watermark
-                    self._reply(conn, {"error": type(e).__name__,
+                    self._reply(conn, {"code": "delta_failed",
+                                       "error": type(e).__name__,
                                        "detail": str(e)})
                     return
                 if not self._reply(conn, {"payload": payload}):
                     return
             elif op == "push_dense":
-                # The meta frame is followed by ONE raw binary frame.
+                # The meta frame is followed by ONE raw binary frame,
+                # bounded by io_timeout (not the whole conn_deadline):
+                # a peer that announces a frame and goes silent must
+                # not hold the single-connection endpoint for minutes.
                 try:
-                    blob = recv_bytes_frame(conn, deadline=deadline)
+                    blob = recv_bytes_frame(
+                        conn, deadline=min(
+                            deadline,
+                            _time.monotonic() + self._io_timeout))
                 except (socket.timeout, OSError, ValueError):
                     return
                 if blob is None:
@@ -373,6 +462,7 @@ class SyncServer:
                         self.crdt.merge_split(scs, ids)
                 except Exception as e:
                     self._reply(conn, {"ok": False,
+                                       "code": "dense_rejected",
                                        "error": type(e).__name__,
                                        "detail": str(e)})
                     return
@@ -387,7 +477,8 @@ class SyncServer:
                     meta, bufs = _pack_split(scs)
                     meta_msg = {"meta": meta, "node_ids": list(ids)}
                 except Exception as e:
-                    self._reply(conn, {"error": type(e).__name__,
+                    self._reply(conn, {"code": "dense_rejected",
+                                       "error": type(e).__name__,
                                        "detail": str(e)})
                     return
                 if not self._reply(conn, meta_msg):
@@ -397,7 +488,8 @@ class SyncServer:
                 except (OSError, ValueError):
                     return
             else:
-                self._reply(conn, {"error": f"unknown op {op!r}"})
+                self._reply(conn, {"code": "unknown_op",
+                                   "error": f"unknown op {op!r}"})
                 return
 
     @staticmethod
@@ -411,12 +503,27 @@ class SyncServer:
             return False
 
 
+def _check_reply(what: str, reply: Any, want_field: str) -> None:
+    """Classify a reply frame: a peer that vanished or desynchronized
+    (None / missing field, no error report) is a TRANSPORT fault —
+    retryable; an explicit error report is a PROTOCOL rejection —
+    fatal. Preserves the legacy '<what>: <reply>' message shape."""
+    if isinstance(reply, dict) and want_field in reply \
+            and "error" not in reply:
+        return
+    if isinstance(reply, dict) and ("error" in reply
+                                    or reply.get("ok") is False):
+        raise SyncProtocolError.from_reply(what, reply)
+    raise SyncTransportError(f"{what}: {reply!r}")
+
+
 def sync_over_tcp(crdt: Crdt, host: str, port: int,
                   since: Optional[Hlc] = None,
                   timeout: float = 30.0,
                   key_encoder=None, value_encoder=None,
                   key_decoder=None, value_decoder=None,
-                  lock: Optional[threading.Lock] = None) -> Hlc:
+                  lock: Optional[threading.Lock] = None,
+                  tally: Optional[WireTally] = None) -> Hlc:
     """One anti-entropy round against a :class:`SyncServer`.
 
     ``since`` is this replica's delta watermark: pass None on first
@@ -434,6 +541,11 @@ def sync_over_tcp(crdt: Crdt, host: str, port: int,
     held only around local replica calls, never across network waits,
     so a gossiping mesh of self-served replicas cannot deadlock on
     each other's rounds.
+
+    Failures raise the :class:`SyncError` taxonomy: link faults as
+    retryable :class:`SyncTransportError`, peer rejections as fatal
+    :class:`SyncProtocolError` — both still `ConnectionError`.
+    ``tally``, when given, accumulates wire bytes for the round.
     """
     if lock is None:
         lock = threading.Lock()   # uncontended no-op
@@ -442,31 +554,42 @@ def sync_over_tcp(crdt: Crdt, host: str, port: int,
         payload = crdt.to_json(key_encoder=key_encoder,
                                value_encoder=value_encoder)
     import time as _time
-    with socket.create_connection((host, port), timeout=timeout) as sock:
-        sock.settimeout(timeout)
-        # Each reply frame is bounded WHOLE (not per recv chunk): a
-        # server trickling bytes can't hold the round open past
-        # ``timeout`` per frame.
-        send_frame(sock, {"op": "push", "payload": payload})
-        reply = recv_frame(sock, deadline=_time.monotonic() + timeout)
-        if not (reply and reply.get("ok")):
-            raise ConnectionError(f"push rejected: {reply!r}")
-        send_frame(sock, {"op": "delta",
-                          "since": None if since is None else str(since)})
-        reply = recv_frame(sock, deadline=_time.monotonic() + timeout)
-        if reply is None or "payload" not in reply:
-            raise ConnectionError(f"delta failed: {reply!r}")
-        with lock:
-            crdt.merge_json(reply["payload"], key_decoder=key_decoder,
-                            value_decoder=value_decoder)
-        send_frame(sock, {"op": "bye"})
+    try:
+        with socket.create_connection((host, port),
+                                      timeout=timeout) as sock:
+            sock.settimeout(timeout)
+            # Each reply frame is bounded WHOLE (not per recv chunk):
+            # a server trickling bytes can't hold the round open past
+            # ``timeout`` per frame.
+            send_frame(sock, {"op": "push", "payload": payload}, tally)
+            reply = recv_frame(sock,
+                               deadline=_time.monotonic() + timeout,
+                               tally=tally)
+            _check_reply("push rejected", reply, "ok")
+            send_frame(sock, {"op": "delta",
+                              "since": None if since is None
+                              else str(since)}, tally)
+            reply = recv_frame(sock,
+                               deadline=_time.monotonic() + timeout,
+                               tally=tally)
+            _check_reply("delta failed", reply, "payload")
+            pulled = reply["payload"]
+            with lock:
+                crdt.merge_json(pulled, key_decoder=key_decoder,
+                                value_decoder=value_decoder)
+            send_frame(sock, {"op": "bye"}, tally)
+    except SyncError:
+        raise
+    except (OSError, ValueError) as e:
+        raise SyncTransportError(f"sync round failed: {e!r}") from e
     return watermark
 
 
 def sync_dense_over_tcp(crdt, host: str, port: int,
                         since: Optional[Hlc] = None,
                         timeout: float = 30.0,
-                        lock: Optional[threading.Lock] = None) -> Hlc:
+                        lock: Optional[threading.Lock] = None,
+                        tally: Optional[WireTally] = None) -> Hlc:
     """One anti-entropy round between DENSE replicas in the kernel
     wire form: split 32-bit lanes as raw binary frames
     (`DenseCrdt.export_split_delta` / `merge_split`) — ~19 B per slot
@@ -488,28 +611,38 @@ def sync_dense_over_tcp(crdt, host: str, port: int,
         scs, ids = crdt.export_split_delta()
         meta, bufs = _pack_split(scs)
     import time as _time
-    with socket.create_connection((host, port), timeout=timeout) as sock:
-        sock.settimeout(timeout)
-        send_frame(sock, {"op": "push_dense", "meta": meta,
-                          "node_ids": list(ids)})
-        send_bytes_frame(sock, bufs)
-        reply = recv_frame(sock, deadline=_time.monotonic() + timeout)
-        if not (reply and reply.get("ok")):
-            raise ConnectionError(f"push rejected: {reply!r}")
-        send_frame(sock, {"op": "delta_dense",
-                          "since": None if since is None else str(since)})
-        reply = recv_frame(sock, deadline=_time.monotonic() + timeout)
-        if reply is None or "meta" not in reply:
-            raise ConnectionError(f"delta failed: {reply!r}")
-        blob = recv_bytes_frame(sock,
-                                deadline=_time.monotonic() + timeout)
-        if blob is None:
-            raise ConnectionError("delta binary frame missing")
-        peer_scs = _unpack_split(reply["meta"], blob)
-        ids_in = reply.get("node_ids")
-        if not isinstance(ids_in, list) or not ids_in:
-            raise ConnectionError("delta reply without node_ids")
-        with lock:
-            crdt.merge_split(peer_scs, ids_in)
-        send_frame(sock, {"op": "bye"})
+    try:
+        with socket.create_connection((host, port),
+                                      timeout=timeout) as sock:
+            sock.settimeout(timeout)
+            send_frame(sock, {"op": "push_dense", "meta": meta,
+                              "node_ids": list(ids)}, tally)
+            send_bytes_frame(sock, bufs, tally)
+            reply = recv_frame(sock,
+                               deadline=_time.monotonic() + timeout,
+                               tally=tally)
+            _check_reply("push rejected", reply, "ok")
+            send_frame(sock, {"op": "delta_dense",
+                              "since": None if since is None
+                              else str(since)}, tally)
+            reply = recv_frame(sock,
+                               deadline=_time.monotonic() + timeout,
+                               tally=tally)
+            _check_reply("delta failed", reply, "meta")
+            blob = recv_bytes_frame(sock,
+                                    deadline=_time.monotonic() + timeout,
+                                    tally=tally)
+            if blob is None:
+                raise SyncTransportError("delta binary frame missing")
+            peer_scs = _unpack_split(reply["meta"], blob)
+            ids_in = reply.get("node_ids")
+            if not isinstance(ids_in, list) or not ids_in:
+                raise SyncTransportError("delta reply without node_ids")
+            with lock:
+                crdt.merge_split(peer_scs, ids_in)
+            send_frame(sock, {"op": "bye"}, tally)
+    except SyncError:
+        raise
+    except (OSError, ValueError) as e:
+        raise SyncTransportError(f"sync round failed: {e!r}") from e
     return watermark

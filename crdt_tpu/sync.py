@@ -23,35 +23,52 @@ from __future__ import annotations
 from typing import Optional
 
 from .crdt import Crdt
+from .hlc import Hlc
 from .record import (KeyDecoder, KeyEncoder, ValueDecoder, ValueEncoder)
 
+# Default for ``since``: pull from the SAME round's pre-push canonical
+# time — the reference's one-shot `_sync` shape. Distinct from None,
+# which (matching `sync_over_tcp`) requests a cold-start FULL pull.
+_SAME_ROUND = object()
 
-def sync(local: Crdt, remote: Crdt) -> None:
+
+def sync(local: Crdt, remote: Crdt, since=_SAME_ROUND) -> Hlc:
     """One push/pull anti-entropy round between two in-process replicas.
 
     After a round in each direction (or one round plus a later reverse
     round) the two replicas converge; N replicas converge through any
-    connected gossip topology."""
-    time = local.canonical_time
+    connected gossip topology.
+
+    ``since`` aligns this with :func:`crdt_tpu.net.sync_over_tcp`'s
+    watermark contract: omit it for the reference's one-shot round
+    (pull bounded by this round's pre-push canonical time), pass
+    ``None`` for a cold-start full pull, or pass the watermark a
+    previous round returned to resume delta sync."""
+    watermark = local.canonical_time
     remote.merge(local.record_map())
-    local.merge(remote.record_map(modified_since=time))
+    local.merge(remote.record_map(
+        modified_since=watermark if since is _SAME_ROUND else since))
+    return watermark
 
 
 def sync_json(local: Crdt, remote: Crdt,
               key_encoder: Optional[KeyEncoder] = None,
               value_encoder: Optional[ValueEncoder] = None,
               key_decoder: Optional[KeyDecoder] = None,
-              value_decoder: Optional[ValueDecoder] = None) -> None:
+              value_decoder: Optional[ValueDecoder] = None,
+              since=_SAME_ROUND) -> Hlc:
     """The same round over the JSON wire format — full-state push, then
     delta pull keyed on the pre-push canonical time (crdt.dart:124-135).
-    """
-    time = local.canonical_time
+    ``since`` follows :func:`sync`'s watermark contract."""
+    watermark = local.canonical_time
     remote.merge_json(local.to_json(key_encoder=key_encoder,
                                     value_encoder=value_encoder),
                       key_decoder=key_decoder,
                       value_decoder=value_decoder)
-    local.merge_json(remote.to_json(modified_since=time,
-                                    key_encoder=key_encoder,
-                                    value_encoder=value_encoder),
-                     key_decoder=key_decoder,
-                     value_decoder=value_decoder)
+    local.merge_json(remote.to_json(
+        modified_since=watermark if since is _SAME_ROUND else since,
+        key_encoder=key_encoder,
+        value_encoder=value_encoder),
+        key_decoder=key_decoder,
+        value_decoder=value_decoder)
+    return watermark

@@ -17,6 +17,10 @@ from __future__ import annotations
 import itertools
 
 from crdt_tpu import Crdt
+# Fault-injection siblings of this kit: a backend proves CONFORMANCE
+# here, and proves ROBUSTNESS against the scheduled-misbehavior proxy.
+from crdt_tpu.testing_faults import (FaultProxy, FaultSchedule,  # noqa: F401
+                                     ScriptedSchedule)
 
 
 class FakeClock:

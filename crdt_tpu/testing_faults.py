@@ -1,0 +1,317 @@
+"""Fault-injection TCP proxy for replication testing — EXPORTED API.
+
+Sits between a sync client and a :class:`crdt_tpu.net.SyncServer` and
+misbehaves on a SEEDED schedule: refuse connections, delay or trickle
+bytes, truncate a frame mid-body, corrupt payload bytes, duplicate a
+whole frame. The gossip runtime (`crdt_tpu.gossip`) must converge
+through all of it — that is the robustness claim the fault-matrix
+soak (tests/test_network_soak.py) makes, and this proxy is what makes
+the claim falsifiable.
+
+Faults are applied to the client→server byte stream (the direction
+that carries pushes and requests); the reply stream is forwarded
+verbatim. Every fault surfaces to a well-behaved client as either an
+EOF/desync (retryable transport fault) or a server-side rejection —
+never as silent corruption: a corrupted byte XORs to an invalid UTF-8
+sequence, so a damaged JSON frame fails to decode instead of parsing
+to different records.
+
+>>> with SyncServer(crdt) as server:
+...     proxy = FaultProxy(server.host, server.port,
+...                        FaultSchedule(seed=7)).start()
+...     sync_over_tcp(other, proxy.host, proxy.port)   # may fault!
+...     proxy.counters                                 # what fired
+...     proxy.stop()
+
+`FaultSchedule` draws one fault (or none) per CONNECTION from a
+seeded rng; `ScriptedSchedule` replays an explicit list — unit tests
+use it to script "refuse once, then behave". Set
+:attr:`FaultProxy.passthrough` True to disable faulting (the soak's
+settle phase) without tearing down the proxy.
+"""
+
+from __future__ import annotations
+
+import random
+import socket
+import struct
+import threading
+import time
+from typing import Dict, Iterable, Optional
+
+# Corruption XOR mask: flips the high bit of any ASCII byte, yielding
+# an invalid UTF-8 sequence — corrupt JSON always FAILS to decode
+# rather than decoding to different data.
+_CORRUPT_MASK = 0xA5
+
+# A frame larger than this is passed through un-duplicated rather than
+# buffered (the duplicate fault is frame-aware and must not hold a
+# 100 MB push in memory).
+_DUP_FRAME_CAP = 1 << 20
+
+
+def _teardown(sock: socket.socket) -> None:
+    """shutdown + close: the shutdown forces the FIN out (and wakes
+    any thread blocked in recv on the socket) even while another
+    in-flight syscall keeps the kernel file referenced — a bare
+    close() in that state notifies nobody."""
+    for call in (lambda: sock.shutdown(socket.SHUT_RDWR), sock.close):
+        try:
+            call()
+        except OSError:
+            pass
+
+
+class FaultSchedule:
+    """Seeded per-connection fault plan.
+
+    ``rate`` is the probability a connection faults at all; ``kinds``
+    weights the fault drawn when one does. Defaults exercise the whole
+    matrix. Deterministic for a fixed seed and connection order."""
+
+    DEFAULT_KINDS = {"drop": 2, "delay": 2, "trickle": 1,
+                     "truncate": 2, "corrupt": 2, "duplicate": 1}
+
+    def __init__(self, seed: int = 0, rate: float = 0.5,
+                 kinds: Optional[Dict[str, float]] = None,
+                 max_delay: float = 0.05):
+        self._rng = random.Random(seed)
+        self.rate = rate
+        self.kinds = dict(kinds if kinds is not None
+                          else self.DEFAULT_KINDS)
+        self.max_delay = max_delay
+
+    def next_fault(self) -> Optional[dict]:
+        rng = self._rng
+        if rng.random() >= self.rate:
+            return None
+        names = sorted(self.kinds)
+        kind = rng.choices(names,
+                           weights=[self.kinds[n] for n in names])[0]
+        if kind == "delay":
+            return {"kind": kind,
+                    "seconds": rng.uniform(0.0, self.max_delay)}
+        if kind == "truncate":
+            # Inside the first frame's header-or-body for any real
+            # payload, so the cut is mid-frame, not between frames.
+            return {"kind": kind, "after": rng.randrange(1, 40)}
+        if kind == "corrupt":
+            # Past the 4-byte length prefix: framing stays intact and
+            # the DAMAGE lands in the body, where it must be caught by
+            # decode, not by a misread frame length.
+            return {"kind": kind, "offset": rng.randrange(4, 160)}
+        return {"kind": kind}
+
+
+class ScriptedSchedule:
+    """Replays an explicit fault sequence, one entry per connection
+    (None = behave); after the script runs out, behaves forever."""
+
+    def __init__(self, plan: Iterable[Optional[dict]]):
+        self._plan = list(plan)
+        self._i = 0
+
+    def next_fault(self) -> Optional[dict]:
+        if self._i >= len(self._plan):
+            return None
+        fault = self._plan[self._i]
+        self._i += 1
+        return fault
+
+
+class FaultProxy:
+    """TCP proxy with scheduled misbehavior (see module docstring).
+
+    ``counters`` maps fault kind → times it actually FIRED (a
+    truncate-at-1000 against a 40-byte stream never fires and is not
+    counted), plus ``"connections"``. The soak asserts on these to
+    prove its faults happened."""
+
+    def __init__(self, target_host: str, target_port: int,
+                 schedule=None,
+                 host: str = "127.0.0.1", port: int = 0):
+        self.target_host = target_host
+        self.target_port = target_port
+        self.schedule = schedule or FaultSchedule()
+        self.passthrough = False
+        self.counters: Dict[str, int] = {}
+        self._counter_lock = threading.Lock()
+        self._lsock = socket.create_server((host, port))
+        self._lsock.settimeout(0.2)   # poll the stop flag
+        self.host, self.port = self._lsock.getsockname()[:2]
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self._open: set = set()       # sockets to tear down on stop
+
+    # --- lifecycle (SyncServer's shape) ---
+
+    def start(self) -> "FaultProxy":
+        self._thread = threading.Thread(target=self._serve, daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        for sock in list(self._open):
+            _teardown(sock)
+        if self._thread is not None:
+            self._thread.join(timeout=10)
+        self._lsock.close()
+
+    def __enter__(self) -> "FaultProxy":
+        return self.start()
+
+    def __exit__(self, *exc) -> None:
+        self.stop()
+
+    def _count(self, key: str) -> None:
+        with self._counter_lock:
+            self.counters[key] = self.counters.get(key, 0) + 1
+
+    # --- relay ---
+
+    def _serve(self) -> None:
+        while not self._stop.is_set():
+            try:
+                conn, _ = self._lsock.accept()
+            except socket.timeout:
+                continue
+            except OSError:
+                return
+            threading.Thread(target=self._relay, args=(conn,),
+                             daemon=True).start()
+
+    def _relay(self, conn: socket.socket) -> None:
+        self._count("connections")
+        fault = (None if self.passthrough
+                 else self.schedule.next_fault())
+        if fault is not None and fault["kind"] == "drop":
+            # Accept-then-slam: the client sees a vanished peer.
+            self._count("drop")
+            conn.close()
+            return
+        try:
+            up = socket.create_connection(
+                (self.target_host, self.target_port), timeout=10)
+        except OSError:
+            conn.close()
+            return
+        self._open.update((conn, up))
+        conn.settimeout(60)
+        up.settimeout(60)
+        if fault is not None and fault["kind"] == "delay":
+            self._count("delay")
+            time.sleep(fault["seconds"])
+        reply_pump = threading.Thread(
+            target=self._pump_verbatim, args=(up, conn), daemon=True)
+        reply_pump.start()
+        try:
+            self._pump_faulty(conn, up, fault)
+        finally:
+            # shutdown() BEFORE close(): close alone does not send the
+            # FIN while the reply pump still holds a blocked recv on
+            # the socket (the in-flight syscall keeps the kernel file
+            # alive), and the un-notified server would park its
+            # single-connection handler in a 30 s recv — starving the
+            # client's own retry connection.
+            for sock in (conn, up):
+                self._open.discard(sock)
+                _teardown(sock)
+            reply_pump.join(timeout=10)
+
+    def _pump_verbatim(self, src: socket.socket,
+                       dst: socket.socket) -> None:
+        """Server→client direction: faithful forwarding. A close from
+        the server is PROPAGATED (shutdown of the client's read side):
+        a client waiting for a reply the server will never send must
+        see EOF now, not its whole round timeout later."""
+        try:
+            while True:
+                data = src.recv(1 << 16)
+                if not data:
+                    return
+                dst.sendall(data)
+        except OSError:
+            return
+        finally:
+            try:
+                dst.shutdown(socket.SHUT_WR)
+            except OSError:
+                pass
+
+    def _pump_faulty(self, src: socket.socket, dst: socket.socket,
+                     fault: Optional[dict]) -> None:
+        """Client→server direction with the scheduled fault applied."""
+        kind = fault["kind"] if fault is not None else None
+        sent = 0
+        try:
+            if kind == "duplicate":
+                sent = self._duplicate_first_frame(src, dst)
+            while True:
+                data = src.recv(1 << 16)
+                if not data:
+                    return
+                if kind == "truncate":
+                    cut = fault["after"] - sent
+                    if cut < len(data):
+                        # Forward a prefix, then kill both ends: the
+                        # server holds a partial frame, the client a
+                        # dead socket.
+                        self._count("truncate")
+                        if cut > 0:
+                            dst.sendall(data[:cut])
+                        return
+                elif kind == "corrupt":
+                    off = fault["offset"] - sent
+                    if 0 <= off < len(data):
+                        self._count("corrupt")
+                        damaged = bytearray(data)
+                        damaged[off] ^= _CORRUPT_MASK
+                        data = bytes(damaged)
+                elif kind == "trickle" and sent < 64:
+                    # Drip the first bytes through one at a time —
+                    # exercises every whole-frame deadline bound.
+                    if sent == 0:
+                        self._count("trickle")
+                    for i in range(len(data)):
+                        dst.sendall(data[i:i + 1])
+                        if sent + i < 64:
+                            time.sleep(0.002)
+                    sent += len(data)
+                    continue
+                dst.sendall(data)
+                sent += len(data)
+        except OSError:
+            return
+
+    def _duplicate_first_frame(self, src: socket.socket,
+                               dst: socket.socket) -> int:
+        """Read the first length-prefixed frame whole and send it
+        TWICE — the server processes one request twice and the client's
+        reply stream desynchronizes (a retryable fault, since rounds
+        are idempotent). Returns bytes forwarded (the original's)."""
+        head = self._read_exact(src, 4)
+        if head is None:
+            return 0
+        (n,) = struct.unpack(">I", head)
+        if n > _DUP_FRAME_CAP:
+            dst.sendall(head)
+            return 4
+        body = self._read_exact(src, n)
+        if body is None:
+            dst.sendall(head)
+            return 4
+        self._count("duplicate")
+        dst.sendall(head + body)
+        dst.sendall(head + body)
+        return 4 + n
+
+    @staticmethod
+    def _read_exact(sock: socket.socket, n: int) -> Optional[bytes]:
+        buf = bytearray()
+        while len(buf) < n:
+            chunk = sock.recv(n - len(buf))
+            if not chunk:
+                return None
+            buf += chunk
+        return bytes(buf)

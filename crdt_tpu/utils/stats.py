@@ -80,6 +80,42 @@ class MergeStats:
             setattr(self, k, 0)
 
 
+@dataclass
+class PeerSyncStats:
+    """Per-peer counters for the gossip runtime (`crdt_tpu.gossip`).
+
+    One instance per `Peer`; every field is a plain host int so a
+    monitoring loop can snapshot `as_dict()` without touching the
+    replica. ``retries`` counts re-attempts after transport faults
+    (first attempts are not retries); ``fallbacks`` counts dense→JSON
+    wire-form downgrades; the ``breaker_*`` fields count state
+    TRANSITIONS, so a soak can prove the breaker actually cycled."""
+    rounds_ok: int = 0         # completed anti-entropy rounds
+    rounds_failed: int = 0     # rounds abandoned (retries exhausted
+    #                            or fatal protocol rejection)
+    skipped: int = 0           # rounds refused locally: breaker open
+    retries: int = 0           # transport-fault re-attempts
+    fallbacks: int = 0         # dense wire form downgraded to JSON
+    full_pulls: int = 0        # rounds pulled with since=None
+    delta_pulls: int = 0       # rounds pulled from a watermark
+    bytes_sent: int = 0        # wire bytes out, frame headers included
+    bytes_received: int = 0    # wire bytes in, frame headers included
+    breaker_opened: int = 0
+    breaker_half_open: int = 0
+    breaker_closed: int = 0
+
+    def as_dict(self) -> dict:
+        return {f: getattr(self, f) for f in (
+            "rounds_ok", "rounds_failed", "skipped", "retries",
+            "fallbacks", "full_pulls", "delta_pulls", "bytes_sent",
+            "bytes_received", "breaker_opened", "breaker_half_open",
+            "breaker_closed")}
+
+    def reset(self) -> None:
+        for f in self.as_dict():
+            setattr(self, f, 0)
+
+
 @contextmanager
 def merge_annotation(name: str = "crdt_tpu.merge"):
     """Named span around a merge dispatch for TPU profile traces."""

@@ -1,0 +1,329 @@
+"""Resilient gossip runtime (crdt_tpu.gossip): bounded retry with
+jittered backoff, the per-peer circuit breaker, durable watermark
+resume, and dense→JSON wire degradation — driven over real sockets,
+with the fault proxy (crdt_tpu.testing_faults) injecting the failures
+the runtime claims to survive."""
+
+import random
+import socket
+import threading
+
+import pytest
+
+from crdt_tpu import (BreakerPolicy, CircuitBreaker, DenseCrdt,
+                      GossipNode, MapCrdt, RetryPolicy, SqliteCrdt,
+                      load_gossip_state)
+from crdt_tpu.checkpoint import save_gossip_state
+from crdt_tpu.testing import (FakeClock, FaultProxy, FaultSchedule,
+                              ScriptedSchedule)
+
+NO_SLEEP = lambda _s: None   # collapse backoff waits in tests
+
+
+class MonotonicStub:
+    """Injectable seconds clock for breaker cool-down tests."""
+
+    def __init__(self):
+        self.now = 100.0
+
+    def __call__(self) -> float:
+        return self.now
+
+    def advance(self, seconds: float) -> None:
+        self.now += seconds
+
+
+def _dead_port() -> int:
+    """A port nothing is listening on (bind, read, close)."""
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+# --- RetryPolicy ---
+
+def test_retry_delay_full_jitter_bounds():
+    policy = RetryPolicy(max_attempts=6, base_delay=0.1, max_delay=1.0)
+    rng = random.Random(42)
+    for attempt in range(1, 8):
+        cap = min(policy.max_delay,
+                  policy.base_delay * (2 ** attempt))
+        draws = [policy.delay(attempt, rng) for _ in range(200)]
+        assert all(0.0 <= d <= cap for d in draws)
+        # FULL jitter, not equal jitter: the low half of the range
+        # must actually be drawn (spreads retrying replicas apart)
+        assert min(draws) < cap / 2
+
+
+# --- CircuitBreaker state machine ---
+
+def test_breaker_opens_after_threshold_and_probes():
+    clk = MonotonicStub()
+    br = CircuitBreaker(BreakerPolicy(failure_threshold=3,
+                                      reset_timeout=30.0), clock=clk)
+    for _ in range(2):
+        br.record_failure()
+        assert br.state == CircuitBreaker.CLOSED and br.allow()
+    br.record_failure()                      # third consecutive: open
+    assert br.state == CircuitBreaker.OPEN
+    assert not br.allow()
+    clk.advance(29.0)
+    assert not br.allow()                    # cool-down not elapsed
+    clk.advance(2.0)
+    assert br.allow()                        # one probe allowed
+    assert br.state == CircuitBreaker.HALF_OPEN
+    br.record_success()
+    assert br.state == CircuitBreaker.CLOSED
+    assert br.failures == 0
+
+
+def test_breaker_failed_probe_reopens():
+    clk = MonotonicStub()
+    br = CircuitBreaker(BreakerPolicy(failure_threshold=2,
+                                      reset_timeout=10.0), clock=clk)
+    br.record_failure()
+    br.record_failure()
+    clk.advance(11.0)
+    assert br.allow() and br.state == CircuitBreaker.HALF_OPEN
+    br.record_failure()                      # probe failed
+    assert br.state == CircuitBreaker.OPEN
+    assert not br.allow()                    # a fresh cool-down starts
+    clk.advance(11.0)
+    assert br.allow()
+
+
+def test_breaker_success_resets_consecutive_count():
+    br = CircuitBreaker(BreakerPolicy(failure_threshold=3,
+                                      reset_timeout=1.0),
+                        clock=MonotonicStub())
+    for _ in range(5):                       # fail, fail, success, ...
+        br.record_failure()
+        br.record_failure()
+        br.record_success()
+    assert br.state == CircuitBreaker.CLOSED  # never opened
+
+
+# --- GossipNode rounds ---
+
+def _node(crdt, **kw):
+    kw.setdefault("rng", random.Random(7))
+    kw.setdefault("sleep", NO_SLEEP)
+    return GossipNode(crdt, **kw)
+
+
+def test_two_nodes_converge_and_count_pull_kinds():
+    clk = FakeClock()
+    a = _node(MapCrdt("a", wall_clock=clk))
+    b = _node(MapCrdt("b", wall_clock=clk))
+    with a, b:
+        a.add_peer("b", b.host, b.port)
+        b.add_peer("a", a.host, a.port)
+        a.crdt.put("ka", 1)
+        b.crdt.put("kb", 2)
+        assert a.run_round() == {"b": "ok"}
+        assert b.run_round() == {"a": "ok"}
+        a.crdt.put("ka2", 3)
+        assert a.sync_peer("b") == "ok"
+        assert b.sync_peer("a") == "ok"
+    assert a.crdt.map == b.crdt.map == {"ka": 1, "kb": 2, "ka2": 3}
+    sa = a.stats_snapshot()["b"]
+    # first round is the cold-start full pull; every later one a delta
+    assert sa["full_pulls"] == 1 and sa["delta_pulls"] == 1
+    assert sa["rounds_ok"] == 2 and sa["rounds_failed"] == 0
+    assert sa["bytes_sent"] > 0 and sa["bytes_received"] > 0
+    assert sa["breaker"] == "closed"
+    assert sa["watermark"] is not None
+
+
+def test_transport_fault_is_retried_within_budget():
+    clk = FakeClock()
+    b = _node(MapCrdt("b", wall_clock=clk))
+    b.crdt.put("kb", 2)
+    with b:
+        sched = ScriptedSchedule([{"kind": "drop"}, None])
+        with FaultProxy(b.host, b.port, sched) as proxy:
+            a = _node(MapCrdt("a", wall_clock=clk),
+                      retry=RetryPolicy(max_attempts=3,
+                                        base_delay=0.001))
+            with a:
+                a.add_peer("b", proxy.host, proxy.port)
+                assert a.sync_peer("b") == "ok"
+            stats = a.peers["b"].stats
+            assert stats.retries == 1 and stats.rounds_ok == 1
+            assert proxy.counters.get("drop") == 1
+    assert a.crdt.get("kb") == 2
+
+
+def test_retry_budget_exhaustion_fails_and_trips_breaker():
+    clk = MonotonicStub()
+    a = _node(MapCrdt("a", wall_clock=FakeClock()),
+              retry=RetryPolicy(max_attempts=2, base_delay=0.001),
+              breaker=BreakerPolicy(failure_threshold=2,
+                                    reset_timeout=30.0),
+              clock=clk)
+    peer = a.add_peer("ghost", "127.0.0.1", _dead_port())
+    assert a.sync_peer("ghost") == "failed"
+    assert a.sync_peer("ghost") == "failed"      # second round: opens
+    assert peer.breaker.state == CircuitBreaker.OPEN
+    assert a.sync_peer("ghost") == "skipped"     # no network attempt
+    assert peer.stats.skipped == 1
+    assert peer.stats.retries == 2               # one retry per round
+    assert peer.stats.rounds_failed == 2
+    assert peer.stats.breaker_opened == 1
+    assert isinstance(peer.last_error, ConnectionError)
+    # cool-down elapses; the probe round finds a revived peer
+    clk.advance(31.0)
+    live = _node(MapCrdt("b", wall_clock=FakeClock()))
+    with live:
+        peer.host, peer.port = live.host, live.port
+        assert a.sync_peer("ghost") == "ok"
+    assert peer.breaker.state == CircuitBreaker.CLOSED
+    assert peer.stats.breaker_half_open == 1
+    assert peer.stats.breaker_closed == 1
+
+
+def test_background_loop_converges(tmp_path):
+    clk = FakeClock()
+    a = GossipNode(MapCrdt("a", wall_clock=clk))
+    b = GossipNode(MapCrdt("b", wall_clock=clk))
+    try:
+        a.start(gossip_interval=0.02)
+        b.start(gossip_interval=0.02)
+        a.add_peer("b", b.host, b.port)
+        b.add_peer("a", a.host, a.port)
+        with a.lock:
+            a.crdt.put("ka", 1)
+        with b.lock:
+            b.crdt.put("kb", 2)
+        import time
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline:
+            with a.lock:
+                done = a.crdt.map == {"ka": 1, "kb": 2}
+            if done:
+                break
+            time.sleep(0.02)
+    finally:
+        a.stop()
+        b.stop()
+    assert a.crdt.map == b.crdt.map == {"ka": 1, "kb": 2}
+
+
+# --- watermark persistence / crash resume ---
+
+def test_restart_resumes_delta_from_persisted_watermark(tmp_path):
+    state = str(tmp_path / "a.gossip.json")
+    db = str(tmp_path / "a.db")
+    clk = FakeClock()
+    b = _node(MapCrdt("b", wall_clock=clk))
+    b.crdt.put("early", 1)
+    with b:
+        # first incarnation: durable replica + durable watermarks
+        a = _node(SqliteCrdt("a", db, wall_clock=clk,
+                             check_same_thread=False),
+                  state_path=state)
+        with a:
+            a.add_peer("b", b.host, b.port)
+            assert a.sync_peer("b") == "ok"
+            assert a.peers["b"].stats.full_pulls == 1
+        marks = load_gossip_state(state, "a")
+        assert str(marks["b"]) == \
+            a.stats_snapshot()["b"]["watermark"]
+
+        # node "a" dies; the world moves on
+        b.crdt.put("while_down", 2)
+
+        # second incarnation: same replica file, same state file
+        a2 = _node(SqliteCrdt("a", db, wall_clock=clk,
+                              check_same_thread=False),
+                   state_path=state)
+        with a2:
+            a2.add_peer("b", b.host, b.port)
+            assert a2.peers["b"].watermark is not None  # resumed
+            assert a2.sync_peer("b") == "ok"
+            stats = a2.peers["b"].stats
+            # the resumed round is a DELTA pull, not a full re-pull
+            assert stats.full_pulls == 0
+            assert stats.delta_pulls == 1
+    assert a2.crdt.map == {"early": 1, "while_down": 2}
+
+
+def test_foreign_state_file_rejected(tmp_path):
+    state = str(tmp_path / "gossip.json")
+    from crdt_tpu import Hlc
+    save_gossip_state(state, "somebody_else",
+                      {"b": Hlc(1_700_000_000_000, 0, "b")})
+    with pytest.raises(ValueError, match="somebody_else"):
+        GossipNode(MapCrdt("a", wall_clock=FakeClock()),
+                   state_path=state)
+
+
+# --- dense→JSON wire degradation ---
+
+def test_dense_peer_pair_stays_dense():
+    clk = FakeClock()
+    a = _node(DenseCrdt("a", 64, wall_clock=clk))
+    b = _node(DenseCrdt("b", 64, wall_clock=clk))
+    assert a.prefer_dense and b.prefer_dense
+    with a, b:
+        a.add_peer("b", b.host, b.port)
+        a.crdt.put_batch([1, 2], [10, 20])
+        assert a.sync_peer("b") == "ok"
+        assert a.peers["b"].dense is True
+        assert a.peers["b"].stats.fallbacks == 0
+    assert b.crdt.get(1) == 10 and b.crdt.get(2) == 20
+
+
+def test_dense_rejection_downgrades_sticky_to_json():
+    clk = FakeClock()
+    a = _node(DenseCrdt("a", 64, wall_clock=clk))
+    # a JSON-only peer (MapCrdt cannot merge_split)
+    b = _node(MapCrdt("b", wall_clock=clk), key_decoder=int)
+    with a, b:
+        a.add_peer("b", b.host, b.port)
+        a.crdt.put_batch([3], [30])
+        b.crdt.put(8, 80)
+        assert a.sync_peer("b") == "ok"      # fell back within the round
+        peer = a.peers["b"]
+        assert peer.dense is False           # sticky downgrade
+        assert peer.stats.fallbacks == 1
+        assert peer.stats.rounds_ok == 1 and peer.stats.retries == 0
+        # subsequent rounds go straight to JSON — no second fallback
+        a.crdt.put_batch([4], [40])
+        assert a.sync_peer("b") == "ok"
+        assert peer.stats.fallbacks == 1
+    assert b.crdt.get(3) == 30 and b.crdt.get(4) == 40
+    assert a.crdt.get(8) == 80
+
+
+# --- faulty-link convergence (the tier-1 slice of the soak) ---
+
+def test_convergence_through_seeded_fault_proxy():
+    clk = FakeClock()
+    a = _node(MapCrdt("a", wall_clock=clk),
+              retry=RetryPolicy(max_attempts=6, base_delay=0.001,
+                                max_delay=0.01),
+              breaker=BreakerPolicy(failure_threshold=50))
+    b = _node(MapCrdt("b", wall_clock=clk))
+    with a, b:
+        sched = FaultSchedule(seed=11, rate=0.7, max_delay=0.01)
+        with FaultProxy(b.host, b.port, sched) as proxy:
+            a.add_peer("b", proxy.host, proxy.port)
+            for i in range(6):
+                with a.lock:
+                    a.crdt.put(f"ka{i}", i)
+                with b.lock:
+                    b.crdt.put(f"kb{i}", i)
+                a.sync_peer("b")
+            proxy.passthrough = True         # settle: faults off
+            assert a.sync_peer("b") == "ok"
+            assert a.sync_peer("b") == "ok"
+            fired = {k: v for k, v in proxy.counters.items()
+                     if k != "connections"}
+            assert sum(fired.values()) > 0, \
+                f"no faults fired: {proxy.counters}"
+    want = {f"ka{i}": i for i in range(6)}
+    want.update({f"kb{i}": i for i in range(6)})
+    assert a.crdt.map == b.crdt.map == want
+    stats = a.peers["b"].stats
+    assert stats.retries > 0                 # the runtime earned it

@@ -465,3 +465,26 @@ class TestDenseBinarySync:
         with SyncServer(b) as server:
             sync_dense_over_tcp(a, server.host, server.port)
         assert b.get(3) == -33
+
+    def test_push_dense_meta_without_binary_frame_is_bounded(self):
+        # A peer announces push_dense and goes silent before the
+        # binary frame: io_timeout (not the 300 s conn_deadline) must
+        # reclaim the single-connection endpoint.
+        import socket as socket_mod
+        import time
+        from crdt_tpu.net import SyncServer, send_frame, sync_dense_over_tcp
+        b = self._dense("nb")
+        with SyncServer(b, io_timeout=0.3) as server:
+            with socket_mod.create_connection(
+                    (server.host, server.port), timeout=10) as sock:
+                sock.settimeout(5)
+                send_frame(sock, {"op": "push_dense", "node_ids": ["x"],
+                                  "meta": {"form": "split", "lanes": []}})
+                t0 = time.monotonic()
+                assert sock.recv(1) == b""     # dropped, no reply
+                assert time.monotonic() - t0 < 2.0
+            # the endpoint serves the next (well-behaved) peer
+            a = self._dense("na")
+            a.put_batch([1], [10])
+            sync_dense_over_tcp(a, server.host, server.port)
+        assert b.get(1) == 10

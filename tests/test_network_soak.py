@@ -14,6 +14,7 @@ export equality is checked key-sorted)."""
 
 import json
 import random
+import time
 
 import pytest
 
@@ -164,3 +165,168 @@ def test_dense_binary_gossip_mesh_converges(seed):
                 np.asarray(getattr(base.store, lane))[mask],
                 np.asarray(getattr(other.store, lane))[mask],
                 err_msg=lane)
+
+
+@pytest.mark.slow
+@pytest.mark.soak
+@pytest.mark.parametrize("seed", [0, 1])
+def test_fault_matrix_gossip_soak(seed, tmp_path):
+    """The robustness claim end-to-end: a 4-backend GossipNode mesh
+    where EVERY link runs through a fault proxy on a seeded schedule
+    (drops, delays, trickles, truncations, corruptions, duplicated
+    frames), interleaved with random local writes and a mid-soak
+    kill-and-restart of the durable node — which must resume with a
+    DELTA pull from its persisted watermark, not a full re-pull. After
+    a passthrough settle phase all replicas hold byte-identical
+    key-sorted wire exports, and the retry/fault counters prove the
+    faults actually fired."""
+    from crdt_tpu import BreakerPolicy, GossipNode, RetryPolicy
+    from crdt_tpu.testing import FaultProxy, FaultSchedule
+
+    rng = random.Random(1000 + seed)
+    clk = FakeClock(step=3)
+    db = str(tmp_path / "lite.db")
+    state = str(tmp_path / "lite.gossip.json")
+    retry = RetryPolicy(max_attempts=5, base_delay=0.001,
+                        max_delay=0.02)
+    breaker = BreakerPolicy(failure_threshold=3, reset_timeout=0.05)
+
+    def mk_node(crdt):
+        return GossipNode(crdt, retry=retry, breaker=breaker,
+                          rng=random.Random(seed))
+
+    nodes = {
+        "oracle": mk_node(MapCrdt("oracle", wall_clock=clk)),
+        "tpu": mk_node(TpuMapCrdt("tpu", wall_clock=clk)),
+        "lite": GossipNode(SqliteCrdt("lite", db, wall_clock=clk,
+                                      check_same_thread=False),
+                           retry=retry, breaker=breaker,
+                           rng=random.Random(seed), state_path=state),
+        "dense": mk_node(KeyedDenseCrdt(
+            DenseCrdt("dense", 64, wall_clock=clk))),
+    }
+    names = sorted(nodes)
+    proxies = {}
+    try:
+        for name, node in nodes.items():
+            node.start()
+            proxies[name] = FaultProxy(
+                node.host, node.port,
+                FaultSchedule(seed=seed * 31 + len(proxies),
+                              rate=0.5, max_delay=0.01)).start()
+        for name, node in nodes.items():
+            for other in names:
+                if other != name:
+                    node.add_peer(other, proxies[other].host,
+                                  proxies[other].port)
+
+        def soak_steps(count):
+            for _ in range(count):
+                name = rng.choice(sorted(nodes))   # live nodes only
+                node = nodes[name]
+                op = rng.random()
+                with node.lock:
+                    if op < 0.45:
+                        node.crdt.put(rng.choice(KEYS),
+                                      rng.randrange(1000))
+                    elif op < 0.60:
+                        node.crdt.delete(rng.choice(KEYS))
+                    elif op < 0.68:
+                        node.crdt.put_all(
+                            {rng.choice(KEYS): rng.randrange(1000)
+                             for _ in range(rng.randrange(1, 5))})
+                if op >= 0.68:
+                    peer = rng.choice([n for n in names if n != name])
+                    node.sync_peer(peer)
+
+        def settled_round(node):
+            # passthrough leaves only breaker cool-downs between us
+            # and an all-ok sweep
+            for _ in range(50):
+                if all(v == "ok" for v in node.run_round().values()):
+                    return
+                time.sleep(0.05)
+            raise AssertionError(
+                f"mesh did not settle: {node.stats_snapshot()}")
+
+        soak_steps(60)
+
+        # make sure the durable node holds a watermark for every peer
+        # before it "crashes"
+        for _ in range(50):
+            if all(v == "ok"
+                   for v in nodes["lite"].run_round().values()):
+                break
+            time.sleep(0.02)
+        assert all(p.watermark is not None
+                   for p in nodes["lite"].peers.values())
+
+        # kill the durable node; the world keeps writing and gossiping
+        nodes["lite"].stop()
+        nodes["lite"].crdt.close()
+        lite_port = nodes["lite"].port
+        del nodes["lite"]
+        # trip a breaker against the dead peer, deterministically
+        for _ in range(breaker.failure_threshold):
+            nodes["oracle"].sync_peer("lite")
+        assert nodes["oracle"].peers["lite"].stats.breaker_opened >= 1
+        soak_steps(25)
+
+        # restart: same replica file, same watermark file, same port
+        # (the proxies keep targeting it)
+        lite = GossipNode(SqliteCrdt("lite", db, wall_clock=clk,
+                                     check_same_thread=False),
+                          port=lite_port, retry=retry, breaker=breaker,
+                          rng=random.Random(seed), state_path=state)
+        nodes["lite"] = lite
+        lite.start()
+        for other in names:
+            if other != "lite":
+                lite.add_peer(other, proxies[other].host,
+                              proxies[other].port)
+        # the persisted watermarks survived the crash...
+        assert all(p.watermark is not None
+                   for p in lite.peers.values())
+        for _ in range(50):
+            if all(v == "ok" for v in lite.run_round().values()):
+                break
+            time.sleep(0.02)
+        # ...and the resumed rounds were DELTA pulls, not full re-pulls
+        for peer in lite.peers.values():
+            assert peer.stats.full_pulls == 0
+            assert peer.stats.delta_pulls >= 1
+        soak_steps(30)
+
+        # settle: faults off, every node completes an all-ok sweep,
+        # twice (round 1 spreads everything anyone holds; round 2
+        # spreads what round 1 taught the early sweepers)
+        for proxy in proxies.values():
+            proxy.passthrough = True
+        for _ in range(2):
+            for name in names:
+                settled_round(nodes[name])
+
+        fault_counts = {}
+        for proxy in proxies.values():
+            for kind, n in proxy.counters.items():
+                if kind != "connections":
+                    fault_counts[kind] = fault_counts.get(kind, 0) + n
+        retries = sum(p.stats.retries for node in nodes.values()
+                      for p in node.peers.values())
+        assert sum(fault_counts.values()) > 0, "no faults fired"
+        assert retries > 0, f"faults fired but nothing retried: " \
+            f"{fault_counts}"
+    finally:
+        for proxy in proxies.values():
+            proxy.stop()
+        for node in nodes.values():
+            node.stop()
+
+    states = {name: _sorted_state(nodes[name].crdt) for name in names}
+    base = states[names[0]]
+    for name, st in states.items():
+        assert st == base, (
+            f"{name} diverged at seed {seed}: {set(st) ^ set(base)}")
+    maps = [nodes[n].crdt.map for n in names]
+    assert all(m == maps[0] for m in maps[1:])
+    nodes["lite"].crdt.close()

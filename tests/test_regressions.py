@@ -123,3 +123,73 @@ def test_empty_merge_json_clock_parity():
         c.put("y", 2)
     assert oracle.canonical_time == tpu.canonical_time
     assert oracle.to_json() == tpu.to_json()
+
+
+class TestDuplicateWireKeys:
+    """ADVICE r5 findings 1-2: distinct wire keys that decode to ONE
+    dense slot ("5" and "05" under the int key decoder) must collapse
+    last-wins BEFORE the columnar merge dispatch — the legacy
+    decode-dict semantics, and the uniqueness the scatter join and the
+    watch `get` callback both require."""
+
+    BASE = 1_700_000_000_000
+
+    def _hlc(self, offset_ms):
+        return str(Hlc(self.BASE + offset_ms, 0, "peer"))
+
+    def test_last_occurrence_wins_matches_oracle(self):
+        import json
+
+        from crdt_tpu import DenseCrdt
+        payload = json.dumps({
+            "5": {"hlc": self._hlc(10_000), "value": 111},
+            "05": {"hlc": self._hlc(5_000), "value": 222},
+        })
+        dense = DenseCrdt("dd", 64, wall_clock=FakeClock())
+        dense.merge_json(payload)
+        oracle = MapCrdt("dd", wall_clock=FakeClock())
+        oracle.merge_json(payload, key_decoder=int)
+        # decode-dict parity: the LAST occurrence survives dedup even
+        # though the dropped one carries the higher hlc
+        assert dense.get(5) == oracle.get(5) == 222
+        assert dense.record_map()[5].hlc == oracle.record_map()[5].hlc
+        # the dropped occurrence was never seen by the merge
+        assert dense.stats.records_seen == 1
+
+    def test_literal_duplicate_keys_match_oracle(self):
+        # The same canonical key appearing twice in the raw wire text
+        # (json.loads collapses it last-wins; the columnar scan must
+        # agree) — exercises the C wire-scan dedup when available.
+        from crdt_tpu import DenseCrdt
+        payload = ('{"5": {"hlc": "%s", "value": 111}, '
+                   '"5": {"hlc": "%s", "value": 222}}'
+                   % (self._hlc(10_000), self._hlc(5_000)))
+        dense = DenseCrdt("dd", 64, wall_clock=FakeClock())
+        dense.merge_json(payload)
+        oracle = MapCrdt("dd", wall_clock=FakeClock())
+        oracle.merge_json(payload, key_decoder=int)
+        assert dense.get(5) == oracle.get(5) == 222
+
+    def test_dropped_duplicate_never_reaches_watch(self):
+        # Finding 2 shape: the surviving (last) occurrence LOSES to the
+        # local record while the dropped one would have won. Decode-dict
+        # semantics: nothing is adopted, nothing emits — previously the
+        # winning dropped occurrence merged and the keyed get callback
+        # could answer with the losing occurrence's value.
+        import json
+
+        from crdt_tpu import DenseCrdt
+        dense = DenseCrdt("dd", 64, wall_clock=FakeClock(start=self.BASE))
+        dense.put_batch([5], [7])                    # local, ~BASE
+        whole = dense.watch().record()
+        keyed = dense.watch(5).record()
+        payload = json.dumps({
+            "5": {"hlc": self._hlc(30_000), "value": 111},   # would win
+            "05": {"hlc": self._hlc(-30_000), "value": 222},  # loses
+        })
+        dense.merge_json(payload)
+        oracle = MapCrdt("dd", wall_clock=FakeClock(start=self.BASE))
+        oracle.put(5, 7)
+        oracle.merge_json(payload, key_decoder=int)
+        assert dense.get(5) == oracle.get(5) == 7    # local still wins
+        assert whole.events == [] and keyed.events == []
