@@ -1,0 +1,39 @@
+"""crdtlint: static + dynamic correctness tooling (docs/ANALYSIS.md).
+
+- `host_lint` — AST linter for host-layer race/discipline rules
+- `lattice_laws` — seeded semilattice-law counterexample search
+- `jaxpr_audit` — order-sensitivity hazards in merge kernel jaxprs
+- `sanitizer` — opt-in runtime lattice assertions (CRDT_TPU_SANITIZE=1)
+- CLI: ``python -m crdt_tpu.analysis`` (the CI gate)
+
+This package is import-light on purpose: the sanitizer hook sits on
+`crdt.Crdt.merge`'s path, so importing `crdt_tpu.analysis` (or
+`.sanitizer`) must not pull in jax or the analyzers. Analyzer names
+resolve lazily via ``__getattr__``.
+"""
+
+from __future__ import annotations
+
+from . import sanitizer  # import-light: os + typing only
+from .findings import Finding
+
+_LAZY = {
+    "lint_file": "host_lint", "lint_source": "host_lint",
+    "lint_package": "host_lint",
+    "LawTarget": "lattice_laws", "run_laws": "lattice_laws",
+    "make_wire_join_target": "lattice_laws",
+    "AuditTarget": "jaxpr_audit", "AuditReport": "jaxpr_audit",
+    "audit_all": "jaxpr_audit",
+    "LatticeViolation": "sanitizer",
+}
+
+__all__ = ["Finding", "sanitizer"] + sorted(_LAZY)
+
+
+def __getattr__(name: str):
+    if name in _LAZY:
+        import importlib
+        module = importlib.import_module("." + _LAZY[name], __name__)
+        return getattr(module, name)
+    raise AttributeError(f"module {__name__!r} has no attribute "
+                         f"{name!r}")

@@ -1,0 +1,19 @@
+"""Entry point: ``python -m crdt_tpu.analysis``.
+
+Environment setup must precede any jax import: the jaxpr audit's
+sharded targets trace on 8 virtual CPU devices (the same layout
+tests/conftest.py forces), and forcing the CPU platform keeps the CI
+gate runnable on machines without an accelerator."""
+
+import os
+import sys
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8").strip()
+
+from .cli import main  # noqa: E402  (env setup must run first)
+
+sys.exit(main())

@@ -1,0 +1,124 @@
+"""crdtlint CLI: ``python -m crdt_tpu.analysis``.
+
+Default run (no explicit targets) audits the shipped tree — the CI
+gate: host-lint every package file, run the semilattice law search
+over the registered kernels, and audit every merge jaxpr for
+order-sensitivity hazards. Exit 0 means no findings.
+
+Explicit targets (``--lint PATH``, ``--law-fixture PATH``) run ONLY
+what was named — how the self-test fixtures are exercised::
+
+    python -m crdt_tpu.analysis --lint tests/fixtures/racy_gossip.py
+    python -m crdt_tpu.analysis --law-fixture tests/fixtures/broken_merge.py
+
+A law fixture is a Python file exposing ``LAW_TARGETS`` (a list of
+``analysis.lattice_laws.LawTarget``); on a law violation the CLI
+prints the violating input (seed + batches) and exits nonzero.
+
+``--json`` emits machine-readable output; its ``jaxpr_reports`` key
+carries each audited kernel's golden report (hazards + relied-on
+contracts), which tests pin for the Pallas fan-in path.
+"""
+
+from __future__ import annotations
+
+import argparse
+import importlib.util
+import os
+import sys
+from typing import List
+
+
+def _load_law_fixture(path: str):
+    spec = importlib.util.spec_from_file_location(
+        "crdtlint_law_fixture_" + os.path.basename(path).replace(
+            ".", "_"), path)
+    module = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(module)
+    targets = getattr(module, "LAW_TARGETS", None)
+    if not targets:
+        raise SystemExit(
+            f"law fixture {path} defines no LAW_TARGETS list")
+    return list(targets)
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="python -m crdt_tpu.analysis",
+        description="crdtlint: jaxpr lattice auditor + host-layer "
+                    "race/discipline linter")
+    parser.add_argument("--json", action="store_true",
+                        help="machine-readable JSON output")
+    parser.add_argument("--lint", action="append", default=[],
+                        metavar="PATH",
+                        help="lint only this file/directory (repeat "
+                             "to add more); disables the default "
+                             "full-tree run")
+    parser.add_argument("--law-fixture", action="append", default=[],
+                        metavar="PATH",
+                        help="run the law search over a fixture "
+                             "module's LAW_TARGETS instead of the "
+                             "builtin kernels")
+    parser.add_argument("--seeds", default="0,1,2",
+                        help="comma-separated seeds for the law "
+                             "counterexample search (default 0,1,2)")
+    parser.add_argument("--skip-lint", action="store_true",
+                        help="skip the host linter in the default run")
+    parser.add_argument("--skip-laws", action="store_true",
+                        help="skip the law search in the default run")
+    parser.add_argument("--skip-jaxpr", action="store_true",
+                        help="skip the jaxpr audit in the default run")
+    args = parser.parse_args(argv)
+
+    from .findings import Finding, render_human, render_json
+    findings: List[Finding] = []
+    reports = []
+    seeds = tuple(int(s) for s in args.seeds.split(",") if s.strip())
+    explicit = bool(args.lint or args.law_fixture)
+
+    if args.lint:
+        from .host_lint import lint_file, lint_package
+        for path in args.lint:
+            if os.path.isdir(path):
+                findings.extend(lint_package(path))
+            else:
+                findings.extend(lint_file(path))
+
+    if args.law_fixture:
+        from .lattice_laws import run_laws
+        for path in args.law_fixture:
+            findings.extend(run_laws(_load_law_fixture(path),
+                                     seeds=seeds))
+
+    if not explicit:
+        if not args.skip_lint:
+            from .host_lint import lint_package
+            pkg_root = os.path.dirname(os.path.dirname(
+                os.path.abspath(__file__)))
+            findings.extend(lint_package(pkg_root))
+        if not args.skip_laws:
+            from .lattice_laws import builtin_targets, run_laws
+            findings.extend(run_laws(builtin_targets(), seeds=seeds))
+        if not args.skip_jaxpr:
+            from .jaxpr_audit import audit_all, builtin_targets as \
+                audit_targets
+            reports, audit_findings = audit_all(audit_targets())
+            findings.extend(audit_findings)
+
+    findings.sort(key=lambda f: (f.path, f.line, f.rule))
+    if args.json:
+        print(render_json(
+            findings,
+            jaxpr_reports=[r.golden() for r in reports]))
+    else:
+        audited = (f" ({len(reports)} kernels audited)"
+                   if reports else "")
+        if findings:
+            print(render_human(findings))
+        else:
+            print(f"crdtlint: clean{audited}")
+    return 1 if findings else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

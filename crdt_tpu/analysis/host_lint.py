@@ -1,0 +1,403 @@
+"""Host-layer AST linter: project-specific race/discipline rules.
+
+Every rule encodes a bug class this codebase has actually shipped or
+is structurally exposed to (see docs/ANALYSIS.md for the catalog with
+reproduced bugs):
+
+- ``socket-no-timeout`` — a socket created without a timeout bound
+  turns one silent peer into an unbounded stall of the (single-
+  connection) replication endpoint.
+- ``lock-discipline`` — reads/writes of attributes a class declares
+  lock-guarded (``_CRDTLINT_GUARDED``) outside a ``with self.<lock>:``
+  block.
+- ``wall-clock-read`` — wall-clock reads outside the one sanctioned
+  boundary (``hlc.wall_clock_millis``); HLC math against an ad-hoc
+  clock source breaks drift accounting and injected-clock tests.
+- ``hlc-wall-compare`` — comparing HLC state against a wall-clock
+  read; HLCs order by ``(logical_time, node)``, not wall time.
+- ``record-mutation`` — in-place mutation of a ``Record``'s
+  ``hlc``/``modified``/``value``; records are handed to merge/watch
+  machinery by reference and must be treated as immutable cells.
+- ``add-batch-unique-keys`` — passing a keyed ``get`` callback to
+  ``ChangeHub.add_batch`` without a visible uniqueness gate; ``get``
+  answers a key AT MOST ONCE per batch, so repeat-capable batches
+  must pass ``get=None`` (the round-5 ADVICE bug).
+- ``donated-buffer-reuse`` — reusing a store buffer after passing it
+  to a scatter wrapper with ``donate=True``; the donated buffer is
+  aliased and its contents are undefined after the call.
+
+The linter is purely lexical/AST — no imports of the linted code — so
+it runs on broken or unimportable files (the self-test fixtures).
+Lock discipline is declaration-driven: a class opts in with
+
+    _CRDTLINT_GUARDED = {"_lock": ("attr_a", "attr_b")}
+
+and the linter enforces that every ``self.attr_a`` access in a method
+sits lexically inside ``with self._lock:``. ``__init__`` is exempt
+(construction happens-before publication).
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+from typing import Dict, List, Optional, Set, Tuple
+
+from .findings import Finding, apply_suppressions, parse_suppressions
+
+RULES = (
+    "socket-no-timeout",
+    "lock-discipline",
+    "wall-clock-read",
+    "hlc-wall-compare",
+    "record-mutation",
+    "add-batch-unique-keys",
+    "donated-buffer-reuse",
+    "suppression-without-reason",
+)
+
+_SOCKET_CTORS = {"create_connection", "create_server"}
+_WALL_CALLS = {
+    "time.time", "time.time_ns", "_time.time", "_time.time_ns",
+    "datetime.now", "datetime.utcnow",
+    "datetime.datetime.now", "datetime.datetime.utcnow",
+}
+_HLC_ATTRS = {"hlc", "canonical_time", "_canonical_time", "logical_time"}
+_DONATING_WRAPPERS = {"put_scatter", "record_scatter", "delete_scatter"}
+
+
+def _dotted(node: ast.AST) -> Optional[str]:
+    """'a.b.c' for nested Name/Attribute chains, else None."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def _functions(tree: ast.AST):
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            yield node
+
+
+def _is_wall_call(node: ast.AST) -> bool:
+    if not isinstance(node, ast.Call):
+        return False
+    d = _dotted(node.func)
+    return d in _WALL_CALLS
+
+
+def _contains_wall_call(node: ast.AST) -> bool:
+    return any(_is_wall_call(n) for n in ast.walk(node))
+
+
+def _contains_hlc_attr(node: ast.AST) -> bool:
+    for n in ast.walk(node):
+        if isinstance(n, ast.Attribute) and n.attr in _HLC_ATTRS:
+            return True
+        if isinstance(n, ast.Name) and n.id in _HLC_ATTRS:
+            return True
+    return False
+
+
+# --- rule: socket-no-timeout ---
+
+def _check_sockets(tree: ast.AST, path: str) -> List[Finding]:
+    out: List[Finding] = []
+    for fn in _functions(tree):
+        # assignment target (dotted) per socket-ctor call id
+        targets: Dict[int, Optional[str]] = {}
+        settimeout_on: Set[str] = set()
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                    and isinstance(node.value, ast.Call):
+                targets[id(node.value)] = _dotted(node.targets[0])
+            if isinstance(node, ast.withitem) \
+                    and isinstance(node.context_expr, ast.Call) \
+                    and node.optional_vars is not None:
+                targets[id(node.context_expr)] = _dotted(node.optional_vars)
+            if isinstance(node, ast.Call):
+                d = _dotted(node.func)
+                if d is not None and d.endswith(".settimeout"):
+                    settimeout_on.add(d.rsplit(".", 1)[0])
+        for node in ast.walk(fn):
+            if not isinstance(node, ast.Call):
+                continue
+            d = _dotted(node.func)
+            if d is None:
+                continue
+            name = d.rsplit(".", 1)[-1]
+            if name not in _SOCKET_CTORS and d != "socket.socket":
+                continue
+            if any(kw.arg == "timeout" for kw in node.keywords):
+                continue
+            target = targets.get(id(node))
+            if target is not None and target in settimeout_on:
+                continue
+            out.append(Finding(
+                rule="socket-no-timeout", path=path, line=node.lineno,
+                message=f"{d}(...) without a timeout bound (no timeout= "
+                        "and no settimeout on the result); a silent peer "
+                        "stalls this path forever"))
+    return out
+
+
+# --- rule: lock-discipline ---
+
+def _guard_decl(cls: ast.ClassDef) -> Dict[str, Tuple[str, ...]]:
+    for stmt in cls.body:
+        if isinstance(stmt, ast.Assign) and len(stmt.targets) == 1 \
+                and isinstance(stmt.targets[0], ast.Name) \
+                and stmt.targets[0].id == "_CRDTLINT_GUARDED":
+            try:
+                raw = ast.literal_eval(stmt.value)
+            except (ValueError, SyntaxError):
+                return {}
+            if isinstance(raw, dict):
+                return {str(k): tuple(str(a) for a in v)
+                        for k, v in raw.items()}
+    return {}
+
+
+def _check_lock_discipline(tree: ast.AST, path: str) -> List[Finding]:
+    out: List[Finding] = []
+    for cls in ast.walk(tree):
+        if not isinstance(cls, ast.ClassDef):
+            continue
+        guards = _guard_decl(cls)
+        if not guards:
+            continue
+        attr_to_lock = {attr: lock
+                        for lock, attrs in guards.items()
+                        for attr in attrs}
+
+        def visit(node: ast.AST, held: frozenset) -> None:
+            if isinstance(node, (ast.With, ast.AsyncWith)):
+                acquired = set()
+                for item in node.items:
+                    d = _dotted(item.context_expr)
+                    if d is not None and d.startswith("self."):
+                        lock = d[len("self."):]
+                        if lock in guards:
+                            acquired.add(lock)
+                    # the lock expression itself runs unguarded
+                    visit(item.context_expr, held)
+                for child in node.body:
+                    visit(child, held | frozenset(acquired))
+                return
+            if isinstance(node, ast.Attribute) \
+                    and isinstance(node.value, ast.Name) \
+                    and node.value.id == "self" \
+                    and node.attr in attr_to_lock \
+                    and attr_to_lock[node.attr] not in held:
+                out.append(Finding(
+                    rule="lock-discipline", path=path, line=node.lineno,
+                    message=f"self.{node.attr} accessed outside "
+                            f"'with self.{attr_to_lock[node.attr]}:' "
+                            f"(declared guarded by "
+                            f"{cls.name}._CRDTLINT_GUARDED)"))
+            for child in ast.iter_child_nodes(node):
+                visit(child, held)
+
+        for stmt in cls.body:
+            if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                if stmt.name in ("__init__", "__new__", "__del__"):
+                    continue
+                visit(stmt, frozenset())
+    return out
+
+
+# --- rules: wall-clock-read / hlc-wall-compare ---
+
+def _check_wall_clock(tree: ast.AST, path: str) -> List[Finding]:
+    out: List[Finding] = []
+    # calls inside the sanctioned boundary function are exempt
+    exempt: Set[int] = set()
+    for fn in _functions(tree):
+        if fn.name == "wall_clock_millis":
+            exempt.update(id(n) for n in ast.walk(fn))
+    for node in ast.walk(tree):
+        if _is_wall_call(node) and id(node) not in exempt:
+            out.append(Finding(
+                rule="wall-clock-read", path=path, line=node.lineno,
+                message=f"{_dotted(node.func)}() outside "
+                        "hlc.wall_clock_millis; clock-path code must "
+                        "read wall time through the injectable boundary"))
+        if isinstance(node, ast.Compare):
+            sides = [node.left] + list(node.comparators)
+            if any(_contains_wall_call(s) for s in sides) \
+                    and any(_contains_hlc_attr(s) for s in sides
+                            if not _contains_wall_call(s)):
+                out.append(Finding(
+                    rule="hlc-wall-compare", path=path, line=node.lineno,
+                    message="HLC state compared against a wall-clock "
+                            "read; HLCs order by (logical_time, node), "
+                            "not wall time"))
+    return out
+
+
+# --- rule: record-mutation ---
+
+def _check_record_mutation(tree: ast.AST, path: str) -> List[Finding]:
+    out: List[Finding] = []
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Assign):
+            tgts = node.targets
+        elif isinstance(node, ast.AugAssign):
+            tgts = [node.target]
+        else:
+            continue
+        for tgt in tgts:
+            if not isinstance(tgt, ast.Attribute):
+                continue
+            base = _dotted(tgt.value)
+            if base == "self":
+                continue  # a class assigning its own slots
+            hit = (tgt.attr in ("hlc", "modified")
+                   or (tgt.attr == "value" and base is not None
+                       and "record" in base.lower()))
+            if hit:
+                out.append(Finding(
+                    rule="record-mutation", path=path, line=tgt.lineno,
+                    message=f"in-place mutation of {base}.{tgt.attr}; "
+                            "Records are shared by reference with "
+                            "merge/watch machinery — build a new "
+                            "Record instead"))
+    return out
+
+
+# --- rule: add-batch-unique-keys ---
+
+def _get_arg(call: ast.Call) -> Optional[ast.AST]:
+    if len(call.args) >= 2:
+        return call.args[1]
+    for kw in call.keywords:
+        if kw.arg == "get":
+            return kw.value
+    return None
+
+
+def _check_add_batch(tree: ast.AST, path: str) -> List[Finding]:
+    out: List[Finding] = []
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        if not (isinstance(node.func, ast.Attribute)
+                and node.func.attr == "add_batch"):
+            continue
+        get = _get_arg(node)
+        if get is None:
+            continue
+        if isinstance(get, ast.Constant) and get.value is None:
+            continue
+        if isinstance(get, ast.IfExp) and any(
+                isinstance(b, ast.Constant) and b.value is None
+                for b in (get.body, get.orelse)):
+            continue  # '... if unique else None' uniqueness gate
+        out.append(Finding(
+            rule="add-batch-unique-keys", path=path, line=node.lineno,
+            message="add_batch(..., get=...) without a visible "
+                    "uniqueness gate ('get if unique else None'); "
+                    "get answers a key AT MOST ONCE per batch — a "
+                    "repeat-capable batch must pass get=None "
+                    "(suppress with the uniqueness argument if slots "
+                    "are unique by construction)"))
+    return out
+
+
+# --- rule: donated-buffer-reuse ---
+
+def _check_donated_reuse(tree: ast.AST, path: str) -> List[Finding]:
+    out: List[Finding] = []
+    for fn in _functions(tree):
+        # result-assignment targets per call id: 'store = put_scatter(
+        # store, ..., donate=True)' rebinds the name, so later reads
+        # see the fresh buffer and are fine.
+        assigned: Dict[int, Set[str]] = {}
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Assign) \
+                    and isinstance(node.value, ast.Call):
+                names = {d for d in (_dotted(t) for t in node.targets)
+                         if d is not None}
+                assigned[id(node.value)] = names
+        for node in ast.walk(fn):
+            if not isinstance(node, ast.Call):
+                continue
+            d = _dotted(node.func)
+            if d is None or d.rsplit(".", 1)[-1] not in _DONATING_WRAPPERS:
+                continue
+            donated = any(
+                kw.arg == "donate"
+                and isinstance(kw.value, ast.Constant)
+                and kw.value.value is True
+                for kw in node.keywords)
+            if not donated or not node.args:
+                continue
+            buf = _dotted(node.args[0])
+            if buf is None or buf in assigned.get(id(node), set()):
+                continue
+            for later in ast.walk(fn):
+                if not isinstance(later, (ast.Name, ast.Attribute)):
+                    continue
+                if getattr(later, "lineno", 0) <= node.lineno:
+                    continue
+                if _dotted(later) == buf \
+                        and isinstance(getattr(later, "ctx", None),
+                                       ast.Load):
+                    out.append(Finding(
+                        rule="donated-buffer-reuse", path=path,
+                        line=later.lineno,
+                        message=f"{buf} read after being donated to "
+                                f"{d}(donate=True) at line "
+                                f"{node.lineno}; a donated buffer is "
+                                "aliased and undefined afterwards"))
+                    break
+    return out
+
+
+_ALL_CHECKS = (
+    _check_sockets,
+    _check_lock_discipline,
+    _check_wall_clock,
+    _check_record_mutation,
+    _check_add_batch,
+    _check_donated_reuse,
+)
+
+
+def lint_source(text: str, path: str) -> List[Finding]:
+    """Lint one source text. ``path`` labels findings and is matched
+    against suppression comments in ``text``."""
+    try:
+        tree = ast.parse(text)
+    except SyntaxError as e:
+        return [Finding(rule="parse-error", path=path,
+                        line=e.lineno or 0,
+                        message=f"file does not parse: {e.msg}")]
+    findings: List[Finding] = []
+    for check in _ALL_CHECKS:
+        findings.extend(check(tree, path))
+    findings = apply_suppressions(findings, parse_suppressions(text),
+                                  path)
+    return sorted(findings, key=lambda f: (f.path, f.line, f.rule))
+
+
+def lint_file(path: str) -> List[Finding]:
+    with open(path, "r", encoding="utf-8") as fh:
+        return lint_source(fh.read(), path)
+
+
+def lint_package(root: str) -> List[Finding]:
+    """Lint every .py file under ``root`` (the crdt_tpu package)."""
+    findings: List[Finding] = []
+    for dirpath, dirnames, filenames in os.walk(root):
+        dirnames[:] = sorted(d for d in dirnames
+                             if d not in ("__pycache__",))
+        for name in sorted(filenames):
+            if name.endswith(".py"):
+                findings.extend(lint_file(os.path.join(dirpath, name)))
+    return findings

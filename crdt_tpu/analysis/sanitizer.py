@@ -1,0 +1,120 @@
+"""Opt-in runtime lattice sanitizer (``CRDT_TPU_SANITIZE=1``).
+
+The static auditors prove properties of the KERNELS; the sanitizer
+checks the deployed COMPOSITION: after every merge the store must
+dominate the merged payload in the (logical_time, node) lattice order
+— stored >= remote for every record the merge saw. That is the
+post-state every correct join leaves regardless of who won (local
+winners already dominated; adopted remotes dominate by construction),
+so it holds across backends, executors, and overflow masking — and a
+merge that drops, reorders, or double-applies records breaks it.
+
+Checks are O(merged batch) numpy sweeps on data the merge already
+materialized, so soak tests double as dynamic checkers at tolerable
+cost — but the mode stays opt-in (env var read LIVE, so a test can
+flip it per-case).
+
+Scope notes:
+
+- The DenseCrdt PIPELINED path is exempt by contract: it keeps
+  everything on device with zero host syncs per merge, which is the
+  entire point of the pipeline — a host-side assertion there would
+  serialize it. Sanitize soaks run unpipelined.
+- ``modified`` lanes are NOT checked: stamping is order-dependent
+  bookkeeping by design (see analysis.lattice_laws).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Dict
+
+_ENV = "CRDT_TPU_SANITIZE"
+
+
+class LatticeViolation(AssertionError):
+    """A merge left the store NOT dominating its input — the lattice
+    join invariant is broken (lost update, reordered winner, or
+    double-apply)."""
+
+
+def enabled() -> bool:
+    """Read ``CRDT_TPU_SANITIZE`` live — per-test toggling works
+    without reimporting anything."""
+    return os.environ.get(_ENV, "") not in ("", "0")
+
+
+def check_scalar_join(crdt, remote_records: Dict) -> None:
+    """Post-``Crdt.merge`` invariant: every remote record is dominated
+    by what the store now holds for its key, and by the canonical
+    clock."""
+    canonical = crdt.canonical_time
+    for key, remote in remote_records.items():
+        stored = crdt.get_record(key)
+        if stored is None:
+            raise LatticeViolation(
+                f"sanitizer: merge saw key {key!r} but the store "
+                f"holds no record for it afterwards")
+        if stored.hlc < remote.hlc:
+            raise LatticeViolation(
+                f"sanitizer: store does not dominate merged input at "
+                f"key {key!r}: stored hlc {stored.hlc} < remote hlc "
+                f"{remote.hlc}")
+        if canonical < remote.hlc:
+            raise LatticeViolation(
+                f"sanitizer: canonical clock {canonical} was not "
+                f"absorbed past remote hlc {remote.hlc} at key "
+                f"{key!r}")
+
+
+def check_dense_sparse_join(store, slots, lt, node, valid=None) -> None:
+    """Post-merge invariant for a payload-order sparse delta against a
+    DenseStore: stored (lt, node) at each valid slot is lex >= the
+    delta's. Duplicate slots must already be collapsed (the same
+    contract the merge itself requires)."""
+    import numpy as np
+    s_lt = np.asarray(store.lt)[np.asarray(slots)]
+    s_node = np.asarray(store.node)[np.asarray(slots)]
+    r_lt = np.asarray(lt)
+    r_node = np.asarray(node)
+    dominated = (s_lt > r_lt) | ((s_lt == r_lt) & (s_node >= r_node))
+    if valid is not None:
+        dominated = dominated | ~np.asarray(valid)
+    if not bool(np.all(dominated)):
+        i = int(np.argmin(dominated))
+        raise LatticeViolation(
+            f"sanitizer: store does not dominate merged delta at slot "
+            f"{int(np.asarray(slots)[i])}: stored (lt={int(s_lt[i])}, "
+            f"node={int(s_node[i])}) < remote (lt={int(r_lt[i])}, "
+            f"node={int(r_node[i])})")
+
+
+def check_dense_join(store, cs) -> None:
+    """Post-merge invariant for a wide [R, N] DenseChangeset: per
+    slot, the store dominates the lex max over the valid replica
+    rows."""
+    import numpy as np
+    lt = np.asarray(cs.lt)
+    node = np.asarray(cs.node)
+    valid = np.asarray(cs.valid).astype(bool)
+    if not valid.any():
+        return
+    neg = np.int64(-(2 ** 62))
+    m_lt = np.where(valid, lt, neg)
+    # lex max over rows: max lt, then max node among rows at that lt
+    best_lt = m_lt.max(axis=0)
+    at_best = valid & (m_lt == best_lt)
+    best_node = np.where(at_best, node, np.iinfo(np.int32).min
+                         ).max(axis=0)
+    any_valid = valid.any(axis=0)
+    s_lt = np.asarray(store.lt)
+    s_node = np.asarray(store.node)
+    dominated = (~any_valid | (s_lt > best_lt)
+                 | ((s_lt == best_lt) & (s_node >= best_node)))
+    if not bool(np.all(dominated)):
+        i = int(np.argmin(dominated))
+        raise LatticeViolation(
+            f"sanitizer: store does not dominate merged changeset at "
+            f"slot {i}: stored (lt={int(s_lt[i])}, "
+            f"node={int(s_node[i])}) < changeset best "
+            f"(lt={int(best_lt[i])}, node={int(best_node[i])})")

@@ -28,6 +28,7 @@ from abc import ABC, abstractmethod
 from typing import Any, Callable, Dict, Generic, List, Optional, TypeVar
 
 from . import crdt_json
+from .analysis import sanitizer as _sanitizer
 from .hlc import Hlc, wall_clock_millis
 from .record import (KeyDecoder, KeyEncoder, Record, ValueDecoder,
                      ValueEncoder)
@@ -155,6 +156,9 @@ class Crdt(ABC, Generic[K, V]):
 
         self._canonical_time = Hlc.send(self._canonical_time,
                                         millis=self._wall_clock())
+
+        if _sanitizer.enabled():
+            _sanitizer.check_scalar_join(self, remote_records)
 
     def _decode_wall_millis(self) -> int:
         """The ONE wall-clock read ``merge_json`` consumes for the

@@ -178,6 +178,11 @@ class GossipNode:
     `sync_peer`/`run_round` themselves are not re-entrant; drive them
     from one thread (the built-in loop, or your own)."""
 
+    # crdtlint lock-discipline contract: the peer registry is touched
+    # only under self._peers_lock (enforced statically by
+    # crdt_tpu.analysis.host_lint).
+    _CRDTLINT_GUARDED = {"_peers_lock": ("peers",)}
+
     def __init__(self, crdt: Crdt, host: str = "127.0.0.1",
                  port: int = 0, *,
                  state_path: Optional[str] = None,
@@ -207,6 +212,10 @@ class GossipNode:
         self._sleep = sleep
         self.server = SyncServer(crdt, host, port,
                                  **self._codecs, **server_kwargs)
+        # Guards the peer REGISTRY (the dict itself): add_peer may run
+        # from any thread while the gossip loop iterates. Per-peer
+        # mutable state stays single-writer (the gossip thread).
+        self._peers_lock = threading.Lock()
         self.peers: Dict[str, Peer] = {}
         self._state_path = state_path
         # Crash resume: watermarks persisted by a previous incarnation
@@ -247,7 +256,8 @@ class GossipNode:
                                    clock=self._clock, stats=stats),
             stats=stats,
             watermark=self._saved_marks.get(name))
-        self.peers[name] = peer
+        with self._peers_lock:
+            self.peers[name] = peer
         return peer
 
     # --- lifecycle ---
@@ -289,7 +299,8 @@ class GossipNode:
         """One gossip sweep: sync every peer once, in a shuffled order
         (uncoordinated nodes must not all visit peers in registration
         order). Returns ``{peer name: outcome}``."""
-        names = list(self.peers)
+        with self._peers_lock:
+            names = list(self.peers)
         self._rng.shuffle(names)
         return {name: self.sync_peer(name) for name in names}
 
@@ -302,7 +313,8 @@ class GossipNode:
         or the peer rejected the round; see ``peer.last_error``).
         Failures never raise — a long-running mesh must keep gossiping
         with its healthy peers."""
-        peer = self.peers[name]
+        with self._peers_lock:
+            peer = self.peers[name]
         if not peer.breaker.allow():
             peer.stats.skipped += 1
             return "skipped"
@@ -365,18 +377,22 @@ class GossipNode:
 
     def _persist(self) -> None:
         if self._state_path is not None:
+            with self._peers_lock:
+                entries = list(self.peers.items())
             save_gossip_state(
                 self._state_path, self.crdt.node_id,
-                {name: p.watermark for name, p in self.peers.items()})
+                {name: p.watermark for name, p in entries})
 
     # --- observability ---
 
     def stats_snapshot(self) -> Dict[str, Dict[str, Any]]:
         """Per-peer counter snapshot plus breaker state — cheap, no
         replica access, safe to poll from a monitoring thread."""
+        with self._peers_lock:
+            entries = list(self.peers.items())
         return {name: {**p.stats.as_dict(),
                        "breaker": p.breaker.state,
                        "dense": p.dense,
                        "watermark": None if p.watermark is None
                        else str(p.watermark)}
-                for name, p in self.peers.items()}
+                for name, p in entries}

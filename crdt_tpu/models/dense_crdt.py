@@ -35,6 +35,7 @@ import jax
 import jax.numpy as jnp
 
 from .. import crdt_json
+from ..analysis import sanitizer as _sanitizer
 from ..hlc import (ClockDriftException, DuplicateNodeException, Hlc,
                    wall_clock_millis)
 from ..ops.dense import (DenseChangeset, DenseStore, FaninResult, _NEG,
@@ -600,6 +601,7 @@ class DenseCrdt:
                 return False, None
             return True, None if tomb[k] else int(val[k])
 
+        # crdtlint: disable=add-batch-unique-keys -- widx comes from np.nonzero(win): a slot mask cannot repeat a slot, so the batch is unique by construction
         self._hub.add_batch(pairs, get)
 
     # --- wire interop (C10/C11): every replica speaks the JSON wire
@@ -981,6 +983,12 @@ class DenseCrdt:
             new_store, win, slot_aligned = self._dispatch_columns(
                 slots, lt, node, val, tomb, new_canonical, my_ord)
         self._store = self._postprocess_store(new_store)
+        if _sanitizer.enabled():
+            # Callers collapse duplicate slots before reaching here
+            # (same contract the merge itself needs), so the
+            # payload-order domination check is well-defined.
+            _sanitizer.check_dense_sparse_join(self._store, slots, lt,
+                                               node)
 
         if self._hub.active:
             win_full = np.asarray(jax.device_get(win))
@@ -998,6 +1006,7 @@ class DenseCrdt:
             # so a queried slot matches AT MOST one payload entry —
             # the get callback can never answer with a losing
             # occurrence's value (ChangeHub.add_batch's contract).
+            # crdtlint: disable=add-batch-unique-keys -- duplicate slots are collapsed last-wins by both callers before reaching here (see above)
             self._hub.add_batch(
                 lambda: ([int(slots[i]) for i in widx],
                          [value_at(i) for i in widx]),
@@ -1530,6 +1539,12 @@ class DenseCrdt:
             # are bit-identical either way).
 
         self._store = new_store
+        if _sanitizer.enabled():
+            # Wide post-state check against the merged changeset. The
+            # pipelined branch above is exempt BY CONTRACT: it promises
+            # zero host syncs per merge, which a host-side assertion
+            # would break — sanitize soaks run unpipelined.
+            _sanitizer.check_dense_join(self._store, cs_for_exact())
         self.stats.records_adopted += int(win_count)
         self._emit_merge_wins(new_store, res.win)
         self._canonical_time = Hlc.send(

@@ -335,8 +335,10 @@ class SqliteCrdt(Crdt[K, V], Generic[K, V]):
                     return False, None
 
                 if all_win:
+                    # crdtlint: disable=add-batch-unique-keys -- merge payloads are dict-keyed record maps: keys cannot repeat
                     self._hub.add_batch(lambda: (keys, values), get)
                 else:
+                    # crdtlint: disable=add-batch-unique-keys -- merge payloads are dict-keyed record maps: keys cannot repeat
                     self._hub.add_batch(
                         lambda: ([keys[i] for i in win_list],
                                  [values[i] for i in win_list]), get)

@@ -293,6 +293,7 @@ class TpuMapCrdt(Crdt[K, V]):
                     return False, None
                 return True, payload[slot]
 
+            # crdtlint: disable=add-batch-unique-keys -- putAll batches are dict-keyed, so a key cannot repeat within the batch
             self._hub.add_batch(lambda: (list(keys), list(values)), get)
 
     def _delta_slots(self, modified_since: Optional[Hlc]) -> np.ndarray:
@@ -573,8 +574,10 @@ class TpuMapCrdt(Crdt[K, V]):
                 return True, payload[slot]
 
             if len(win_list) == m:   # every record won (fresh sync)
+                # crdtlint: disable=add-batch-unique-keys -- merge payloads are dict-keyed record maps: keys cannot repeat
                 self._hub.add_batch(lambda: (keys, values), get)
             else:
+                # crdtlint: disable=add-batch-unique-keys -- merge payloads are dict-keyed record maps: keys cannot repeat
                 self._hub.add_batch(
                     lambda: ([keys[i] for i in win_list],
                              [values[i] for i in win_list]), get)

@@ -69,6 +69,7 @@ def load() -> Optional[object]:
             # other's fresh binary and recompile on every load
             # (load-after-unlink is the unsafe half).
             import time
+            # crdtlint: disable=wall-clock-read -- file-age reaping of stale build artifacts, nowhere near HLC clock paths
             cutoff = time.time() - 24 * 3600
             for name in os.listdir(here):
                 if (name.startswith("_hlccodec_")

@@ -295,6 +295,10 @@ class SyncServer:
     >>> server.stop()
     """
 
+    # crdtlint lock-discipline contract: every replica access holds
+    # the replica lock (enforced by crdt_tpu.analysis.host_lint).
+    _CRDTLINT_GUARDED = {"lock": ("crdt",)}
+
     def __init__(self, crdt: Crdt, host: str = "127.0.0.1",
                  port: int = 0,
                  key_encoder=None, value_encoder=None,

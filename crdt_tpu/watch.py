@@ -151,6 +151,10 @@ class AsyncChangeIterator:
     ``async for ... break`` cannot leak the hub subscription forever.
     """
 
+    # crdtlint lock-discipline contract: the pending buffer is touched
+    # only under self._lock (enforced by crdt_tpu.analysis.host_lint).
+    _CRDTLINT_GUARDED = {"_lock": ("_pending",)}
+
     _CLOSE = object()
 
     def __init__(self, stream: ChangeStream):

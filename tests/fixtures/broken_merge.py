@@ -1,0 +1,53 @@
+"""Deliberately BROKEN merge kernel — crdtlint self-test fixture.
+
+A max→mean corruption of `ops.dense.wire_join_step`: where the real
+kernel ADOPTS the winning remote logical time, this one stores the
+AVERAGE of local and remote lt on a win. Averaging is not a lattice
+join (it is neither idempotent nor commutative once the LWW compare
+reads the damaged lt back), so the law search must find a
+counterexample and print the violating input:
+
+    python -m crdt_tpu.analysis --law-fixture tests/fixtures/broken_merge.py
+
+The lt lane (not val) is averaged on purpose: a val-only corruption
+would slide under the idempotence check, because the UNDAMAGED lt
+lane still blocks re-adoption on the second apply. Averaging lt makes
+the store's own compare input wrong, so the breakage is visible to
+every law.
+"""
+
+import jax
+import jax.numpy as jnp
+
+from crdt_tpu.analysis.lattice_laws import make_wire_join_target
+from crdt_tpu.ops.dense import DenseStore, _NEG
+
+
+@jax.jit
+def mean_join_step(store: DenseStore, lt, node, val, tomb, valid,
+                   stamp_lt, local_node):
+    """wire_join_step with the max→mean bug planted."""
+    lt = jnp.where(valid, lt, _NEG)
+    node = node.astype(jnp.int32)
+    val = val.astype(jnp.int64)
+    remote_newer = ((lt > store.lt) |
+                    ((lt == store.lt) & (node > store.node)))
+    win = valid & (~store.occupied | remote_newer)
+    # BUG: mean instead of max — not a semilattice join.
+    mean_lt = (store.lt + lt) // 2
+    new_store = DenseStore(
+        lt=jnp.where(win, mean_lt, store.lt),
+        node=jnp.where(win, node, store.node),
+        val=jnp.where(win, val, store.val),
+        mod_lt=jnp.where(win, stamp_lt, store.mod_lt),
+        mod_node=jnp.where(win, local_node, store.mod_node),
+        occupied=store.occupied | win,
+        tomb=jnp.where(win, tomb, store.tomb),
+    )
+    return new_store, win
+
+
+LAW_TARGETS = [
+    make_wire_join_target(mean_join_step, "broken-mean-join",
+                          notes="max→mean planted bug"),
+]

@@ -1,0 +1,61 @@
+"""Deliberately RACY/undisciplined gossip stub — crdtlint self-test
+fixture. Never imported by production code; every construct below
+exists to be flagged:
+
+    python -m crdt_tpu.analysis --lint tests/fixtures/racy_gossip.py
+
+Expected findings: lock-discipline (peer registry touched outside the
+declared lock), socket-no-timeout (unbounded connect), wall-clock-read
++ hlc-wall-compare (HLC ordered against time.time), record-mutation
+(in-place hlc overwrite), add-batch-unique-keys (keyed get with a
+repeat-capable batch).
+"""
+
+import socket
+import threading
+import time
+
+
+class RacyGossipStub:
+    """Declares the same lock contract as GossipNode, then breaks it."""
+
+    _CRDTLINT_GUARDED = {"_lock": ("peers",)}
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.peers = {}
+
+    def add_peer(self, name, host, port):
+        # RACE: registry write outside self._lock.
+        self.peers[name] = (host, port)
+
+    def run_round(self):
+        with self._lock:
+            names = list(self.peers)          # disciplined (not flagged)
+        for name in names:
+            self.sync_peer(name)
+
+    def sync_peer(self, name):
+        # RACE: registry read outside self._lock.
+        host, port = self.peers[name]
+        # UNBOUNDED: no timeout= and no settimeout on the result — a
+        # silent peer stalls the round forever.
+        conn = socket.create_connection((host, port))
+        try:
+            conn.sendall(b"sync")
+        finally:
+            conn.close()
+
+    def expire_stale(self, record):
+        # HLC MISUSE: wall-clock compared against HLC state. HLCs
+        # order by (logical_time, node) — not wall time.
+        if record.hlc.millis < time.time() * 1000:
+            # MUTATION: records are shared by reference with merge and
+            # watch machinery; they must be replaced, not edited.
+            record.hlc = None
+
+    def emit(self, hub, slots, values):
+        # CONTRACT: slots may repeat (raw payload order), but a keyed
+        # get callback answers each key AT MOST ONCE per batch.
+        hub.add_batch(lambda: (slots, values),
+                      lambda k: (k in slots, values[slots.index(k)]))
