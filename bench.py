@@ -16,11 +16,19 @@ host<->device round trip of this environment's remote-proxied chip is
 paid once rather than per run. Merges are counted over valid lanes
 only.
 
-Prints exactly ONE JSON line:
+Prints exactly ONE JSON line per metric:
     {"metric": ..., "value": N, "unit": "merges/s", "vs_baseline": N,
      "path": ..., "platform": ...}
 ``vs_baseline`` is value / 100e6 (the north-star target), since the
 reference has no published numbers to compare against (BASELINE.md).
+
+Stream mode also re-runs the workload once with the `crdt_tpu.obs`
+trace ring enabled and prints a SECOND JSON line
+(``{"metric": "<name>_phases", "phases": {...}}``) breaking the run
+into pack (changeset manufacture) / dispatch (enqueue loop) / fetch
+(scalar readback) spans, plus the measured tracing overhead against
+the untraced number — the observability layer's ≤5% hot-path budget,
+checked where it matters. The main metric line always comes first.
 """
 
 from __future__ import annotations
@@ -148,9 +156,41 @@ CONFIGS = {
 }
 
 
+def _traced_phases(run, args, cs_spec, repeats: int, metric: str,
+                   untraced_elapsed: float) -> dict:
+    """One extra traced pass of the stream workload, broken into
+    pack / dispatch / fetch spans via the `crdt_tpu.obs` trace ring.
+    Overhead is judged on dispatch+fetch (the phases the untraced
+    timed loop actually covers; pack happens outside it there)."""
+    from crdt_tpu.obs import span, summarize_trace, tracer
+    ring = tracer()
+    ring.enable()
+    ring.clear()
+    with span("bench.pack", kind="bench_phase"):
+        cs = make_changeset(*cs_spec[:2], seed=0, **CONFIGS[cs_spec[2]])
+        jax.block_until_ready(cs)
+    canon = args[2]
+    with span("bench.dispatch", kind="bench_phase"):
+        for _ in range(repeats):
+            _, canon = run(args[0], cs, canon, args[3], args[4])
+    with span("bench.fetch", kind="bench_phase"):
+        int(jax.device_get(canon))
+    phases = summarize_trace(ring.events("bench_phase"))
+    ring.disable()
+    ring.clear()
+    traced = (phases["bench.dispatch"]["total_s"]
+              + phases["bench.fetch"]["total_s"])
+    return {"metric": f"{metric}_phases", "phases": phases,
+            "traced_elapsed_s": round(traced, 6),
+            "untraced_elapsed_s": round(untraced_elapsed, 6),
+            "trace_overhead_frac": (
+                round(max(0.0, traced / untraced_elapsed - 1.0), 4)
+                if untraced_elapsed else None)}
+
+
 def bench(n_keys: int, n_replicas: int, chunk_replicas: int,
           repeats: int = 64, path: str = "auto",
-          config: str = "fanin") -> dict:
+          config: str = "fanin", with_phases: bool = False) -> dict:
     platform = jax.devices()[0].platform
     # The kernel path is the default on ANY accelerator platform (the
     # driver's chip reports a plugin platform name, not "tpu"); when
@@ -215,6 +255,10 @@ def bench(n_keys: int, n_replicas: int, chunk_replicas: int,
         merges * repeats, elapsed, path=path, platform=platform)
     out["repeats"] = repeats  # protocol transparency: rows at different
     #                           amortization levels must be comparable
+    if with_phases:
+        out["_phases"] = _traced_phases(
+            run, args, (chunk_replicas, n_keys, config), repeats,
+            out["metric"], elapsed)
     return out
 
 
@@ -495,8 +539,12 @@ def main() -> None:
                                 loops=args.loops)
     else:
         result = bench(n_keys, n_replicas, chunk, path=args.path,
-                       config=args.config, repeats=args.repeats)
+                       config=args.config, repeats=args.repeats,
+                       with_phases=True)
+    phases = result.pop("_phases", None)
     print(json.dumps(result))
+    if phases is not None:
+        print(json.dumps(phases))
 
 
 if __name__ == "__main__":

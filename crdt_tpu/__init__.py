@@ -34,8 +34,10 @@ from .models.keyed_dense import KeyedDenseCrdt
 from .models.sqlite_crdt import SqliteCrdt
 from .sync import sync, sync_json
 from .net import (SyncError, SyncProtocolError, SyncServer,
-                  SyncTransportError, WireTally, sync_dense_over_tcp,
-                  sync_over_tcp)
+                  SyncTransportError, WireTally, fetch_metrics,
+                  sync_dense_over_tcp, sync_over_tcp)
+from .obs import (MetricsRegistry, TraceRing, default_registry,
+                  metrics_snapshot, tracer)
 from .checkpoint import (load_dense, load_gossip_state, load_json,
                          save_dense, save_gossip_state, save_json)
 from .gossip import (BreakerPolicy, CircuitBreaker, GossipNode, Peer,
@@ -53,7 +55,10 @@ __all__ = [
     "sync_dense", "SqliteCrdt",
     "sync", "sync_json", "SyncServer", "sync_dense_over_tcp", "sync_over_tcp",
     "SyncError", "SyncTransportError", "SyncProtocolError", "WireTally",
+    "fetch_metrics",
     "GossipNode", "Peer", "RetryPolicy", "BreakerPolicy", "CircuitBreaker",
     "load_dense", "load_json", "save_dense", "save_json",
     "load_gossip_state", "save_gossip_state",
+    "MetricsRegistry", "TraceRing", "default_registry",
+    "metrics_snapshot", "tracer",
 ]

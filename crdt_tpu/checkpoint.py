@@ -14,6 +14,7 @@ from __future__ import annotations
 
 import json
 import os
+import time
 from typing import Any, Callable, Optional, Type
 
 import numpy as np
@@ -21,8 +22,29 @@ import numpy as np
 import jax.numpy as jnp
 
 from .crdt import Crdt
+from .obs.trace import tracer as _tracer
 from .ops.dense import DenseStore
 from .record import (KeyDecoder, KeyEncoder, ValueDecoder, ValueEncoder)
+
+
+def _note(action: str, path: str, start: float, hlc=None) -> None:
+    """Account one completed checkpoint op: bump the process counter,
+    and — when the tracer is on — emit an HLC-stamped ``checkpoint``
+    event with duration and on-disk size. Checkpoints are rare and
+    already did file I/O, so this is never on a hot path."""
+    from .obs.registry import default_registry
+    default_registry().counter(
+        "crdt_tpu_checkpoints_total",
+        "checkpoint save/load operations by action").inc(action=action)
+    ring = _tracer()
+    if ring.enabled:
+        fields = {"action": action, "path": path,
+                  "dur_s": time.perf_counter() - start}
+        try:
+            fields["bytes"] = os.path.getsize(path)
+        except OSError:
+            pass
+        ring.emit("checkpoint", hlc=hlc, **fields)
 
 
 def save_json(crdt: Crdt, path: str,
@@ -30,11 +52,13 @@ def save_json(crdt: Crdt, path: str,
               value_encoder: Optional[ValueEncoder] = None) -> None:
     """Snapshot via the wire format — full state including tombstones
     (crdt.dart:124-135). Any conformant backend can restore it."""
+    start = time.perf_counter()
     tmp = path + ".tmp"
     with open(tmp, "w") as f:
         f.write(crdt.to_json(key_encoder=key_encoder,
                              value_encoder=value_encoder))
     os.replace(tmp, path)
+    _note("save_json", path, start, hlc=crdt.canonical_time)
 
 
 def load_json(cls: Type[Crdt], node_id: Any, path: str,
@@ -54,12 +78,15 @@ def load_json(cls: Type[Crdt], node_id: Any, path: str,
     from . import crdt_json
     from .hlc import Hlc
 
+    start = time.perf_counter()
     with open(path) as f:
         records = crdt_json.decode(
             f.read(), Hlc.zero(node_id),
             key_decoder=key_decoder, value_decoder=value_decoder,
             now_millis=wall_clock() if wall_clock else None)
-    return cls(node_id, seed=records, wall_clock=wall_clock, **kwargs)
+    crdt = cls(node_id, seed=records, wall_clock=wall_clock, **kwargs)
+    _note("load_json", path, start, hlc=crdt.canonical_time)
+    return crdt
 
 
 _GOSSIP_STATE_MAGIC = "crdt_tpu/gossip-state@1"
@@ -78,6 +105,7 @@ def save_gossip_state(path: str, node_id: Any,
     :func:`load_json` (or a durable backend like `SqliteCrdt`).
     ``node_id`` is recorded so a state file restored onto the wrong
     node is rejected instead of silently skipping records."""
+    start = time.perf_counter()
     tmp = path + ".tmp"
     with open(tmp, "w") as f:
         json.dump({"magic": _GOSSIP_STATE_MAGIC,
@@ -86,6 +114,7 @@ def save_gossip_state(path: str, node_id: Any,
                                   for name, hlc in watermarks.items()
                                   if hlc is not None}}, f)
     os.replace(tmp, path)
+    _note("save_gossip_state", path, start)
 
 
 def load_gossip_state(path: str, node_id: Any) -> dict:
@@ -120,6 +149,7 @@ def save_dense(store: DenseStore, path: str,
     the node-id interning table when given — the ``node``/``mod_node``
     ordinal lanes are meaningless without it, so model-level snapshots
     (`DenseCrdt.save`) always include it."""
+    start = time.perf_counter()
     tmp = path + ".tmp"
     extra = ({} if node_ids is None
              else {"node_ids": np.array(json.dumps(list(node_ids)))})
@@ -129,6 +159,7 @@ def save_dense(store: DenseStore, path: str,
             **{lane: np.asarray(getattr(store, lane))
                for lane in DenseStore._fields})
     os.replace(tmp, path)
+    _note("save_dense", path, start)
 
 
 def _validated_npz(z, path: str):
@@ -141,12 +172,14 @@ def load_dense_with_node_ids(path: str):
     """One-open load of ``(DenseStore, node_ids-or-None)``. ``None``
     marks a lane-only (v1 / store-level) snapshot whose ordinal lanes
     only a caller holding the original table can interpret."""
+    start = time.perf_counter()
     with np.load(path) as z:
         _validated_npz(z, path)
         store = DenseStore(**{lane: jnp.asarray(z[lane])
                               for lane in DenseStore._fields})
         ids = (json.loads(str(z["node_ids"]))
                if "node_ids" in z else None)
+    _note("load_dense", path, start)
     return store, ids
 
 

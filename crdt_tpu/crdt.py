@@ -32,6 +32,7 @@ from .analysis import sanitizer as _sanitizer
 from .hlc import Hlc, wall_clock_millis
 from .record import (KeyDecoder, KeyEncoder, Record, ValueDecoder,
                      ValueEncoder)
+from .utils.stats import merge_annotation
 from .watch import ChangeStream
 
 K = TypeVar("K")
@@ -40,6 +41,11 @@ V = TypeVar("V")
 
 class Crdt(ABC, Generic[K, V]):
     """Abstract LWW-map CRDT (crdt.dart:7-170)."""
+
+    # Backends that account merges set a MergeStats here (and usually
+    # register it with the obs metrics registry); the base merge then
+    # counts seen/adopted records without per-backend plumbing.
+    stats = None
 
     def __init__(self, wall_clock: Optional[Callable[[], int]] = None):
         self._wall_clock = wall_clock or wall_clock_millis
@@ -130,6 +136,11 @@ class Crdt(ABC, Generic[K, V]):
     # --- merge: the lattice join (crdt.dart:77-94) ---
 
     def merge(self, remote_records: Dict[K, Record[V]]) -> None:
+        with merge_annotation("crdt_tpu.host_merge",
+                              hlc=lambda: self._canonical_time):
+            self._merge_impl(remote_records)
+
+    def _merge_impl(self, remote_records: Dict[K, Record[V]]) -> None:
         local_records = self._local_records_for(remote_records)
 
         wall = self._wall_clock()
@@ -153,6 +164,11 @@ class Crdt(ABC, Generic[K, V]):
                                   self._canonical_time)
 
         self.put_records(updated)
+
+        if self.stats is not None:
+            self.stats.merges += 1
+            self.stats.add_seen_lazy(len(remote_records))
+            self.stats.add_adopted_lazy(len(winners))
 
         self._canonical_time = Hlc.send(self._canonical_time,
                                         millis=self._wall_clock())
@@ -196,6 +212,15 @@ class Crdt(ABC, Generic[K, V]):
             key_encoder=key_encoder,
             value_encoder=value_encoder,
         )
+
+    def count_modified_since(self, modified_since: Optional[Hlc] = None
+                             ) -> int:
+        """How many records (tombstones included) have
+        ``modified.logical_time >= modified_since`` — the backlog a
+        delta sync from that watermark would ship. ``None`` counts the
+        whole store. Backends with columnar or SQL storage override
+        this so lag monitoring never materializes a record map."""
+        return len(self.record_map(modified_since=modified_since))
 
     def __repr__(self) -> str:
         return repr(self.record_map())

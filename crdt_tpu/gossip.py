@@ -46,6 +46,9 @@ from .crdt import Crdt
 from .hlc import Hlc
 from .net import (SyncProtocolError, SyncServer, SyncTransportError,
                   WireTally, sync_dense_over_tcp, sync_over_tcp)
+from .obs.lag import health_status, lag_entry
+from .obs.registry import default_registry
+from .obs.trace import tracer
 from .utils.stats import PeerSyncStats
 
 
@@ -81,7 +84,8 @@ class CircuitBreaker:
     Failures are counted per ROUND (after the retry budget is spent),
     not per attempt — a peer that needs one retry per round is slow,
     not down, and must not trip the breaker. Transitions are counted
-    into the owning peer's :class:`PeerSyncStats`."""
+    into the owning peer's :class:`PeerSyncStats` and, when the
+    process tracer is enabled, emitted as ``breaker`` trace events."""
 
     CLOSED = "closed"
     OPEN = "open"
@@ -89,13 +93,22 @@ class CircuitBreaker:
 
     def __init__(self, policy: BreakerPolicy,
                  clock: Callable[[], float] = time.monotonic,
-                 stats: Optional[PeerSyncStats] = None):
+                 stats: Optional[PeerSyncStats] = None,
+                 name: str = ""):
         self.policy = policy
         self._clock = clock
         self._stats = stats
+        self.name = name           # owning peer, for trace events
         self.state = self.CLOSED
         self.failures = 0          # consecutive, resets on success
         self._opened_at = 0.0
+
+    def _transition(self, state: str) -> None:
+        self.state = state
+        ring = tracer()
+        if ring.enabled:
+            ring.emit("breaker", peer=self.name, state=state,
+                      failures=self.failures)
 
     def allow(self) -> bool:
         """May a round be attempted now? Flips OPEN → HALF_OPEN when
@@ -104,7 +117,7 @@ class CircuitBreaker:
             if self._clock() - self._opened_at \
                     < self.policy.reset_timeout:
                 return False
-            self.state = self.HALF_OPEN
+            self._transition(self.HALF_OPEN)
             if self._stats is not None:
                 self._stats.breaker_half_open += 1
         return True
@@ -112,7 +125,7 @@ class CircuitBreaker:
     def record_success(self) -> None:
         self.failures = 0
         if self.state != self.CLOSED:
-            self.state = self.CLOSED
+            self._transition(self.CLOSED)
             if self._stats is not None:
                 self._stats.breaker_closed += 1
 
@@ -121,7 +134,7 @@ class CircuitBreaker:
         if self.state == self.HALF_OPEN \
                 or (self.state == self.CLOSED
                     and self.failures >= self.policy.failure_threshold):
-            self.state = self.OPEN
+            self._transition(self.OPEN)
             self._opened_at = self._clock()
             if self._stats is not None:
                 self._stats.breaker_opened += 1
@@ -212,6 +225,13 @@ class GossipNode:
         self._sleep = sleep
         self.server = SyncServer(crdt, host, port,
                                  **self._codecs, **server_kwargs)
+        # Client-side wire bytes across all peers, node lifetime
+        # (per-peer splits live in each PeerSyncStats). The server's
+        # metrics op folds our per-peer lag table into its snapshot.
+        self.wire = WireTally()
+        default_registry().attach("wire", self.wire, role="client",
+                                  node=str(crdt.node_id))
+        self.server.metrics_extra = self._metrics_extra
         # Guards the peer REGISTRY (the dict itself): add_peer may run
         # from any thread while the gossip loop iterates. Per-peer
         # mutable state stays single-writer (the gossip thread).
@@ -248,12 +268,14 @@ class GossipNode:
         """Register (or re-address) a peer. A persisted watermark for
         ``name`` is resumed; ``dense`` overrides the node-level wire
         preference for this peer."""
-        stats = PeerSyncStats()
+        stats = PeerSyncStats().register(
+            node=str(self.crdt.node_id), peer=name)
         peer = Peer(
             name, host, port,
             dense=self.prefer_dense if dense is None else dense,
             breaker=CircuitBreaker(self.breaker_policy,
-                                   clock=self._clock, stats=stats),
+                                   clock=self._clock, stats=stats,
+                                   name=name),
             stats=stats,
             watermark=self._saved_marks.get(name))
         with self._peers_lock:
@@ -313,6 +335,23 @@ class GossipNode:
         or the peer rejected the round; see ``peer.last_error``).
         Failures never raise — a long-running mesh must keep gossiping
         with its healthy peers."""
+        ring = tracer()
+        if not ring.enabled:
+            return self._sync_peer(name)
+        start = time.perf_counter()
+        outcome = self._sync_peer(name)
+        dur = time.perf_counter() - start
+        with self.server.lock:
+            stamp = str(self.crdt.canonical_time)
+        ring.emit("gossip_round", hlc=stamp, peer=name,
+                  outcome=outcome, dur_s=dur)
+        default_registry().histogram(
+            "crdt_tpu_gossip_round_seconds",
+            "anti-entropy round wall time, retries included"
+        ).observe(dur, peer=name, outcome=outcome)
+        return outcome
+
+    def _sync_peer(self, name: str) -> str:
         with self._peers_lock:
             peer = self.peers[name]
         if not peer.breaker.allow():
@@ -368,6 +407,8 @@ class GossipNode:
         finally:
             peer.stats.bytes_sent += tally.sent
             peer.stats.bytes_received += tally.received
+            self.wire.sent += tally.sent
+            self.wire.received += tally.received
 
     def _round_failed(self, peer: Peer, exc: Exception) -> str:
         peer.last_error = exc
@@ -396,3 +437,56 @@ class GossipNode:
                        "watermark": None if p.watermark is None
                        else str(p.watermark)}
                 for name, p in entries}
+
+    def lag_snapshot(self, include_pending: bool = True
+                     ) -> Dict[str, Dict[str, Any]]:
+        """Per-peer convergence lag: how far each peer's last
+        completed round is behind the local HLC head.
+
+        ``lag_ms`` is ``local_head.millis - watermark.millis`` (the
+        watermark is the local canonical time captured at the start of
+        the peer's last completed round, so this measures sync
+        staleness, not network latency); ``pending_records`` counts
+        local records modified since that watermark — the backlog the
+        next delta round would push. Never-synced peers report
+        ``synced: False`` with null lag. ``include_pending=False``
+        skips the replica scan (and its lock) for cheap polling."""
+        with self._peers_lock:
+            entries = list(self.peers.items())
+        with self.server.lock:
+            head = self.crdt.canonical_time
+            pending = {}
+            if include_pending:
+                for name, p in entries:
+                    pending[name] = self.crdt.count_modified_since(
+                        p.watermark)
+        return {name: lag_entry(head, p.watermark,
+                                pending=pending.get(name),
+                                breaker=p.breaker.state,
+                                dense=p.dense,
+                                last_error=p.last_error)
+                for name, p in entries}
+
+    def health(self, include_pending: bool = True,
+               stale_after_ms: int = 60_000) -> Dict[str, Any]:
+        """One-call node health: identity, HLC head, per-peer lag, and
+        an overall ``status`` — ``"degraded"`` when any peer is
+        never-synced, breaker-impaired, or staler than
+        ``stale_after_ms``; else ``"ok"``."""
+        peers = self.lag_snapshot(include_pending=include_pending)
+        with self.server.lock:
+            head = self.crdt.canonical_time
+        return {"node_id": str(self.crdt.node_id),
+                "hlc_head": str(head),
+                "head_millis": head.millis,
+                "status": health_status(peers,
+                                        stale_after_ms=stale_after_ms),
+                "peers": peers}
+
+    def _metrics_extra(self) -> Dict[str, Any]:
+        """Folded into the server's ``metrics`` op reply (called
+        WITHOUT the server lock held — lag_snapshot takes it)."""
+        with self.server.lock:
+            node = {"node_id": str(self.crdt.node_id),
+                    "hlc_head": str(self.crdt.canonical_time)}
+        return {"node": node, "lag": self.lag_snapshot()}

@@ -166,7 +166,8 @@ class DenseCrdt:
                 f"n_slots={n_slots}")
         if node_id not in self._table:
             self._intern_ids([node_id])
-        self.stats = MergeStats()
+        self.stats = MergeStats().register(backend="DenseCrdt",
+                                           node=str(node_id))
         self._hub = ChangeHub()
         self._pipe: Optional[_PipeState] = None
         self._pending_val_overflow = None
@@ -694,6 +695,14 @@ class DenseCrdt:
                 self._store, jnp.int64(modified_since.logical_time))
         return mask
 
+    def count_modified_since(self, modified_since: Optional[Hlc] = None
+                             ) -> int:
+        """Delta-backlog size for lag monitoring: occupied slots with
+        ``mod_lt >= modified_since`` (tombstones included). One masked
+        sum on device, one scalar fetch — never materializes records."""
+        return int(jax.device_get(
+            jnp.sum(self._delta_mask(modified_since))))
+
     def record_map(self, modified_since: Optional[Hlc] = None
                    ) -> Dict[int, Record]:
         """Slot→Record export (recordMap semantics, crdt.dart:140-169,
@@ -979,7 +988,8 @@ class DenseCrdt:
             raise ClockDriftException(int(lt[fold.bad_index]) >> 16, wall)
         new_canonical = fold.new_canonical
 
-        with merge_annotation("crdt_tpu.dense_merge"):
+        with merge_annotation("crdt_tpu.dense_merge",
+                              hlc=lambda: self._canonical_time):
             new_store, win, slot_aligned = self._dispatch_columns(
                 slots, lt, node, val, tomb, new_canonical, my_ord)
         self._store = self._postprocess_store(new_store)
@@ -1397,7 +1407,8 @@ class DenseCrdt:
             # clocks tick identically.
             wall_merge = self._wall_clock()
             wall_send = self._wall_clock()
-            with merge_annotation("crdt_tpu.dense_merge"):
+            with merge_annotation("crdt_tpu.dense_merge",
+                                  hlc=lambda: self._canonical_time):
                 (new_store, new_canon, any_bad, overflow, drift,
                  val_ovf, first_idx, win_count, win, seen) = \
                     pipelined_model_step(
@@ -1441,7 +1452,8 @@ class DenseCrdt:
             self.stats.add_seen_lazy(jnp.sum(cs.valid))
 
         wall = self._wall_clock()
-        with merge_annotation("crdt_tpu.dense_merge"):
+        with merge_annotation("crdt_tpu.dense_merge",
+                              hlc=lambda: self._canonical_time):
             new_store, res = self._dispatch_fanin(cs, wall)
 
         voverflow, self._pending_val_overflow = \
@@ -1625,7 +1637,8 @@ class DenseCrdt:
             from ..ops.pallas_merge import pipelined_model_step_split
             wall_merge = self._wall_clock()
             wall_send = self._wall_clock()
-            with merge_annotation("crdt_tpu.dense_merge"):
+            with merge_annotation("crdt_tpu.dense_merge",
+                                  hlc=lambda: self._canonical_time):
                 (new_store, new_canon, any_bad, overflow, drift,
                  val_ovf, first_idx, win_count, win, seen) = \
                     pipelined_model_step_split(
@@ -1651,7 +1664,8 @@ class DenseCrdt:
             self._emit_merge_wins(new_store, win)
             return
         wall = self._wall_clock()
-        with merge_annotation("crdt_tpu.dense_merge"):
+        with merge_annotation("crdt_tpu.dense_merge",
+                              hlc=lambda: self._canonical_time):
             new_store, pres, seen, voverflow = model_fanin_split(
                 self._store, scs, jnp.asarray(node_map),
                 self._canonical_lt(),

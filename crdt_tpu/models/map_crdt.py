@@ -13,6 +13,7 @@ from typing import Any, Callable, Dict, Generic, Optional, TypeVar
 from ..crdt import Crdt
 from ..hlc import Hlc
 from ..record import Record
+from ..utils.stats import MergeStats
 from ..watch import ChangeHub, ChangeStream
 
 K = TypeVar("K")
@@ -28,6 +29,8 @@ class MapCrdt(Crdt[K, V], Generic[K, V]):
         self._node_id = node_id
         self._map: Dict[K, Record[V]] = dict(seed or {})
         self._hub = ChangeHub()
+        self.stats = MergeStats().register(backend="MapCrdt",
+                                           node=str(node_id))
         super().__init__(wall_clock=wall_clock)
 
     @property

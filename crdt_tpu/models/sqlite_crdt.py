@@ -37,6 +37,7 @@ from typing import Any, Callable, Dict, Generic, Optional, TypeVar
 from ..crdt import Crdt
 from ..hlc import Hlc
 from ..record import Record
+from ..utils.stats import MergeStats
 from ..watch import ChangeHub, ChangeStream
 
 K = TypeVar("K")
@@ -106,6 +107,8 @@ class SqliteCrdt(Crdt[K, V], Generic[K, V]):
             node_decoder = type(node_id)
         self._node_dec = node_decoder
         self._hub = ChangeHub()
+        self.stats = MergeStats().register(backend="SqliteCrdt",
+                                           node=str(node_id))
         super().__init__(wall_clock=wall_clock)
 
     @property
@@ -217,6 +220,14 @@ class SqliteCrdt(Crdt[K, V], Generic[K, V]):
 
     def _merge_columns(self, keys, lt, nodes, values, hlc_strs,
                        wall: int) -> None:
+        from ..utils.stats import merge_annotation
+        with merge_annotation("crdt_tpu.sqlite_merge",
+                              hlc=lambda: self._canonical_time):
+            self._merge_columns_impl(keys, lt, nodes, values,
+                                     hlc_strs, wall)
+
+    def _merge_columns_impl(self, keys, lt, nodes, values, hlc_strs,
+                            wall: int) -> None:
         import numpy as np
 
         from ..hlc import (MAX_COUNTER, SHIFT, ClockDriftException,
@@ -273,6 +284,9 @@ class SqliteCrdt(Crdt[K, V], Generic[K, V]):
 
         # --- stage 3: one-transaction columnar upsert of the winners.
         widx = np.nonzero(win)[0]
+        self.stats.merges += 1
+        self.stats.add_seen_lazy(len(keys))
+        self.stats.add_adopted_lazy(int(widx.size))
         if widx.size:
             import itertools
 
@@ -389,6 +403,21 @@ class SqliteCrdt(Crdt[K, V], Generic[K, V]):
                 "ORDER BY rowid", (modified_since.logical_time,))
         return {self._key_dec(row[0]): self._decode_row(row)
                 for row in rows}
+
+    def count_modified_since(self, modified_since: Optional[Hlc] = None
+                             ) -> int:
+        """Delta-backlog size straight off the ``modified_lt`` index —
+        lag monitoring never parses a row."""
+        if modified_since is None:
+            # Same no-WHERE rationale as record_map: pre-epoch rows
+            # must count.
+            (n,) = self._conn.execute(
+                "SELECT COUNT(*) FROM records").fetchone()
+        else:
+            (n,) = self._conn.execute(
+                "SELECT COUNT(*) FROM records WHERE modified_lt >= ?",
+                (modified_since.logical_time,)).fetchone()
+        return n
 
     def watch(self, key: Optional[K] = None) -> ChangeStream:
         return self._hub.stream(key)

@@ -111,7 +111,8 @@ class TpuMapCrdt(Crdt[K, V]):
         self._slot_keys: List[K] = []       # slot -> key, insertion order
         self._payload: List[Any] = []       # slot -> value (None = tombstone)
         self._hub = ChangeHub()
-        self.stats = MergeStats()
+        self.stats = MergeStats().register(backend="TpuMapCrdt",
+                                           node=str(node_id))
         if seed:
             # Seed lands before the canonical clock is derived, so
             # refresh_canonical_time absorbs it (map_crdt.dart:16-18 +
@@ -498,7 +499,8 @@ class TpuMapCrdt(Crdt[K, V]):
         my_ord = self._my_ordinal()
         canonical_lt = self._canonical_time.logical_time
 
-        with merge_annotation("crdt_tpu.host_merge"):
+        with merge_annotation("crdt_tpu.host_merge",
+                              hlc=lambda: self._canonical_time):
             # --- stage 1: recv guards against the RUNNING canonical
             # (exclusive cummax — the fast path shields records the
             # clock already dominates, hlc.dart:85), in payload visit

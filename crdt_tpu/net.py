@@ -113,15 +113,21 @@ class SyncProtocolError(SyncError):
 
 
 class WireTally:
-    """Mutable per-round byte counters (frame headers included) the
-    sync functions fill when given one — the gossip runtime's per-peer
-    ``bytes_sent``/``bytes_received`` accounting."""
+    """Mutable wire byte counters (frame headers included) the sync
+    functions fill when given one — per-round for the gossip runtime's
+    per-peer ``bytes_sent``/``bytes_received`` accounting, cumulative
+    for the endpoint-lifetime tallies `SyncServer` and `GossipNode`
+    attach to the metrics registry (the ``__weakref__`` slot exists so
+    the registry can hold them weakly)."""
 
-    __slots__ = ("sent", "received")
+    __slots__ = ("sent", "received", "__weakref__")
 
     def __init__(self) -> None:
         self.sent = 0
         self.received = 0
+
+    def as_dict(self) -> dict:
+        return {"sent": self.sent, "received": self.received}
 
 
 def send_frame(sock: socket.socket, obj: Any,
@@ -318,6 +324,20 @@ class SyncServer:
         # custom-typed keys/values need the same coders over TCP
         self._kenc, self._venc = key_encoder, value_encoder
         self._kdec, self._vdec = key_decoder, value_decoder
+        # Endpoint-lifetime wire byte tally, registered with the
+        # process metrics registry (weakly — a test's short-lived
+        # server vanishes from snapshots with the server). Touched by
+        # the single handler thread only; snapshot reads are racy-but-
+        # atomic int reads.
+        from .obs.registry import default_registry
+        self.tally = WireTally()
+        default_registry().attach("wire", self.tally, role="server",
+                                  node=str(crdt.node_id))
+        # Optional hook merged into the `metrics` op reply — a
+        # `GossipNode` installs its lag snapshot here so the wire op
+        # answers "how far behind is replica B?" without the server
+        # knowing about gossip state.
+        self.metrics_extra = None
         self._active: Optional[socket.socket] = None
         self._lsock = socket.create_server((host, port))
         self._lsock.settimeout(0.2)  # poll the stop flag
@@ -390,11 +410,16 @@ class SyncServer:
     def _handle(self, conn: socket.socket) -> None:
         conn.settimeout(self._io_timeout)
         import time as _time
+
+        from .obs.trace import tracer as _tracer
+        ring = _tracer()
         deadline = _time.monotonic() + self._conn_deadline
         ops = 0
         while not self._stop.is_set():
+            sent0, received0 = self.tally.sent, self.tally.received
             try:
-                msg = recv_frame(conn, deadline=deadline)
+                msg = recv_frame(conn, deadline=deadline,
+                                 tally=self.tally)
             except (socket.timeout, OSError, ValueError):
                 return
             if msg is None or not isinstance(msg, dict) \
@@ -420,9 +445,10 @@ class SyncServer:
                     self._reply(conn, {"ok": False,
                                        "code": "merge_rejected",
                                        "error": type(e).__name__,
-                                       "detail": str(e)})
+                                       "detail": str(e)},
+                                self.tally)
                     return
-                if not self._reply(conn, {"ok": True}):
+                if not self._reply(conn, {"ok": True}, self.tally):
                     return
             elif op == "delta":
                 try:
@@ -437,9 +463,11 @@ class SyncServer:
                     # e.g. an unparseable `since` watermark
                     self._reply(conn, {"code": "delta_failed",
                                        "error": type(e).__name__,
-                                       "detail": str(e)})
+                                       "detail": str(e)},
+                                self.tally)
                     return
-                if not self._reply(conn, {"payload": payload}):
+                if not self._reply(conn, {"payload": payload},
+                                   self.tally):
                     return
             elif op == "push_dense":
                 # The meta frame is followed by ONE raw binary frame,
@@ -450,7 +478,8 @@ class SyncServer:
                     blob = recv_bytes_frame(
                         conn, deadline=min(
                             deadline,
-                            _time.monotonic() + self._io_timeout))
+                            _time.monotonic() + self._io_timeout),
+                        tally=self.tally)
                 except (socket.timeout, OSError, ValueError):
                     return
                 if blob is None:
@@ -468,9 +497,10 @@ class SyncServer:
                     self._reply(conn, {"ok": False,
                                        "code": "dense_rejected",
                                        "error": type(e).__name__,
-                                       "detail": str(e)})
+                                       "detail": str(e)},
+                                self.tally)
                     return
-                if not self._reply(conn, {"ok": True}):
+                if not self._reply(conn, {"ok": True}, self.tally):
                     return
             elif op == "delta_dense":
                 try:
@@ -483,25 +513,60 @@ class SyncServer:
                 except Exception as e:
                     self._reply(conn, {"code": "dense_rejected",
                                        "error": type(e).__name__,
-                                       "detail": str(e)})
+                                       "detail": str(e)},
+                                self.tally)
                     return
-                if not self._reply(conn, meta_msg):
+                if not self._reply(conn, meta_msg, self.tally):
                     return
                 try:
-                    send_bytes_frame(conn, bufs)
+                    send_bytes_frame(conn, bufs, self.tally)
                 except (OSError, ValueError):
+                    return
+            elif op == "metrics":
+                # Registry snapshot + whatever the embedding runtime
+                # (GossipNode: per-peer HLC lag) contributes. The
+                # registry and the hook take their own locks; only the
+                # replica-identity read holds the replica lock.
+                try:
+                    from .obs import metrics_snapshot
+                    snap = metrics_snapshot()
+                    extra = self.metrics_extra
+                    if extra is not None:
+                        snap.update(extra())
+                    if "node" not in snap:
+                        with self.lock:
+                            snap["node"] = {
+                                "node_id": str(self.crdt.node_id),
+                                "hlc_head":
+                                    str(self.crdt.canonical_time)}
+                except Exception as e:
+                    self._reply(conn, {"code": "metrics_failed",
+                                       "error": type(e).__name__,
+                                       "detail": str(e)},
+                                self.tally)
+                    return
+                if not self._reply(conn, {"metrics": snap},
+                                   self.tally):
                     return
             else:
                 self._reply(conn, {"code": "unknown_op",
-                                   "error": f"unknown op {op!r}"})
+                                   "error": f"unknown op {op!r}"},
+                            self.tally)
                 return
+            if ring.enabled:
+                with self.lock:
+                    stamp = str(self.crdt.canonical_time)
+                ring.emit("wire_frame", hlc=stamp, op=op,
+                          sent=self.tally.sent - sent0,
+                          received=self.tally.received - received0)
 
     @staticmethod
-    def _reply(conn: socket.socket, obj: Any) -> bool:
+    def _reply(conn: socket.socket, obj: Any,
+               tally: Optional[WireTally] = None) -> bool:
         """Send a reply; a peer that vanished mid-reply just ends the
         connection, never the server."""
         try:
-            send_frame(conn, obj)
+            send_frame(conn, obj, tally)
             return True
         except (OSError, ValueError):
             return False
@@ -650,3 +715,28 @@ def sync_dense_over_tcp(crdt, host: str, port: int,
     except (OSError, ValueError) as e:
         raise SyncTransportError(f"sync round failed: {e!r}") from e
     return watermark
+
+
+def fetch_metrics(host: str, port: int, timeout: float = 10.0,
+                  tally: Optional[WireTally] = None) -> dict:
+    """Poll a :class:`SyncServer`'s ``metrics`` op: one registry
+    snapshot (merge/peer/wire counters, and — when the server belongs
+    to a `GossipNode` — per-peer HLC lag under ``"lag"``). Raises the
+    usual :class:`SyncError` taxonomy; a pre-metrics server replies
+    ``unknown_op``, surfaced as :class:`SyncProtocolError`."""
+    import time as _time
+    try:
+        with socket.create_connection((host, port),
+                                      timeout=timeout) as sock:
+            sock.settimeout(timeout)
+            send_frame(sock, {"op": "metrics"}, tally)
+            reply = recv_frame(sock,
+                               deadline=_time.monotonic() + timeout,
+                               tally=tally)
+            _check_reply("metrics poll failed", reply, "metrics")
+            send_frame(sock, {"op": "bye"}, tally)
+            return reply["metrics"]
+    except SyncError:
+        raise
+    except (OSError, ValueError) as e:
+        raise SyncTransportError(f"metrics poll failed: {e!r}") from e

@@ -1,0 +1,55 @@
+"""Unified telemetry for the CRDT runtime (SURVEY.md §5: the reference
+has no tracing/metrics at all; ROADMAP's production north star needs
+both).
+
+Three cooperating layers, all dependency-free (stdlib + the jax the
+package already requires):
+
+- :mod:`~crdt_tpu.obs.registry` — a process-wide **metrics registry**
+  (counters, gauges, log2-bucket histograms; thread-safe). The legacy
+  counter dataclasses (`MergeStats`, `PeerSyncStats`, `WireTally`)
+  attach to it as weak-referenced collectors, so every live backend,
+  peer and wire endpoint shows up in one snapshot instead of three
+  orphan objects.
+- :mod:`~crdt_tpu.obs.trace` — **HLC-stamped structured trace events**
+  (merge dispatch, gossip round, wire frame, checkpoint, breaker
+  transition) in a bounded in-memory ring with an optional JSONL sink;
+  `span()` threads `jax.profiler.TraceAnnotation` through the
+  merge/pack/wire phases so TPU profiles show named kernels. Disabled
+  by default — the hot path pays one attribute read.
+- :mod:`~crdt_tpu.obs.lag` — the **convergence-lag monitor**: per-peer
+  staleness (local HLC head minus peer watermark, in millis and
+  pending records) derived from `GossipNode` watermarks; surfaced as
+  ``node.health()`` and over the wire via the `SyncServer` ``metrics``
+  op.
+
+Exposition: :func:`~crdt_tpu.obs.render.render_prometheus` renders a
+snapshot as Prometheus text; ``python -m crdt_tpu.obs`` polls a live
+node's ``metrics`` op or summarizes a trace JSONL into a per-phase
+latency table (see docs/OBSERVABILITY.md).
+"""
+
+from __future__ import annotations
+
+from .registry import (Counter, Gauge, Histogram, MetricsRegistry,
+                       default_registry)
+from .trace import TraceRing, span, tracer
+from .lag import health_status, lag_entry, lag_millis
+from .render import (format_phase_table, render_prometheus,
+                     render_summary, summarize_trace)
+
+
+def metrics_snapshot() -> dict:
+    """One self-describing snapshot of the process-wide registry — the
+    payload the `SyncServer` ``metrics`` wire op returns."""
+    return default_registry().snapshot()
+
+
+__all__ = [
+    "Counter", "Gauge", "Histogram", "MetricsRegistry",
+    "default_registry", "metrics_snapshot",
+    "TraceRing", "tracer", "span",
+    "lag_millis", "lag_entry", "health_status",
+    "render_prometheus", "render_summary", "summarize_trace",
+    "format_phase_table",
+]

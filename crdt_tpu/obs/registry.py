@@ -1,0 +1,240 @@
+"""Process-wide metrics registry: counters, gauges, log2 histograms.
+
+Design constraints, in order:
+
+1. **The merge hot path stays lock-free.** `MergeStats` accumulates
+   plain host ints (and lazy device scalars) exactly as before; it
+   *attaches* to the registry as a weak-referenced collector and is
+   only read at snapshot time. Registry locks are paid on scrape and
+   on genuinely cold paths (gossip rounds, checkpoints, watch fanout),
+   never per record.
+2. **Thread-safe by declaration.** Every instrument and the registry
+   itself guard their mutable state behind one lock each, declared via
+   ``_CRDTLINT_GUARDED`` so the crdtlint lock-discipline rule enforces
+   the contract statically.
+3. **No global leak.** Collectors are held by ``weakref`` — a test
+   that builds ten thousand replicas does not grow the registry past
+   their lifetimes; dead entries are pruned on snapshot.
+
+Histograms use **fixed log2 buckets**: bucket ``e`` counts
+observations ``<= 2**e`` for ``e`` in a fixed exponent range, plus an
+overflow bucket. Log-spaced bounds cover µs..minutes latencies with
+~26 integers and merge trivially across processes (the bounds are the
+same everywhere by construction).
+"""
+
+from __future__ import annotations
+
+import bisect
+import threading
+import weakref
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+_LabelKey = Tuple[Tuple[str, str], ...]
+
+
+def _label_key(labels: Dict[str, Any]) -> _LabelKey:
+    return tuple(sorted((str(k), str(v)) for k, v in labels.items()))
+
+
+class Counter:
+    """Monotonically increasing counter with optional labels."""
+
+    kind = "counter"
+
+    # crdtlint lock-discipline contract (see module docstring).
+    _CRDTLINT_GUARDED = {"_lock": ("_values",)}
+
+    def __init__(self, name: str, help: str = ""):
+        self.name = name
+        self.help = help
+        self._lock = threading.Lock()
+        self._values: Dict[_LabelKey, float] = {}
+
+    def inc(self, amount: float = 1, **labels: Any) -> None:
+        if amount < 0:
+            raise ValueError("counters only go up; use a Gauge")
+        key = _label_key(labels)
+        with self._lock:
+            self._values[key] = self._values.get(key, 0) + amount
+
+    def value(self, **labels: Any) -> float:
+        key = _label_key(labels)
+        with self._lock:
+            return self._values.get(key, 0)
+
+    def samples(self) -> List[dict]:
+        with self._lock:
+            items = list(self._values.items())
+        return [{"labels": dict(k), "value": v} for k, v in items]
+
+
+class Gauge:
+    """Point-in-time value with optional labels (set or add)."""
+
+    kind = "gauge"
+
+    _CRDTLINT_GUARDED = {"_lock": ("_values",)}
+
+    def __init__(self, name: str, help: str = ""):
+        self.name = name
+        self.help = help
+        self._lock = threading.Lock()
+        self._values: Dict[_LabelKey, float] = {}
+
+    def set(self, value: float, **labels: Any) -> None:
+        key = _label_key(labels)
+        with self._lock:
+            self._values[key] = value
+
+    def add(self, amount: float, **labels: Any) -> None:
+        key = _label_key(labels)
+        with self._lock:
+            self._values[key] = self._values.get(key, 0) + amount
+
+    def value(self, **labels: Any) -> float:
+        key = _label_key(labels)
+        with self._lock:
+            return self._values.get(key, 0)
+
+    def samples(self) -> List[dict]:
+        with self._lock:
+            items = list(self._values.items())
+        return [{"labels": dict(k), "value": v} for k, v in items]
+
+
+class Histogram:
+    """Histogram over fixed log2 buckets.
+
+    Bucket ``i`` counts observations ``<= 2**exponents[i]``; one extra
+    overflow bucket catches the rest. The default range (2**-20 ..
+    2**5 seconds, ~1 µs .. 32 s) suits the latencies this codebase
+    emits; pass ``low_exp``/``high_exp`` for other units.
+    """
+
+    kind = "histogram"
+
+    _CRDTLINT_GUARDED = {"_lock": ("_series",)}
+
+    def __init__(self, name: str, help: str = "",
+                 low_exp: int = -20, high_exp: int = 5):
+        if high_exp <= low_exp:
+            raise ValueError("need high_exp > low_exp")
+        self.name = name
+        self.help = help
+        self.bounds: Tuple[float, ...] = tuple(
+            2.0 ** e for e in range(low_exp, high_exp + 1))
+        self._lock = threading.Lock()
+        # label key -> [bucket counts (len(bounds)+1, last=overflow),
+        #               total count, running sum]
+        self._series: Dict[_LabelKey, list] = {}
+
+    def observe(self, value: float, **labels: Any) -> None:
+        idx = bisect.bisect_left(self.bounds, value)
+        key = _label_key(labels)
+        with self._lock:
+            series = self._series.get(key)
+            if series is None:
+                series = [[0] * (len(self.bounds) + 1), 0, 0.0]
+                self._series[key] = series
+            series[0][idx] += 1
+            series[1] += 1
+            series[2] += value
+
+    def samples(self) -> List[dict]:
+        with self._lock:
+            items = [(k, [list(s[0]), s[1], s[2]])
+                     for k, s in self._series.items()]
+        return [{"labels": dict(k),
+                 "buckets": [[b, c] for b, c in zip(self.bounds,
+                                                    counts)],
+                 "overflow": counts[len(self.bounds)],
+                 "count": count, "sum": total}
+                for k, (counts, count, total) in items]
+
+
+class MetricsRegistry:
+    """Named instruments plus weak-referenced stat collectors.
+
+    ``counter``/``gauge``/``histogram`` get-or-create by name (the
+    same name always yields the same instrument; a kind clash raises).
+    ``attach(kind, obj, **labels)`` registers any object exposing
+    ``as_dict()`` as a collector — its live values land under
+    ``snapshot()["stats"][kind]`` with the given labels. Collectors
+    are weakly referenced and pruned once their owner is collected.
+    """
+
+    _CRDTLINT_GUARDED = {"_lock": ("_instruments", "_collectors")}
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._instruments: Dict[str, Any] = {}
+        self._collectors: List[Tuple[str, Dict[str, str],
+                                     weakref.ref]] = []
+
+    def _get_or_create(self, cls, name: str, help: str, **kwargs):
+        with self._lock:
+            inst = self._instruments.get(name)
+            if inst is None:
+                inst = cls(name, help, **kwargs)
+                self._instruments[name] = inst
+            elif not isinstance(inst, cls):
+                raise ValueError(
+                    f"instrument {name!r} already registered as "
+                    f"{type(inst).__name__}, not {cls.__name__}")
+            return inst
+
+    def counter(self, name: str, help: str = "") -> Counter:
+        return self._get_or_create(Counter, name, help)
+
+    def gauge(self, name: str, help: str = "") -> Gauge:
+        return self._get_or_create(Gauge, name, help)
+
+    def histogram(self, name: str, help: str = "",
+                  low_exp: int = -20, high_exp: int = 5) -> Histogram:
+        return self._get_or_create(Histogram, name, help,
+                                   low_exp=low_exp, high_exp=high_exp)
+
+    def attach(self, kind: str, obj: Any, **labels: Any) -> Any:
+        """Register ``obj`` (anything with ``as_dict()``) as a live
+        stats collector; returns ``obj`` for chaining. Weakly held."""
+        entry = (kind, {str(k): str(v) for k, v in labels.items()},
+                 weakref.ref(obj))
+        with self._lock:
+            self._collectors.append(entry)
+        return obj
+
+    def snapshot(self) -> dict:
+        """Self-describing JSON-safe snapshot of every instrument and
+        every live collector. Dead collector entries are pruned."""
+        with self._lock:
+            instruments = list(self._instruments.values())
+            collectors = list(self._collectors)
+            self._collectors = [c for c in collectors
+                                if c[2]() is not None]
+        out = {"counters": {}, "gauges": {}, "histograms": {},
+               "stats": {}}
+        section = {"counter": "counters", "gauge": "gauges",
+                   "histogram": "histograms"}
+        for inst in instruments:
+            out[section[inst.kind]][inst.name] = inst.samples()
+        for kind, labels, ref in collectors:
+            obj = ref()
+            if obj is None:
+                continue
+            try:
+                values = obj.as_dict()
+            except Exception:
+                # A collector mid-teardown must not break the scrape.
+                continue
+            out["stats"].setdefault(kind, []).append(
+                {"labels": labels, "values": values})
+        return out
+
+
+_DEFAULT = MetricsRegistry()
+
+
+def default_registry() -> MetricsRegistry:
+    """The process-wide registry every in-tree instrument attaches to."""
+    return _DEFAULT

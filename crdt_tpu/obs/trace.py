@@ -1,0 +1,167 @@
+"""HLC-stamped structured trace events + profiler-annotated spans.
+
+A :class:`TraceRing` is a bounded in-memory event ring (newest N
+events) with an optional JSONL sink. Events are plain dicts:
+
+    {"seq": 17, "kind": "gossip_round", "mono_s": 123.456,
+     "hlc": "2026-08-05T..+0000-0000-n0", "peer": "b",
+     "outcome": "ok", "dur_s": 0.0123}
+
+- ``kind`` names the event class: ``merge`` (a merge dispatch span),
+  ``gossip_round``, ``wire_frame``, ``checkpoint``, ``breaker``,
+  ``bench_phase``.
+- ``hlc`` is the emitting replica's canonical HLC at emission — the
+  cluster-orderable stamp. ``mono_s`` (``time.monotonic()``) orders
+  events within one process; wall-clock reads stay where they belong
+  (``hlc.wall_clock_millis`` is the one sanctioned boundary).
+- ``dur_s`` is present on span-shaped events.
+
+**Cost model**: tracing is off by default and every emit site checks
+``tracer().enabled`` (one attribute read) first. :func:`span` always
+wraps its body in ``jax.profiler.TraceAnnotation`` — so TPU profiles
+show named merge/pack/wire phases whether or not the ring is on — and
+only times + emits when the ring is enabled. The stream-bench
+per-phase row (bench.py) pins the enabled-overhead at ~0 on the hot
+path.
+
+HLC arguments may be zero-arg callables; they are invoked only when an
+event is actually recorded, so disabled tracing never pays for a
+``str(Hlc)``.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+from collections import deque
+from contextlib import contextmanager
+from typing import Any, Dict, List, Optional
+
+import jax.profiler
+
+
+class TraceRing:
+    """Bounded in-memory trace event ring + optional JSONL sink."""
+
+    # crdtlint lock-discipline contract: ring storage and sink are
+    # touched only under self._lock. ``enabled`` is a bare bool read
+    # on hot paths by design (stale reads only delay on/off by one
+    # event).
+    _CRDTLINT_GUARDED = {"_lock": ("_events", "_sink", "_seq")}
+
+    def __init__(self, capacity: int = 4096):
+        self.enabled = False
+        self._lock = threading.Lock()
+        self._events: deque = deque(maxlen=capacity)
+        self._sink = None
+        self._seq = 0
+
+    @property
+    def capacity(self) -> int:
+        with self._lock:
+            return self._events.maxlen
+
+    def enable(self, capacity: Optional[int] = None,
+               jsonl_path: Optional[str] = None) -> "TraceRing":
+        """Turn event recording on; optionally resize the ring and/or
+        append every event to a JSONL file."""
+        with self._lock:
+            if capacity is not None:
+                self._events = deque(self._events, maxlen=capacity)
+            if jsonl_path is not None:
+                if self._sink is not None:
+                    self._sink.close()
+                self._sink = open(jsonl_path, "a", encoding="utf-8")
+        self.enabled = True
+        return self
+
+    def disable(self) -> None:
+        """Stop recording and close any JSONL sink."""
+        self.enabled = False
+        with self._lock:
+            if self._sink is not None:
+                self._sink.close()
+                self._sink = None
+
+    def clear(self) -> None:
+        with self._lock:
+            self._events.clear()
+
+    def emit(self, kind: str, hlc: Any = None, **fields: Any) -> None:
+        """Record one event (no-op while disabled). ``hlc`` may be an
+        `Hlc`, a string, or a zero-arg callable evaluated lazily."""
+        if not self.enabled:
+            return
+        event: Dict[str, Any] = {"kind": kind,
+                                 "mono_s": time.monotonic()}
+        if hlc is not None:
+            if callable(hlc):
+                hlc = hlc()
+            if hlc is not None:
+                event["hlc"] = str(hlc)
+        event.update(fields)
+        with self._lock:
+            self._seq += 1
+            event["seq"] = self._seq
+            self._events.append(event)
+            if self._sink is not None:
+                self._sink.write(json.dumps(event, default=str) + "\n")
+                self._sink.flush()
+
+    def events(self, kind: Optional[str] = None) -> List[dict]:
+        """Snapshot the ring (oldest first), optionally one kind."""
+        with self._lock:
+            out = list(self._events)
+        if kind is not None:
+            out = [e for e in out if e.get("kind") == kind]
+        return out
+
+
+_DEFAULT = TraceRing()
+
+# Span durations double into a fixed log2 histogram so the metrics op
+# exposes per-phase latency distributions, not just the event tail the
+# ring happens to hold. Created lazily to keep import order trivial.
+_SPAN_HIST = None
+_SPAN_HIST_LOCK = threading.Lock()
+
+
+def tracer() -> TraceRing:
+    """The process-wide trace ring every in-tree emit site uses."""
+    return _DEFAULT
+
+
+def _span_histogram():
+    global _SPAN_HIST
+    with _SPAN_HIST_LOCK:
+        if _SPAN_HIST is None:
+            from .registry import default_registry
+            _SPAN_HIST = default_registry().histogram(
+                "crdt_tpu_span_seconds",
+                "traced span durations by span name (log2 buckets)",
+                low_exp=-20, high_exp=5)
+        return _SPAN_HIST
+
+
+@contextmanager
+def span(name: str, kind: str = "span", hlc: Any = None,
+         **fields: Any):
+    """Profiler-annotated span: the body always runs inside
+    ``jax.profiler.TraceAnnotation(name)`` (named kernels in TPU
+    profiles); when the process tracer is enabled the span is also
+    timed, emitted as an HLC-stamped ring event, and observed into the
+    ``crdt_tpu_span_seconds`` histogram."""
+    ring = _DEFAULT
+    if not ring.enabled:
+        with jax.profiler.TraceAnnotation(name):
+            yield
+        return
+    start = time.perf_counter()
+    try:
+        with jax.profiler.TraceAnnotation(name):
+            yield
+    finally:
+        dur = time.perf_counter() - start
+        ring.emit(kind, hlc=hlc, span=name, dur_s=dur, **fields)
+        _span_histogram().observe(dur, span=name)

@@ -3,18 +3,25 @@ reference — the TPU build adds lightweight counters and profiler
 annotations around the merge kernel).
 
 `MergeStats` counts merges and record flow on a backend;
-`merge_annotation` wraps the device dispatch in a
-`jax.profiler.TraceAnnotation` so kernel time shows up named in TPU
-profiles (`jax.profiler.trace` / tensorboard).
+`merge_annotation` wraps the device dispatch in a profiler-annotated
+trace span (`crdt_tpu.obs.trace.span`) so kernel time shows up named
+in TPU profiles AND — when the process tracer is on — as HLC-stamped
+``merge`` events in the trace ring.
+
+The counter dataclasses are no longer orphans: ``register(**labels)``
+attaches an instance to the process-wide metrics registry
+(`crdt_tpu.obs.registry`) as a weak-referenced collector, so every
+live backend/peer appears in one ``metrics`` snapshot. Registration is
+read-side only — the hot-path accounting below stays plain host ints
+and lazy device scalars, untouched.
 """
 
 from __future__ import annotations
 
-from contextlib import contextmanager
 from dataclasses import dataclass
 from typing import Any
 
-import jax.profiler
+from ..obs.trace import span as _span
 
 
 @dataclass
@@ -79,6 +86,16 @@ class MergeStats:
         for k in self.as_dict():
             setattr(self, k, 0)
 
+    def register(self, **labels: Any) -> "MergeStats":
+        """Attach to the process-wide metrics registry as a ``merge``
+        collector (weakly held); returns self for chaining. Note the
+        scrape drains the lazy device sums (`records_seen` /
+        `records_adopted` force a device→host fetch) — snapshot from a
+        monitoring thread, not from inside a pipelined window."""
+        from ..obs.registry import default_registry
+        default_registry().attach("merge", self, **labels)
+        return self
+
 
 @dataclass
 class PeerSyncStats:
@@ -115,9 +132,18 @@ class PeerSyncStats:
         for f in self.as_dict():
             setattr(self, f, 0)
 
+    def register(self, **labels: Any) -> "PeerSyncStats":
+        """Attach to the process-wide metrics registry as a
+        ``peer_sync`` collector (weakly held); returns self."""
+        from ..obs.registry import default_registry
+        default_registry().attach("peer_sync", self, **labels)
+        return self
 
-@contextmanager
-def merge_annotation(name: str = "crdt_tpu.merge"):
-    """Named span around a merge dispatch for TPU profile traces."""
-    with jax.profiler.TraceAnnotation(name):
-        yield
+
+def merge_annotation(name: str = "crdt_tpu.merge", hlc: Any = None):
+    """Named span around a merge dispatch: always a
+    `jax.profiler.TraceAnnotation` for TPU profile traces; also an
+    HLC-stamped ``merge`` ring event when the process tracer is
+    enabled. ``hlc`` may be a zero-arg callable, evaluated only when
+    an event is actually recorded."""
+    return _span(name, kind="merge", hlc=hlc)

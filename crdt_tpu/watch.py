@@ -25,6 +25,22 @@ class ChangeEvent(NamedTuple):
 
 _ANY_KEY = object()   # sentinel: stream not filtered to a single key
 
+# Change events delivered to live subscribers, process-wide. Created
+# lazily so importing watch.py never drags in the obs package; touched
+# only when some stream is actually listening, so the nobody-watching
+# bulk path stays zero-cost.
+_WATCH_COUNTER = None
+
+
+def _watch_counter():
+    global _WATCH_COUNTER
+    if _WATCH_COUNTER is None:
+        from .obs.registry import default_registry
+        _WATCH_COUNTER = default_registry().counter(
+            "crdt_tpu_watch_events_total",
+            "change events fanned out to live watch subscribers")
+    return _WATCH_COUNTER
+
 
 class _EventBatch(NamedTuple):
     """A recorded batch held UNMATERIALIZED in a stream buffer: a 1M
@@ -247,6 +263,8 @@ class ChangeHub:
         event = ChangeEvent(key, value)
         for stream in list(self._streams):
             stream._emit(event)
+        if self.active:
+            _watch_counter().inc()
 
     def add_batch(self, pairs,
                   get: Optional[Callable[[Any], tuple]] = None) -> None:
@@ -272,6 +290,7 @@ class ChangeHub:
         (every in-tree caller builds fresh lists or passes decode
         products that are never written again)."""
         mat = None
+        keyed_hits = 0
         for stream in list(self._streams):
             if not (stream._recording or stream._callbacks):
                 continue   # no sink: never materialize on its behalf
@@ -280,10 +299,15 @@ class ChangeHub:
                 present, v = get(k)
                 if present:
                     stream._emit(ChangeEvent(k, v))
+                    keyed_hits += 1
                 continue
             if mat is None:
                 mat = pairs() if callable(pairs) else pairs
             stream._emit_many(*mat)
+        if mat is not None:
+            _watch_counter().inc(len(mat[0]))
+        elif keyed_hits:
+            _watch_counter().inc(keyed_hits)
 
     def stream(self, key: Any = None) -> ChangeStream:
         if key is None:

@@ -1,0 +1,503 @@
+"""Unified telemetry (crdt_tpu.obs): metrics registry, HLC-stamped
+trace ring, convergence-lag monitor, the ``metrics`` wire op, and the
+``python -m crdt_tpu.obs`` CLI — plus the crdtlint gate over the obs
+package itself.
+
+The registry under test is usually a FRESH ``MetricsRegistry`` (unit
+scope); end-to-end tests go through the process-wide default registry
+and therefore filter snapshots by label instead of asserting global
+counts (other tests' backends live in the same process).
+"""
+
+import io
+import json
+import random
+import threading
+
+import pytest
+
+from crdt_tpu import (DenseCrdt, GossipNode, Hlc, MapCrdt, Record,
+                      RetryPolicy, SqliteCrdt, fetch_metrics)
+from crdt_tpu.obs import (default_registry, metrics_snapshot, span,
+                          tracer)
+from crdt_tpu.obs.lag import health_status, lag_entry, lag_millis
+from crdt_tpu.obs.registry import (Counter, Gauge, Histogram,
+                                   MetricsRegistry)
+from crdt_tpu.obs.render import (format_phase_table, render_prometheus,
+                                 render_summary, summarize_trace)
+from crdt_tpu.obs.trace import TraceRing
+from crdt_tpu.testing import FakeClock, FaultProxy, FaultSchedule
+from crdt_tpu.utils.stats import MergeStats
+
+pytestmark = pytest.mark.obs
+
+NO_SLEEP = lambda _s: None
+
+
+# ---------------------------------------------------------------- registry
+
+
+def test_counter_inc_value_and_labels():
+    c = Counter("reqs_total", "requests")
+    c.inc()
+    c.inc(2, route="a")
+    c.inc(route="a")
+    assert c.value() == 1
+    assert c.value(route="a") == 3
+    assert c.value(route="never") == 0
+    by_labels = {tuple(sorted(s["labels"].items())): s["value"]
+                 for s in c.samples()}
+    assert by_labels == {(): 1, (("route", "a"),): 3}
+
+
+def test_counter_rejects_negative_increment():
+    c = Counter("n", "")
+    with pytest.raises(ValueError):
+        c.inc(-1)
+
+
+def test_gauge_set_and_add():
+    g = Gauge("depth", "")
+    g.set(5, q="x")
+    g.add(-2, q="x")
+    assert g.value(q="x") == 3
+
+
+def test_histogram_log2_buckets_and_overflow():
+    h = Histogram("lat", "", low_exp=-2, high_exp=2)
+    assert h.bounds == (0.25, 0.5, 1.0, 2.0, 4.0)
+    h.observe(0.2)     # <= 0.25 -> first bucket
+    h.observe(0.25)    # boundary lands in its own bucket (le=0.25)
+    h.observe(3.0)     # <= 4.0 -> last finite bucket
+    h.observe(100.0)   # overflow (+Inf)
+    (s,) = h.samples()
+    assert s["count"] == 4
+    assert s["sum"] == pytest.approx(103.45)
+    assert s["overflow"] == 1
+    counts = dict(s["buckets"])
+    assert counts[0.25] == 2
+    assert counts[4.0] == 1
+
+
+def test_registry_get_or_create_and_kind_clash():
+    reg = MetricsRegistry()
+    c1 = reg.counter("a_total", "help")
+    c2 = reg.counter("a_total")
+    assert c1 is c2
+    with pytest.raises(ValueError):
+        reg.gauge("a_total")
+
+
+def test_registry_snapshot_shape():
+    reg = MetricsRegistry()
+    reg.counter("c_total").inc(7)
+    reg.gauge("g").set(1.5)
+    reg.histogram("h", low_exp=0, high_exp=1).observe(1.0)
+    snap = reg.snapshot()
+    assert snap["counters"]["c_total"][0]["value"] == 7
+    assert snap["gauges"]["g"][0]["value"] == 1.5
+    assert snap["histograms"]["h"][0]["count"] == 1
+
+
+def test_stats_collectors_absorbed_and_weakly_held():
+    import gc
+    reg = MetricsRegistry()
+    ms = MergeStats()
+    ms.merges = 3
+    reg.attach("merge", ms, backend="X", node="n1")
+    entries = reg.snapshot()["stats"]["merge"]
+    assert entries == [{"labels": {"backend": "X", "node": "n1"},
+                        "values": ms.as_dict()}]
+    del ms
+    gc.collect()
+    assert reg.snapshot()["stats"].get("merge", []) == []
+
+
+def test_backends_register_with_default_registry():
+    crdt = SqliteCrdt("obs-reg-node")
+    crdt.merge({"k": Record(Hlc(1_700_000_000_000, 0, "peer"), 1,
+                            Hlc(1_700_000_000_000, 0, "peer"))})
+    merge_rows = metrics_snapshot()["stats"]["merge"]
+    (row,) = [e for e in merge_rows
+              if e["labels"].get("node") == "obs-reg-node"]
+    assert row["labels"]["backend"] == "SqliteCrdt"
+    assert row["values"]["merges"] == 1
+    assert row["values"]["records_seen"] == 1
+    assert row["values"]["records_adopted"] == 1
+
+
+# ---------------------------------------------------------------- trace ring
+
+
+def test_ring_disabled_is_noop_and_lazy_hlc_not_evaluated():
+    ring = TraceRing()
+    calls = []
+    ring.emit("merge", hlc=lambda: calls.append(1))
+    assert ring.events() == [] and calls == []
+
+
+def test_ring_bounded_and_ordered():
+    ring = TraceRing(capacity=3)
+    ring.enabled = True
+    for i in range(5):
+        ring.emit("k", i=i)
+    assert [e["i"] for e in ring.events()] == [2, 3, 4]
+    assert [e["seq"] for e in ring.events()] == [3, 4, 5]
+
+
+def test_ring_jsonl_sink_and_hlc_stamp(tmp_path):
+    path = str(tmp_path / "trace.jsonl")
+    ring = TraceRing()
+    ring.enable(jsonl_path=path)
+    ring.emit("merge", hlc=lambda: Hlc(1_700_000_000_000, 2, "a"),
+              n=1)
+    ring.disable()
+    (line,) = open(path).read().splitlines()
+    event = json.loads(line)
+    assert event["kind"] == "merge" and event["n"] == 1
+    assert event["hlc"] == str(Hlc(1_700_000_000_000, 2, "a"))
+
+
+def test_span_emits_duration_and_histogram_sample():
+    ring = tracer()
+    ring.enable()
+    ring.clear()
+    try:
+        with span("obs.test.phase", kind="bench_phase"):
+            pass
+        (event,) = ring.events("bench_phase")
+        assert event["span"] == "obs.test.phase"
+        assert event["dur_s"] >= 0
+        hist = default_registry().histogram("crdt_tpu_span_seconds")
+        assert any(s["labels"] == {"span": "obs.test.phase"}
+                   for s in hist.samples())
+    finally:
+        ring.disable()
+        ring.clear()
+
+
+# ---------------------------------------------------------------- lag math
+
+
+def test_lag_millis_and_entry():
+    head = Hlc(1_700_000_060_000, 0, "a")
+    mark = Hlc(1_700_000_000_000, 3, "a")
+    assert lag_millis(head, mark) == 60_000
+    assert lag_millis(head, None) is None
+    assert lag_millis(mark, head) == 0    # clamped, never negative
+    entry = lag_entry(head, mark, pending=4, breaker="closed",
+                      dense=True)
+    assert entry["synced"] and entry["lag_ms"] == 60_000
+    assert entry["pending_records"] == 4 and entry["dense"]
+    never = lag_entry(head, None)
+    assert not never["synced"] and never["lag_ms"] is None
+
+
+def test_health_status_rules():
+    head = Hlc(1_700_000_060_000, 0, "a")
+    ok = {"b": lag_entry(head, Hlc(1_700_000_059_000, 0, "a"),
+                         breaker="closed")}
+    assert health_status(ok) == "ok"
+    assert health_status(ok, stale_after_ms=500) == "degraded"
+    assert health_status(
+        {"b": lag_entry(head, None)}) == "degraded"
+    open_breaker = {"b": lag_entry(head, head, breaker="open")}
+    assert health_status(open_breaker) == "degraded"
+    assert health_status({}) == "ok"
+
+
+# ------------------------------------------------- count_modified_since
+
+
+def _mk_since(crdt):
+    crdt.put("k1", 1)
+    since = crdt.canonical_time
+    crdt.put("k2", 2)
+    crdt.put("k3", 3)
+    return since
+
+
+def test_count_modified_since_map():
+    crdt = MapCrdt("a", wall_clock=FakeClock())
+    since = _mk_since(crdt)
+    # Inclusive bound (map_crdt.dart:44-45): the record at the watermark
+    # itself still counts, so k1 is in the backlog along with k2/k3.
+    assert crdt.count_modified_since(since) == 3
+    assert crdt.count_modified_since(None) == 3
+    assert crdt.count_modified_since(since) == \
+        len(crdt.record_map(modified_since=since))
+
+
+def test_count_modified_since_sqlite():
+    crdt = SqliteCrdt("a", wall_clock=FakeClock())
+    since = _mk_since(crdt)
+    assert crdt.count_modified_since(since) == 3
+    assert crdt.count_modified_since(None) == 3
+    # matches the record_map view it summarizes
+    assert crdt.count_modified_since(since) == \
+        len(crdt.record_map(modified_since=since))
+
+
+def test_count_modified_since_dense():
+    crdt = DenseCrdt("a", 16, wall_clock=FakeClock())
+    crdt.put_batch([1], [10])
+    since = crdt.canonical_time
+    crdt.put_batch([2], [20])
+    crdt.delete_batch([1])   # tombstones count: they still need shipping
+    assert crdt.count_modified_since(since) == 2
+    assert crdt.count_modified_since(None) == 2
+
+
+# -------------------------------------------------- metrics wire op / e2e
+
+
+def _node(crdt, **kw):
+    kw.setdefault("rng", random.Random(7))
+    kw.setdefault("sleep", NO_SLEEP)
+    return GossipNode(crdt, **kw)
+
+
+def test_metrics_wire_op_end_to_end():
+    clk = FakeClock()
+    a = _node(MapCrdt("obs-a", wall_clock=clk))
+    b = _node(MapCrdt("obs-b", wall_clock=clk))
+    with a, b:
+        a.add_peer("b", b.host, b.port)
+        with a.lock:
+            a.crdt.put("x", 1)
+            a.crdt.put("y", 2)
+        assert a.run_round() == {"b": "ok"}
+        snap = fetch_metrics(a.host, a.port)
+
+    assert snap["node"]["node_id"] == "obs-a"
+    assert "hlc_head" in snap["node"]
+    # per-peer HLC lag, from the node that owns the peers
+    entry = snap["lag"]["b"]
+    assert entry["synced"] is True
+    assert entry["lag_ms"] is not None and entry["lag_ms"] >= 0
+    assert entry["pending_records"] is not None
+    assert entry["breaker"] == "closed"
+    # per-peer gossip counters
+    (peer_row,) = [e for e in snap["stats"]["peer_sync"]
+                   if e["labels"].get("node") == "obs-a"]
+    assert peer_row["labels"]["peer"] == "b"
+    assert peer_row["values"]["rounds_ok"] == 1
+    assert peer_row["values"]["bytes_sent"] > 0
+    # merge counters from the remote replica's ingest
+    merge_rows = [e for e in snap["stats"]["merge"]
+                  if e["labels"].get("node") == "obs-b"]
+    assert merge_rows and merge_rows[0]["values"]["records_seen"] >= 2
+    # wire bytes, both roles
+    roles = {e["labels"]["role"] for e in snap["stats"]["wire"]}
+    assert {"server", "client"} <= roles
+    client_rows = [e for e in snap["stats"]["wire"]
+                   if e["labels"] == {"role": "client",
+                                      "node": "obs-a"}]
+    assert client_rows[0]["values"]["sent"] > 0
+
+    # the snapshot renders in both formats without loss
+    prom = render_prometheus(snap)
+    assert 'crdt_tpu_peer_synced{node="obs-a",peer="b"} 1' in prom
+    assert "crdt_tpu_merge_merges_total" in prom
+    assert "crdt_tpu_wire_sent_bytes_total" in prom
+    human = render_summary(snap)
+    assert "obs-a" in human and "b" in human
+
+
+def test_metrics_op_on_bare_sync_server():
+    """A SyncServer without a GossipNode still answers: registry
+    snapshot plus its own node identity, no lag section."""
+    from crdt_tpu.net import SyncServer
+    crdt = MapCrdt("obs-bare", wall_clock=FakeClock())
+    server = SyncServer(crdt)
+    server.start()
+    try:
+        snap = fetch_metrics(server.host, server.port)
+    finally:
+        server.stop()
+    assert snap["node"]["node_id"] == "obs-bare"
+    assert "lag" not in snap
+    assert "stats" in snap
+
+
+def test_unknown_op_still_rejected():
+    """The metrics op must not have loosened the op whitelist."""
+    from crdt_tpu.net import (SyncProtocolError, SyncServer,
+                              recv_frame, send_frame)
+    import socket
+    import time
+    crdt = MapCrdt("obs-unknown", wall_clock=FakeClock())
+    server = SyncServer(crdt)
+    server.start()
+    try:
+        with socket.create_connection((server.host, server.port),
+                                      timeout=5) as sock:
+            send_frame(sock, {"op": "metricz"})
+            reply = recv_frame(sock, deadline=time.monotonic() + 5)
+        assert reply["code"] == "unknown_op"
+    finally:
+        server.stop()
+
+
+# --------------------------------- satellite: partitioned-peer lag growth
+
+
+def test_three_node_lag_grows_under_partition_and_heals():
+    """Hub node `a` gossips with a healthy peer `b` and a peer `c`
+    behind an all-drop fault proxy. After one clean sync everywhere,
+    the partition begins: c's lag (local head minus its watermark)
+    grows with every local write while b's stays near zero, health
+    degrades once c is staler than the threshold — then the proxy
+    heals, one round collapses c's lag, and health returns to ok."""
+    clk = FakeClock()
+    a = _node(MapCrdt("a", wall_clock=clk),
+              retry=RetryPolicy(max_attempts=1, base_delay=0.001))
+    b = _node(MapCrdt("b", wall_clock=clk))
+    c = _node(MapCrdt("c", wall_clock=clk))
+    with a, b, c:
+        drop_all = FaultSchedule(rate=1.0, kinds={"drop": 1})
+        with FaultProxy(c.host, c.port, drop_all) as proxy:
+            proxy.passthrough = True          # healthy to begin with
+            a.add_peer("b", b.host, b.port)
+            a.add_peer("c", proxy.host, proxy.port)
+            with a.lock:
+                a.crdt.put("k0", 0)
+            assert a.run_round() == {"b": "ok", "c": "ok"}
+            lag0 = a.lag_snapshot()
+            assert lag0["c"]["synced"] and lag0["b"]["synced"]
+
+            proxy.passthrough = False         # partition begins
+            samples = []
+            for i in range(3):
+                clk.advance(10_000)
+                with a.lock:
+                    a.crdt.put(f"p{i}", i)
+                outcome = a.run_round()
+                assert outcome["b"] == "ok"
+                assert outcome["c"] == "failed"
+                snap = a.lag_snapshot()
+                samples.append(snap["c"]["lag_ms"])
+                # healthy peer keeps re-syncing: watermark tracks head
+                assert snap["b"]["lag_ms"] < snap["c"]["lag_ms"]
+            # monotone growth while partitioned
+            assert samples == sorted(samples)
+            assert samples[-1] > samples[0] >= 10_000
+            assert snap["c"]["pending_records"] >= 3
+            health = a.health(stale_after_ms=15_000)
+            assert health["status"] == "degraded"
+
+            proxy.passthrough = True          # heal
+            assert a.sync_peer("c") == "ok"
+            healed = a.lag_snapshot()["c"]
+            assert healed["lag_ms"] < samples[0]
+            assert a.health(stale_after_ms=15_000)["status"] == "ok"
+    assert a.crdt.map == c.crdt.map
+
+
+# ---------------------------------------------------------------- CLI
+
+
+def test_cli_once_summary_json_and_prom():
+    from crdt_tpu.obs.cli import main as obs_main
+    clk = FakeClock()
+    a = _node(MapCrdt("obs-cli", wall_clock=clk))
+    b = _node(MapCrdt("obs-cli-b", wall_clock=clk))
+    with a, b:
+        a.add_peer("b", b.host, b.port)
+        with a.lock:
+            a.crdt.put("x", 1)
+        assert a.run_round() == {"b": "ok"}
+        target = f"{a.host}:{a.port}"
+
+        out = io.StringIO()
+        assert obs_main([target, "--once"], out=out) == 0
+        assert "obs-cli" in out.getvalue()
+
+        out = io.StringIO()
+        assert obs_main([target, "--once", "--json"], out=out) == 0
+        snap = json.loads(out.getvalue())
+        assert snap["node"]["node_id"] == "obs-cli"
+        assert snap["lag"]["b"]["synced"] is True
+
+        out = io.StringIO()
+        assert obs_main([target, "--once", "--prom"], out=out) == 0
+        assert "crdt_tpu_peer_synced" in out.getvalue()
+
+
+def test_cli_poll_failure_returns_nonzero():
+    import socket
+    from crdt_tpu.obs.cli import main as obs_main
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+    assert obs_main([f"127.0.0.1:{port}", "--once"],
+                    out=io.StringIO()) == 1
+
+
+def test_cli_trace_summary_table(tmp_path):
+    from crdt_tpu.obs.cli import main as obs_main
+    path = str(tmp_path / "trace.jsonl")
+    with open(path, "w") as f:
+        for dur in (0.010, 0.020, 0.030):
+            f.write(json.dumps({"kind": "merge", "span": "merge",
+                                "dur_s": dur}) + "\n")
+        f.write(json.dumps({"kind": "gossip_round",
+                            "dur_s": 0.5}) + "\n")
+        f.write(json.dumps({"kind": "breaker"}) + "\n")  # no dur_s
+        f.write("{corrupt json\n")                       # tail line
+    out = io.StringIO()
+    assert obs_main(["--trace", path], out=out) == 0
+    table = out.getvalue()
+    assert "merge" in table and "gossip_round" in table
+    assert "breaker" not in table
+
+
+def test_summarize_trace_percentiles():
+    events = [{"kind": "merge", "span": "m", "dur_s": d / 100}
+              for d in range(1, 101)]
+    summary = summarize_trace(events)
+    stats = summary["m"]
+    assert stats["count"] == 100
+    assert stats["p50_s"] == pytest.approx(0.50)
+    assert stats["p95_s"] == pytest.approx(0.95)
+    assert stats["max_s"] == pytest.approx(1.00)
+    table = format_phase_table(summary)
+    assert "m" in table
+    assert format_phase_table({}) == "no span events\n"
+
+
+# ----------------------------------------------- breaker trace events
+
+
+def test_breaker_transitions_emit_trace_events():
+    from crdt_tpu import BreakerPolicy, CircuitBreaker
+    clock = [100.0]
+    br = CircuitBreaker(BreakerPolicy(failure_threshold=1,
+                                      reset_timeout=5.0),
+                        clock=lambda: clock[0], name="peer-x")
+    ring = tracer()
+    ring.enable()
+    ring.clear()
+    try:
+        br.record_failure()                   # -> open
+        clock[0] += 6.0
+        assert br.allow()                     # -> half_open
+        br.record_success()                   # -> closed
+        states = [e["state"] for e in ring.events("breaker")
+                  if e["peer"] == "peer-x"]
+        assert states == ["open", "half_open", "closed"]
+    finally:
+        ring.disable()
+        ring.clear()
+
+
+# ------------------------------------------------ satellite: lint gate
+
+
+@pytest.mark.analysis
+def test_crdtlint_clean_on_obs_package():
+    import os
+    from crdt_tpu.analysis.cli import main as lint_main
+    obs_dir = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "crdt_tpu", "obs")
+    assert lint_main(["--lint", obs_dir, "--json"]) == 0
