@@ -475,6 +475,106 @@ def bench_e2e_generator_only(n_keys: int, rows_per_pass: int = 128,
     return out
 
 
+def bench_sync(n_slots: int = 1 << 14, k: int = 256,
+               rounds: int = 32) -> dict:
+    """End-to-end two-replica sync over the pooled packed fast path.
+
+    Spins up two `GossipNode`s (real sockets on loopback) and reports,
+    in one JSON line, the three acceptance signals of the fast path:
+    a pooled round vs a fresh-connect round on wall-clock, wire bytes
+    for k- vs 2k-row deltas (proportional to the change, not the
+    store), and a steady-state no-change round's pack-cache counters
+    (zero misses == zero device packs) — plus the negotiated zlib
+    compression ratio off the node's `WireTally`."""
+    import statistics
+    import numpy as np
+    from crdt_tpu.gossip import GossipNode
+    from crdt_tpu.models.dense_crdt import DenseCrdt
+    from crdt_tpu.net import PeerConnection, sync_packed_over_conn
+    from crdt_tpu.obs.registry import default_registry
+
+    a = GossipNode(DenseCrdt("a", n_slots=n_slots))
+    b = GossipNode(DenseCrdt("b", n_slots=n_slots))
+    rng = np.random.default_rng(7)
+    cache = default_registry().counter("crdt_tpu_pack_cache_total", "")
+    med = statistics.median
+    out = {"metric": "e2e_sync", "unit": "s/round",
+           "n_slots": n_slots, "rows_per_round": k,
+           "platform": jax.devices()[0].platform}
+    with a, b:
+        peer = a.add_peer("b", b.host, b.port)
+
+        def write(node, n):
+            slots = rng.choice(n_slots, size=n, replace=False)
+            with node.lock:
+                node.crdt.put_batch(
+                    slots.tolist(), [int(s) % 1000 for s in slots])
+
+        def round_pooled():
+            t0 = time.perf_counter()
+            outcome = a.sync_peer("b")
+            assert outcome == "ok", outcome
+            return time.perf_counter() - t0
+
+        write(a, k)
+        write(b, k)
+        round_pooled()                # first contact: connect + hello
+
+        pooled = []
+        for _ in range(rounds):
+            write(a, k)
+            pooled.append(round_pooled())
+
+        fresh = []                    # connect + hello paid every round
+        for _ in range(rounds):
+            write(a, k)
+            t0 = time.perf_counter()
+            fc = PeerConnection(b.host, b.port, timeout=10.0)
+            try:
+                mark = sync_packed_over_conn(
+                    a.crdt, fc, since=peer.watermark, lock=a.lock)
+            finally:
+                fc.close()
+            fresh.append(time.perf_counter() - t0)
+            peer.watermark = mark
+
+        def round_bytes(n):
+            write(a, n)
+            before = peer.stats.bytes_sent + peer.stats.bytes_received
+            round_pooled()
+            return (peer.stats.bytes_sent + peer.stats.bytes_received
+                    - before)
+
+        bytes_k = round_bytes(k)
+        bytes_2k = round_bytes(2 * k)
+
+        for _ in range(6):            # settle: clocks still, caches warm
+            round_pooled()
+        miss0 = (cache.value(outcome="miss", node="a")
+                 + cache.value(outcome="miss", node="b"))
+        hit0 = cache.value(outcome="hit", node="a")
+        nochange_s = round_pooled()
+        miss_delta = (cache.value(outcome="miss", node="a")
+                      + cache.value(outcome="miss", node="b")
+                      - miss0)
+        hit_delta = cache.value(outcome="hit", node="a") - hit0
+
+        out.update({
+            "pooled_round_s": round(med(pooled), 6),
+            "fresh_round_s": round(med(fresh), 6),
+            "pooled_speedup": round(med(fresh) / med(pooled), 3),
+            "bytes_round_k": int(bytes_k),
+            "bytes_round_2k": int(bytes_2k),
+            "bytes_growth": round(bytes_2k / bytes_k, 3),
+            "z_ratio": round(a.wire.z_ratio, 4),
+            "nochange_round_s": round(nochange_s, 6),
+            "nochange_pack_misses": int(miss_delta),
+            "nochange_pack_hits": int(hit_delta),
+            "pooled_connects": peer.conn.connects,
+        })
+    return out
+
+
 def result_dict(metric: str, merges: int, secs: float,
                 path: str = None, platform: str = None) -> dict:
     """The one-line JSON contract shared by bench.py and the suite.
@@ -503,14 +603,18 @@ def main() -> None:
     ap.add_argument("--repeats", type=int, default=64,
                     help="chained timed runs (one readback at the end)")
     ap.add_argument("--mode",
-                    choices=("stream", "distinct", "e2e", "e2e-kernel"),
+                    choices=("stream", "distinct", "e2e", "e2e-kernel",
+                             "sync"),
                     default="stream",
                     help="stream: write-stream replay (chunk replayed "
                          "with +1ms offsets); distinct: HBM-resident "
                          "independent replica rows (north-star shape); "
                          "e2e: 1024 fresh distinct rows through the "
                          "model API (pipelined); e2e-kernel: same loop "
-                         "against the raw kernel")
+                         "against the raw kernel; sync: two-replica "
+                         "gossip over loopback sockets — pooled vs "
+                         "fresh-connect latency, delta bytes, "
+                         "compression ratio, pack-cache hits")
     ap.add_argument("--rows", type=int, default=128,
                     help="distinct mode: replica rows resident in HBM")
     ap.add_argument("--loops", type=int, default=48,
@@ -528,7 +632,12 @@ def main() -> None:
     n_replicas = args.replicas or n_replicas
     chunk = args.chunk or chunk
 
-    if args.mode in ("e2e", "e2e-kernel"):
+    if args.mode == "sync":
+        result = bench_sync(
+            n_slots=1 << 10 if args.smoke else 1 << 14,
+            k=32 if args.smoke else 256,
+            rounds=4 if args.smoke else 32)
+    elif args.mode in ("e2e", "e2e-kernel"):
         result = bench_e2e_1024(
             n_keys,
             rows_per_pass=16 if args.smoke else args.rows,

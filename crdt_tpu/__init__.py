@@ -32,10 +32,13 @@ from .models.dense_crdt import (DenseCrdt, PipelinedGuardError,
                                 ShardedDenseCrdt, sync_dense)
 from .models.keyed_dense import KeyedDenseCrdt
 from .models.sqlite_crdt import SqliteCrdt
-from .sync import sync, sync_json
-from .net import (SyncError, SyncProtocolError, SyncServer,
-                  SyncTransportError, WireTally, fetch_metrics,
-                  sync_dense_over_tcp, sync_over_tcp)
+from .sync import sync, sync_json, sync_packed
+from .net import (FrameCodec, PeerConnection, SyncError,
+                  SyncProtocolError, SyncServer, SyncTransportError,
+                  WireTally, fetch_metrics, sync_dense_over_conn,
+                  sync_dense_over_tcp, sync_over_conn, sync_over_tcp,
+                  sync_packed_over_conn)
+from .ops.packing import PackedDelta
 from .obs import (MetricsRegistry, TraceRing, default_registry,
                   metrics_snapshot, tracer)
 from .checkpoint import (load_dense, load_gossip_state, load_json,
@@ -53,7 +56,10 @@ __all__ = [
     "ChangeStream", "MapCrdt", "TpuMapCrdt", "DenseCrdt",
     "ShardedDenseCrdt", "KeyedDenseCrdt", "PipelinedGuardError",
     "sync_dense", "SqliteCrdt",
-    "sync", "sync_json", "SyncServer", "sync_dense_over_tcp", "sync_over_tcp",
+    "sync", "sync_json", "sync_packed", "SyncServer",
+    "sync_dense_over_tcp", "sync_over_tcp",
+    "PeerConnection", "FrameCodec", "PackedDelta",
+    "sync_over_conn", "sync_dense_over_conn", "sync_packed_over_conn",
     "SyncError", "SyncTransportError", "SyncProtocolError", "WireTally",
     "fetch_metrics",
     "GossipNode", "Peer", "RetryPolicy", "BreakerPolicy", "CircuitBreaker",

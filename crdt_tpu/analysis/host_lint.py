@@ -143,6 +143,29 @@ def _check_sockets(tree: ast.AST, path: str) -> List[Finding]:
                 message=f"{d}(...) without a timeout bound (no timeout= "
                         "and no settimeout on the result); a silent peer "
                         "stalls this path forever"))
+    # Pooled sessions: PeerConnection(..., idle_timeout=None) disables
+    # the stale-session bound, so a parked connection can outlive the
+    # server's io_timeout and the next round races a half-closed
+    # socket. The default (20 s) is deliberately below the server's
+    # 30 s — only an explicit None is flagged.
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        d = _dotted(node.func)
+        if d is None or d.rsplit(".", 1)[-1] != "PeerConnection":
+            continue
+        for kw in node.keywords:
+            if kw.arg == "idle_timeout" \
+                    and isinstance(kw.value, ast.Constant) \
+                    and kw.value.value is None:
+                out.append(Finding(
+                    rule="socket-no-timeout", path=path,
+                    line=node.lineno,
+                    message="PeerConnection(..., idle_timeout=None) "
+                            "disables the stale-session bound; a "
+                            "parked session can outlive the server's "
+                            "io_timeout and the next round races a "
+                            "half-closed socket"))
     return out
 
 

@@ -14,9 +14,22 @@ converging through drops, delays, truncations and crashes:
 - A per-peer **circuit breaker**: open after N consecutive failed
   rounds, half-open probe after a cool-down, close again on success —
   a dead peer costs one probe per reset window, not a retry storm.
-- **Graceful wire-form degradation**: peers start on the dense binary
-  form when the local replica speaks it, and downgrade (sticky) to
-  the universal JSON path the moment the peer rejects a dense op.
+- **Pooled sessions**: each peer keeps one `net.PeerConnection` — a
+  keep-alive framed session with hello capability negotiation —
+  instead of paying a fresh TCP connect (and a fresh zlib
+  negotiation) every round. Any round error RESETS the session and
+  the normal retry machinery reconnects; `stop()` says ``bye``.
+- **Graceful wire-form degradation**: peers aim at the fastest wire
+  form the local replica speaks (``packed`` O(k) columnar, then the
+  ``dense`` kernel form, then universal JSON) and downgrade (sticky)
+  one step the moment the peer rejects an op. Capability selection
+  is separate and free: a session whose hello did not advertise
+  ``packed`` simply isn't offered it — no rejection round-trip, no
+  ``fallbacks`` count, and the peer's aim is retried on reconnect.
+- **Pipelined sweeps**: `run_round` overlaps round N+1's device-side
+  ``pack_since`` with round N's socket I/O (double-buffered through a
+  one-worker executor), so a multi-peer sweep hides pack latency
+  behind the wire instead of paying pack→send→recv→merge serially.
 - **Durable watermarks** (`checkpoint.save_gossip_state`): the
   per-peer delta watermark survives a crash, so a restarted node
   resumes DELTA sync instead of re-pulling full peer state. (The
@@ -38,14 +51,16 @@ from __future__ import annotations
 import random
 import threading
 import time
+from concurrent.futures import ThreadPoolExecutor
 from dataclasses import dataclass
-from typing import Any, Callable, Dict, Optional
+from typing import Any, Callable, Dict, List, Optional, Tuple
 
 from .checkpoint import load_gossip_state, save_gossip_state
 from .crdt import Crdt
 from .hlc import Hlc
-from .net import (SyncProtocolError, SyncServer, SyncTransportError,
-                  WireTally, sync_dense_over_tcp, sync_over_tcp)
+from .net import (PeerConnection, SyncProtocolError, SyncServer,
+                  SyncTransportError, WireTally, sync_dense_over_conn,
+                  sync_over_conn, sync_packed_over_conn)
 from .obs.lag import health_status, lag_entry
 from .obs.registry import default_registry
 from .obs.trace import tracer
@@ -140,28 +155,49 @@ class CircuitBreaker:
                 self._stats.breaker_opened += 1
 
 
+# Wire modes a peer can aim at, fastest first. Downgrades are sticky
+# and one-way: packed -> dense -> json.
+_MODES = ("packed", "dense", "json")
+
+
 class Peer:
-    """One gossip neighbour: address, current wire mode, delta
-    watermark, breaker, counters. ``name`` is the durable identity the
-    watermark persists under — keep it stable across restarts."""
+    """One gossip neighbour: address, pooled session, current wire
+    mode, delta watermark, breaker, counters. ``name`` is the durable
+    identity the watermark persists under — keep it stable across
+    restarts."""
 
     def __init__(self, name: str, host: str, port: int, *,
-                 dense: bool,
+                 mode: str,
                  breaker: CircuitBreaker,
                  stats: PeerSyncStats,
-                 watermark: Optional[Hlc] = None):
+                 watermark: Optional[Hlc] = None,
+                 timeout: float = 30.0):
+        if mode not in _MODES:
+            raise ValueError(f"unknown wire mode {mode!r}")
         self.name = name
         self.host = host
         self.port = port
-        self.dense = dense            # sticky: downgraded on rejection
+        self.mode = mode              # sticky: downgraded on rejection
+        self.conn = PeerConnection(host, port, timeout=timeout)
         self.breaker = breaker
         self.stats = stats
         self.watermark = watermark
         self.last_error: Optional[Exception] = None
+        self.last_attempt = mode      # wire form of the newest round
+
+    @property
+    def dense(self) -> bool:
+        """Back-compat view of :attr:`mode`: any binary form counts
+        as dense (the pre-packed API exposed only that split)."""
+        return self.mode != "json"
+
+    @dense.setter
+    def dense(self, value: bool) -> None:
+        self.mode = "dense" if value else "json"
 
     def __repr__(self) -> str:
         return (f"Peer({self.name!r}, {self.host}:{self.port}, "
-                f"{'dense' if self.dense else 'json'}, "
+                f"{self.mode}, "
                 f"breaker={self.breaker.state}, "
                 f"watermark={self.watermark})")
 
@@ -173,6 +209,15 @@ class Peer:
 # from pre-taxonomy servers map to.
 _DENSE_FALLBACK_CODES = frozenset(
     {"dense_rejected", "unknown_op", "rejected"})
+
+# Codes that mean "this peer will not take the packed columnar form"
+# even though its session advertised (or predated) the capability —
+# drop one step, to dense, and rerun. A session that never advertised
+# "packed" is handled earlier and cheaper: `_one_round` simply never
+# offers the form (capability selection, not a rejection — no
+# fallback counted, no wasted round-trip).
+_PACKED_FALLBACK_CODES = frozenset(
+    {"packed_rejected", "unknown_op", "rejected"})
 
 
 class GossipNode:
@@ -263,23 +308,44 @@ class GossipNode:
         write from outside the gossip thread."""
         return self.server.lock
 
+    def _default_mode(self, binary: bool) -> str:
+        """Fastest wire form the LOCAL replica can speak. What the
+        peer accepts is discovered per session (hello caps) and per
+        round (sticky rejection downgrade)."""
+        if not binary:
+            return "json"
+        if hasattr(self.crdt, "pack_since") \
+                and hasattr(self.crdt, "merge_packed"):
+            return "packed"
+        return "dense"
+
     def add_peer(self, name: str, host: str, port: int,
-                 dense: Optional[bool] = None) -> Peer:
+                 dense: Optional[bool] = None, *,
+                 mode: Optional[str] = None) -> Peer:
         """Register (or re-address) a peer. A persisted watermark for
-        ``name`` is resumed; ``dense`` overrides the node-level wire
-        preference for this peer."""
+        ``name`` is resumed. ``mode`` pins the starting wire form
+        ('packed' | 'dense' | 'json'); the older ``dense`` flag keeps
+        meaning "binary if True, JSON if False", with binary resolving
+        to the fastest form the local replica speaks."""
+        if mode is None:
+            mode = self._default_mode(
+                self.prefer_dense if dense is None else dense)
         stats = PeerSyncStats().register(
             node=str(self.crdt.node_id), peer=name)
         peer = Peer(
             name, host, port,
-            dense=self.prefer_dense if dense is None else dense,
+            mode=mode,
             breaker=CircuitBreaker(self.breaker_policy,
                                    clock=self._clock, stats=stats,
                                    name=name),
             stats=stats,
-            watermark=self._saved_marks.get(name))
+            watermark=self._saved_marks.get(name),
+            timeout=self.round_timeout)
         with self._peers_lock:
+            old = self.peers.get(name)
             self.peers[name] = peer
+        if old is not None:
+            old.conn.reset()     # re-addressed: drop the old session
         return peer
 
     # --- lifecycle ---
@@ -307,6 +373,10 @@ class GossipNode:
         if self._gossip_thread is not None:
             self._gossip_thread.join(timeout=60)
             self._gossip_thread = None
+        with self._peers_lock:
+            conns = [p.conn for p in self.peers.values()]
+        for conn in conns:
+            conn.close(self.wire)    # polite bye, best-effort
         self.server.stop()
 
     def __enter__(self) -> "GossipNode":
@@ -320,13 +390,61 @@ class GossipNode:
     def run_round(self) -> Dict[str, str]:
         """One gossip sweep: sync every peer once, in a shuffled order
         (uncoordinated nodes must not all visit peers in registration
-        order). Returns ``{peer name: outcome}``."""
+        order). Returns ``{peer name: outcome}``.
+
+        Peers on the packed fast path with an already-negotiated
+        healthy session run PIPELINED: peer N+1's ``pack_since``
+        (device work, under the replica lock) overlaps peer N's
+        socket round on a one-worker executor, so the sweep hides
+        pack latency behind the wire. Everything else — first
+        contact, legacy/dense/JSON peers, open or probing breakers —
+        takes the plain sequential path."""
         with self._peers_lock:
             names = list(self.peers)
         self._rng.shuffle(names)
-        return {name: self.sync_peer(name) for name in names}
+        with self._peers_lock:
+            peers = {n: self.peers[n] for n in names
+                     if n in self.peers}
+        fast: List[str] = []
+        results: Dict[str, str] = {}
+        for name in names:
+            p = peers[name]
+            if (p.mode == "packed" and p.conn.connected
+                    and "packed" in p.conn.caps
+                    and p.breaker.state == CircuitBreaker.CLOSED):
+                fast.append(name)
+            else:
+                results[name] = self.sync_peer(name)
+        if len(fast) < 2:
+            for name in fast:
+                results[name] = self.sync_peer(name)
+            return results
+        default_registry().counter(
+            "crdt_tpu_gossip_pipelined_rounds_total",
+            "gossip sweeps that overlapped device pack with "
+            "network I/O").inc(node=str(self.crdt.node_id))
+        with ThreadPoolExecutor(max_workers=1) as ex:
+            prev_name, fut = "", None
+            for name in fast:
+                p = peers[name]
+                with self.server.lock:
+                    watermark = self.crdt.canonical_time
+                    packed, ids = self.crdt.pack_since(p.watermark)
+                # The worker is still (possibly) mid-round on the
+                # previous peer — that socket wait is what the pack
+                # above just overlapped. Collect it before
+                # dispatching this one.
+                if fut is not None:
+                    results[prev_name] = fut.result()
+                prev_name = name
+                fut = ex.submit(self.sync_peer, name,
+                                (watermark, packed, ids))
+            if fut is not None:
+                results[prev_name] = fut.result()
+        return results
 
-    def sync_peer(self, name: str) -> str:
+    def sync_peer(self, name: str,
+                  _prepacked: Optional[Tuple] = None) -> str:
         """One resilient anti-entropy round against a peer.
 
         Returns ``'ok'`` (round completed, watermark advanced and
@@ -337,9 +455,9 @@ class GossipNode:
         with its healthy peers."""
         ring = tracer()
         if not ring.enabled:
-            return self._sync_peer(name)
+            return self._sync_peer(name, _prepacked)
         start = time.perf_counter()
-        outcome = self._sync_peer(name)
+        outcome = self._sync_peer(name, _prepacked)
         dur = time.perf_counter() - start
         with self.server.lock:
             stamp = str(self.crdt.canonical_time)
@@ -351,7 +469,8 @@ class GossipNode:
         ).observe(dur, peer=name, outcome=outcome)
         return outcome
 
-    def _sync_peer(self, name: str) -> str:
+    def _sync_peer(self, name: str,
+                   _prepacked: Optional[Tuple] = None) -> str:
         with self._peers_lock:
             peer = self.peers[name]
         if not peer.breaker.allow():
@@ -361,18 +480,33 @@ class GossipNode:
         attempt = 0
         while True:
             try:
-                mark = self._one_round(peer)
+                mark = self._one_round(peer, _prepacked)
             except SyncProtocolError as e:
-                if peer.dense and e.code in _DENSE_FALLBACK_CODES:
-                    # The peer doesn't speak the dense wire form:
-                    # downgrade (sticky) and rerun on the universal
-                    # JSON path. Not a link fault — no backoff, and
-                    # the retry budget is untouched.
+                # A rejected round means the pre-pack is for the
+                # wrong wire form; a transport fault means the store
+                # may have moved during the backoff. Either way the
+                # rerun re-packs fresh.
+                _prepacked = None
+                tried = peer.last_attempt
+                if tried == "packed" \
+                        and e.code in _PACKED_FALLBACK_CODES:
+                    # The peer advertised packed but won't take it:
+                    # downgrade (sticky) one step and rerun on the
+                    # dense split form. Not a link fault — no
+                    # backoff, and the retry budget is untouched.
                     peer.stats.fallbacks += 1
-                    peer.dense = False
+                    peer.mode = "dense"
+                    continue
+                if tried == "dense" and peer.mode != "json" \
+                        and e.code in _DENSE_FALLBACK_CODES:
+                    # No binary form at all: downgrade (sticky) to
+                    # the universal JSON path and rerun.
+                    peer.stats.fallbacks += 1
+                    peer.mode = "json"
                     continue
                 return self._round_failed(peer, e)
             except SyncTransportError as e:
+                _prepacked = None
                 attempt += 1
                 if attempt >= self.retry.max_attempts:
                     return self._round_failed(peer, e)
@@ -391,24 +525,53 @@ class GossipNode:
             self._persist()
             return "ok"
 
-    def _one_round(self, peer: Peer) -> Hlc:
-        """One wire round in the peer's current form, byte-tallied."""
+    def _one_round(self, peer: Peer,
+                   prepacked: Optional[Tuple] = None) -> Hlc:
+        """One wire round on the peer's pooled session, byte-tallied.
+
+        The form actually attempted may sit BELOW ``peer.mode`` for
+        this round: a session whose hello did not advertise the
+        ``packed`` capability (including pre-hello legacy peers) is
+        never offered it. That is capability selection, not a
+        rejection — ``fallbacks`` stays untouched, ``peer.mode``
+        keeps aiming high, and a future session that does advertise
+        the cap gets the fast path back. Dense stays rejection-based
+        on purpose: pre-hello servers may well speak it, and hello
+        caps can't prove they don't."""
         tally = WireTally()
         try:
-            if peer.dense:
-                return sync_dense_over_tcp(
-                    self.crdt, peer.host, peer.port,
-                    since=peer.watermark, timeout=self.round_timeout,
+            conn = peer.conn
+            if (conn.host, conn.port) != (peer.host, peer.port):
+                # The peer was re-pointed in place (failover): drop
+                # the old session and follow the address.
+                conn.reset()
+                conn.host, conn.port = peer.host, peer.port
+            conn.ensure(tally)
+            mode = peer.mode
+            if mode == "packed" and "packed" not in conn.caps:
+                mode = ("dense"
+                        if hasattr(self.crdt, "export_split_delta")
+                        else "json")
+            peer.last_attempt = mode
+            if mode == "packed":
+                return sync_packed_over_conn(
+                    self.crdt, conn, since=peer.watermark,
+                    lock=self.server.lock, tally=tally,
+                    _prepacked=prepacked)
+            if mode == "dense":
+                return sync_dense_over_conn(
+                    self.crdt, conn, since=peer.watermark,
                     lock=self.server.lock, tally=tally)
-            return sync_over_tcp(
-                self.crdt, peer.host, peer.port,
-                since=peer.watermark, timeout=self.round_timeout,
+            return sync_over_conn(
+                self.crdt, conn, since=peer.watermark,
                 lock=self.server.lock, tally=tally, **self._codecs)
         finally:
             peer.stats.bytes_sent += tally.sent
             peer.stats.bytes_received += tally.received
             self.wire.sent += tally.sent
             self.wire.received += tally.received
+            self.wire.z_raw += tally.z_raw
+            self.wire.z_wire += tally.z_wire
 
     def _round_failed(self, peer: Peer, exc: Exception) -> str:
         peer.last_error = exc
@@ -434,6 +597,8 @@ class GossipNode:
         return {name: {**p.stats.as_dict(),
                        "breaker": p.breaker.state,
                        "dense": p.dense,
+                       "mode": p.mode,
+                       "connects": p.conn.connects,
                        "watermark": None if p.watermark is None
                        else str(p.watermark)}
                 for name, p in entries}

@@ -26,6 +26,7 @@ The columnar store round-trips through `crdt_tpu.checkpoint.save_dense`.
 
 from __future__ import annotations
 
+from collections import OrderedDict
 from contextlib import contextmanager
 from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 
@@ -45,7 +46,7 @@ from ..ops.dense import (DenseChangeset, DenseStore, FaninResult, _NEG,
                          pad_replica_rows, put_scatter, sparse_fanin_step,
                          store_to_changeset)
 from ..ops.merge import recv_guards
-from ..ops.packing import NodeTable
+from ..ops.packing import NodeTable, PackedDelta
 from ..record import (KeyDecoder, KeyEncoder, Record, ValueDecoder,
                       ValueEncoder)
 from ..utils.stats import MergeStats, merge_annotation
@@ -158,6 +159,10 @@ class DenseCrdt:
         # A caller-supplied store counts as escaped: the caller may
         # still hold it, so write scatters must not donate its buffers.
         self._store_escaped = store is not None
+        # pack_since cache (watermark key -> packed delta); must exist
+        # before the first store assignment — the _store setter clears
+        # it on every replacement.
+        self._pack_cache: "OrderedDict[Any, Any]" = OrderedDict()
         self._store = store if store is not None else empty_dense_store(
             n_slots)
         if self._store.n_slots != n_slots:  # must survive `python -O`
@@ -186,6 +191,21 @@ class DenseCrdt:
     @property
     def canonical_time(self) -> Hlc:
         return self._canonical_time
+
+    @property
+    def _store(self) -> DenseStore:
+        return self._store_lanes
+
+    @_store.setter
+    def _store(self, store: DenseStore) -> None:
+        # The ONE choke point every mutation path shares (puts,
+        # deletes, merges, grow, intern remaps): any store replacement
+        # invalidates cached outbound packs, so `pack_since` can trust
+        # a cache hit without re-deriving what changed.
+        self._store_lanes = store
+        cache = self.__dict__.get("_pack_cache")
+        if cache:
+            cache.clear()
 
     @property
     def store(self) -> DenseStore:
@@ -993,6 +1013,11 @@ class DenseCrdt:
             new_store, win, slot_aligned = self._dispatch_columns(
                 slots, lt, node, val, tomb, new_canonical, my_ord)
         self._store = self._postprocess_store(new_store)
+        # The join produced fresh buffers (the old lanes were consumed
+        # — donated when eligible); the next columnar merge may donate
+        # them again, keeping repeated gossip rounds at the in-place
+        # dispatch floor.
+        self._store_escaped = False
         if _sanitizer.enabled():
             # Callers collapse duplicate slots before reaching here
             # (same contract the merge itself needs), so the
@@ -1076,7 +1101,8 @@ class DenseCrdt:
                 self._store, jnp.asarray(lt_n), jnp.asarray(node_n),
                 jnp.asarray(val_n), jnp.asarray(tomb_n),
                 jnp.asarray(valid_n), jnp.int64(new_canonical),
-                jnp.int32(my_ord))
+                jnp.int32(my_ord), donate=self._donate_writes(),
+                sharding=self._write_sharding())
             return new_store, win, True
         # Pad k to a power of two (invalid rows scatter to the n_slots
         # sentinel, mode="drop") so the jitted step compiles O(log k)
@@ -1100,7 +1126,8 @@ class DenseCrdt:
             self._store, jnp.asarray(slot_arr), jnp.asarray(lt_p),
             jnp.asarray(node_p), jnp.asarray(val_p),
             jnp.asarray(tomb_p), jnp.asarray(valid),
-            jnp.int64(new_canonical), jnp.int32(my_ord))
+            jnp.int64(new_canonical), jnp.int32(my_ord),
+            donate=self._donate_writes(), sharding=self._write_sharding())
         return new_store, win, False
 
     # --- checkpoint/resume (SURVEY.md §5) ---
@@ -1692,6 +1719,100 @@ class DenseCrdt:
             wide_for_exact,
             guard_lanes=lambda: split_guard_lanes(
                 scs.hi, scs.lo, scs.node, jnp.asarray(node_map)))
+
+    # pack_since cache depth: a replica gossips a handful of peers with
+    # (usually) one shared watermark frontier per store state; slots
+    # beyond that are churn, not reuse.
+    PACK_CACHE_SLOTS = 4
+
+    def pack_since(self, since: Optional[Hlc] = None
+                   ) -> Tuple[PackedDelta, List[Any]]:
+        """Outbound O(k) columnar delta: host lanes for the rows with
+        ``modified >= since`` (inclusive, the `export_delta` bound) —
+        the wire form `merge_packed` ingests. Unlike `export_delta` /
+        `export_split_delta` this ships only MODIFIED rows (the
+        `count_modified_since` mask), so steady-state gossip bytes are
+        proportional to what changed, not to capacity.
+
+        Results are cached keyed on ``(since, canonical)``; every store
+        replacement — puts, deletes, merges, grow, ordinal remaps —
+        clears the cache through the ``_store`` setter, so an unchanged
+        replica answers repeat packs (the no-change gossip round) with
+        ZERO device work. Hits/misses are counted in
+        ``crdt_tpu_pack_cache_total``. The device lanes are copied to
+        host here, so packing does NOT escape the store snapshot (later
+        merges may still donate)."""
+        from ..obs.registry import default_registry
+        from ..obs.trace import span
+        key = (None if since is None else since.logical_time,
+               self._canonical_time.logical_time)
+        counter = default_registry().counter(
+            "crdt_tpu_pack_cache_total",
+            "pack_since cache lookups by outcome")
+        cached = self._pack_cache.get(key)
+        if cached is not None:
+            self._pack_cache.move_to_end(key)
+            counter.inc(outcome="hit", node=str(self._node_id))
+            return cached
+        counter.inc(outcome="miss", node=str(self._node_id))
+        with span("pack_since", kind="pack",
+                  hlc=lambda: self._canonical_time,
+                  node=str(self._node_id)):
+            mask = self._delta_mask(since)
+            # One batched device->host fetch; `modified` lanes are
+            # local-only and never serialized (record.dart:28-31).
+            mask, lt, node, val, tomb = jax.device_get(
+                (mask, self._store.lt, self._store.node,
+                 self._store.val, self._store.tomb))
+            idx = np.nonzero(mask)[0]
+            packed = PackedDelta(
+                slots=idx.astype(np.int32, copy=False),
+                lt=np.ascontiguousarray(lt[idx], np.int64),
+                node=node[idx].astype(np.int32, copy=False),
+                val=np.ascontiguousarray(val[idx], np.int64),
+                tomb=tomb[idx].astype(np.uint8, copy=False))
+        out = (packed, self._table.ids())
+        self._pack_cache[key] = out
+        while len(self._pack_cache) > self.PACK_CACHE_SLOTS:
+            self._pack_cache.popitem(last=False)
+        return out
+
+    def merge_packed(self, packed: PackedDelta,
+                     node_ids: Sequence[Any]) -> None:
+        """Fan-in a `pack_since` delta: ``packed.node`` holds ordinals
+        into ``node_ids`` (the peer's table order). Validation —
+        aligned lanes, ordinal range, slot bounds, value width — runs
+        BEFORE the first clock mutation, and duplicate slots collapse
+        last-wins (`_last_wins_keep`), the same contract every other
+        columnar ingest path honors. Cost is O(k) in the delta."""
+        self._refuse_in_pipeline("merge_packed")  # host recv fold
+        slots = np.asarray(packed.slots)
+        lt = np.asarray(packed.lt, np.int64)
+        ni = np.asarray(packed.node)
+        val = np.asarray(packed.val, np.int64)
+        tomb = np.asarray(packed.tomb).astype(bool)
+        k = len(slots)
+        if not (len(lt) == len(ni) == len(val) == len(tomb) == k):
+            raise ValueError("packed delta lanes are ragged")
+        if k == 0:
+            self.merge_many([])
+            return
+        if int(ni.min()) < 0 or int(ni.max()) >= len(node_ids):
+            raise ValueError(
+                f"packed node ordinal out of range for {len(node_ids)} "
+                "wire node ids")
+        keep = self._last_wins_keep(slots)
+        if keep is not None:
+            slots, lt, ni, val, tomb = (slots[keep], lt[keep], ni[keep],
+                                        val[keep], tomb[keep])
+            k = len(slots)
+        self.stats.merges += 1
+        self.stats.add_seen_lazy(k)
+        self._check_slots(slots)
+        self._check_value_width(val)
+        self._intern_ids(node_ids)
+        node = self._table.encode(node_ids)[ni]
+        self._merge_validated(slots, lt, node, val, tomb)
 
     def _pipe_send_bump(self, wall: int) -> None:
         """The final crdt.dart:93 send bump, on device, flags

@@ -31,19 +31,50 @@ ops above remain the universal interop path.
     server -> client  {"meta": {...lanes...}}
     server -> client  <raw binary frame>
 
+The sync fast path adds three NEGOTIATED extensions on top — all
+opt-in via a ``hello`` handshake, so a pre-hello peer keeps speaking
+the exact legacy bytes above (see docs/WIRE.md for the full matrix):
+
+    client -> server  {"op": "hello", "proto": 1, "caps": [...]}
+    server -> client  {"ok": true, "proto": 1, "caps": <intersection>}
+
+After a successful hello, every later frame body on the connection
+carries ONE leading tag byte (`FrameCodec`): 0x00 raw, 0x01
+zlib-compressed (sent only when the "zlib" cap was agreed AND the
+body clears a size threshold — tiny control frames never pay the
+codec). The "packed" cap unlocks the O(k) incremental columnar ops
+(`DenseCrdt.pack_since` / `merge_packed` — ~25 B per MODIFIED row,
+vs the dense form's O(capacity) lanes):
+
+    client -> server  {"op": "push_packed", "meta": ..., "node_ids": [...]}
+    client -> server  <raw binary frame: packed lanes>
+    server -> client  {"ok": true}
+    client -> server  {"op": "delta_packed", "since": <hlc str> | null}
+    server -> client  {"meta": ..., "node_ids": [...], "k": <rows>}
+    server -> client  <raw binary frame>
+
+:class:`PeerConnection` keeps one negotiated session alive across
+rounds (connect + hello once, not per round), detecting pre-hello
+servers (they answer ``unknown_op`` and hang up) and sticking to the
+legacy framing for them.
+
 Error replies carry a structured ``code`` ("merge_rejected",
-"delta_failed", "dense_rejected", "unknown_op") plus the server-side
-exception name/detail. Client-side, the sync functions raise a split
-taxonomy: :class:`SyncTransportError` for link faults (retryable —
-rounds are idempotent) and :class:`SyncProtocolError` for peer
-rejections (fatal; for dense ops, fall back to the JSON path). The
-gossip runtime (`crdt_tpu.gossip`) keys its retry/backoff/breaker
-and dense→JSON fallback decisions off exactly this split.
+"delta_failed", "dense_rejected", "packed_rejected", "unknown_op")
+plus the server-side exception name/detail. Client-side, the sync
+functions raise a split taxonomy: :class:`SyncTransportError` for
+link faults (retryable — rounds are idempotent) and
+:class:`SyncProtocolError` for peer rejections (fatal; for dense or
+packed ops, fall back to the JSON path). The gossip runtime
+(`crdt_tpu.gossip`) keys its retry/backoff/breaker and
+packed→dense→JSON fallback decisions off exactly this split.
 
 Threading model: replicas are single-threaded state machines (same
 contract as the reference's isolate model — see SqliteCrdt's notes).
-The server serializes ALL replica access through :attr:`SyncServer.lock`;
-an application that also writes locally from another thread must take
+The server serializes ALL replica access through :attr:`SyncServer.lock`
+— it accepts up to ``max_conns`` concurrent connections (pooled
+gossip peers park sessions between rounds), each on its own handler
+thread, but requests still execute one at a time under the lock. An
+application that also writes locally from another thread must take
 the same lock around its own operations. To serve a `SqliteCrdt`,
 construct it with ``check_same_thread=False`` (sqlite3's own thread
 guard; the server's lock provides the actual serialization).
@@ -55,7 +86,8 @@ import json
 import socket
 import struct
 import threading
-from typing import Any, Optional
+import zlib
+from typing import Any, Iterable, Optional, Sequence, Tuple
 
 from .crdt import Crdt
 from .hlc import Hlc
@@ -118,32 +150,105 @@ class WireTally:
     per-peer ``bytes_sent``/``bytes_received`` accounting, cumulative
     for the endpoint-lifetime tallies `SyncServer` and `GossipNode`
     attach to the metrics registry (the ``__weakref__`` slot exists so
-    the registry can hold them weakly)."""
+    the registry can hold them weakly). ``z_raw``/``z_wire`` count the
+    before/after bytes of every body `FrameCodec` actually compressed,
+    so ``z_ratio`` is the achieved compression ratio (1.0 when nothing
+    was compressed)."""
 
-    __slots__ = ("sent", "received", "__weakref__")
+    __slots__ = ("sent", "received", "z_raw", "z_wire", "__weakref__")
 
     def __init__(self) -> None:
         self.sent = 0
         self.received = 0
+        self.z_raw = 0
+        self.z_wire = 0
+
+    @property
+    def z_ratio(self) -> float:
+        return self.z_raw / self.z_wire if self.z_wire else 1.0
 
     def as_dict(self) -> dict:
-        return {"sent": self.sent, "received": self.received}
+        return {"sent": self.sent, "received": self.received,
+                "z_raw": self.z_raw, "z_wire": self.z_wire,
+                "z_ratio": round(self.z_ratio, 4)}
+
+
+class FrameCodec:
+    """Per-connection frame body transform, active only AFTER a
+    successful ``hello``: every body gets one leading tag byte —
+    ``0x00`` raw, ``0x01`` zlib. Compression is sent only when enabled
+    (both sides advertised "zlib") and the body clears
+    ``min_compress_bytes`` — a 20-byte control frame costs more as a
+    zlib stream than as itself. Decoding always accepts BOTH tags
+    (negotiating the cap governs what a peer may *send*, not what it
+    must *understand*), with the inflated size capped at
+    ``MAX_FRAME_BYTES`` so a zlib bomb rejects before allocating."""
+
+    TAG_RAW = b"\x00"
+    TAG_ZLIB = b"\x01"
+
+    def __init__(self, compress: bool = False, level: int = 1,
+                 min_compress_bytes: int = 512):
+        self.compress = compress
+        self.level = level
+        self.min_compress_bytes = min_compress_bytes
+
+    def encode(self, bufs: Sequence, tally: Optional[WireTally] = None
+               ) -> list:
+        """Tag (and maybe compress) a body given as buffer pieces;
+        returns the pieces to ship. Incompressible bodies ship raw —
+        the tag byte means the receiver never guesses."""
+        total = sum(len(b) for b in bufs)
+        if self.compress and total >= self.min_compress_bytes:
+            co = zlib.compressobj(self.level)
+            pieces = [co.compress(bytes(b)) for b in bufs]
+            pieces.append(co.flush())
+            z_total = sum(len(p) for p in pieces)
+            if z_total < total:
+                if tally is not None:
+                    tally.z_raw += total
+                    tally.z_wire += z_total
+                return [self.TAG_ZLIB] + pieces
+        return [self.TAG_RAW] + list(bufs)
+
+    def decode(self, body: bytes) -> bytes:
+        if not body:
+            raise ValueError("tagged frame with empty body")
+        tag, body = body[:1], body[1:]
+        if tag == self.TAG_RAW:
+            return body
+        if tag == self.TAG_ZLIB:
+            do = zlib.decompressobj()
+            try:
+                out = do.decompress(body, MAX_FRAME_BYTES)
+            except zlib.error as e:
+                raise ValueError(f"corrupt compressed frame: {e}") from e
+            if do.unconsumed_tail or not do.eof or do.unused_data:
+                raise ValueError(
+                    "compressed frame inflates past MAX_FRAME_BYTES, "
+                    "is truncated, or has trailing bytes")
+            return out
+        raise ValueError(f"unknown frame tag {tag!r}")
 
 
 def send_frame(sock: socket.socket, obj: Any,
-               tally: Optional[WireTally] = None) -> None:
-    """One JSON frame — the raw framing plus a dumps."""
-    send_bytes_frame(sock, [json.dumps(obj).encode()], tally)
+               tally: Optional[WireTally] = None,
+               codec: Optional[FrameCodec] = None) -> None:
+    """One JSON frame — the raw framing plus a dumps. ``codec`` (a
+    negotiated connection) tags/compresses the body; None keeps the
+    legacy untagged bytes."""
+    send_bytes_frame(sock, [json.dumps(obj).encode()], tally, codec)
 
 
 def recv_frame(sock: socket.socket,
                deadline: Optional[float] = None,
-               tally: Optional[WireTally] = None) -> Optional[Any]:
+               tally: Optional[WireTally] = None,
+               codec: Optional[FrameCodec] = None) -> Optional[Any]:
     """Receive one JSON frame; ``deadline`` (a ``time.monotonic()``
     value) bounds the WHOLE frame, not just each chunk — a peer
     trickling bytes inside the per-recv socket timeout cannot stretch
     past it."""
-    body = recv_bytes_frame(sock, deadline, tally)
+    body = recv_bytes_frame(sock, deadline, tally, codec)
     return None if body is None else json.loads(body)
 
 
@@ -172,10 +277,13 @@ def _recv_exact(sock: socket.socket, n: int,
 
 
 def send_bytes_frame(sock: socket.socket, bufs,
-                     tally: Optional[WireTally] = None) -> None:
+                     tally: Optional[WireTally] = None,
+                     codec: Optional[FrameCodec] = None) -> None:
     """One length-prefixed RAW frame from a list of buffers — sent
     piecewise, never concatenated (a 100 MB delta must not allocate a
     second copy)."""
+    if codec is not None:
+        bufs = codec.encode(bufs, tally)
     total = sum(len(b) for b in bufs)
     if total > MAX_FRAME_BYTES:
         raise ValueError(f"frame of {total} bytes exceeds "
@@ -189,7 +297,8 @@ def send_bytes_frame(sock: socket.socket, bufs,
 
 def recv_bytes_frame(sock: socket.socket,
                      deadline: Optional[float] = None,
-                     tally: Optional[WireTally] = None
+                     tally: Optional[WireTally] = None,
+                     codec: Optional[FrameCodec] = None
                      ) -> Optional[bytes]:
     """Receive one RAW frame (no JSON decode)."""
     head = _recv_exact(sock, 4, deadline)
@@ -202,6 +311,8 @@ def recv_bytes_frame(sock: socket.socket,
     body = _recv_exact(sock, n, deadline)
     if body is not None and tally is not None:
         tally.received += 4 + n
+    if body is not None and codec is not None:
+        body = codec.decode(body)
     return body
 
 
@@ -284,16 +395,18 @@ def _unpack_split(meta, blob: bytes):
 class SyncServer:
     """Serve a replica's merge/delta surface over TCP.
 
-    One connection is handled at a time (replication rounds are short
-    and the replica is single-threaded anyway); each request holds
-    :attr:`lock` while it touches the replica. Because of the
-    single-connection design, a slow peer delays — and without bounds
-    would starve — every other replica, so each connection is capped:
-    at most ``max_ops`` framed requests and ``conn_deadline`` seconds,
-    after which it is dropped (a well-behaved anti-entropy round is 3
-    frames and well under a second). The endpoint still assumes a
-    trusted network: there is no authentication and a peer can push
-    arbitrary records.
+    Up to ``max_conns`` connections are served concurrently (each on
+    its own handler thread), so pooled gossip peers can park keep-alive
+    sessions between rounds without starving one another; every
+    request still holds :attr:`lock` while it touches the replica, so
+    replica access stays strictly serialized. A slow peer delays —
+    and without bounds would starve — everyone contending for that
+    lock, so each connection is capped: at most ``max_ops`` framed
+    requests and ``conn_deadline`` seconds, after which it is dropped
+    (a well-behaved anti-entropy round is 3 frames and well under a
+    second); connections past ``max_conns`` are refused at accept.
+    The endpoint still assumes a trusted network: there is no
+    authentication and a peer can push arbitrary records.
 
     >>> server = SyncServer(crdt)          # port 0 = ephemeral
     >>> server.start()
@@ -310,16 +423,17 @@ class SyncServer:
                  key_encoder=None, value_encoder=None,
                  key_decoder=None, value_decoder=None,
                  max_ops: int = 1000, conn_deadline: float = 300.0,
-                 io_timeout: float = 30.0):
+                 io_timeout: float = 30.0, max_conns: int = 8):
         self.crdt = crdt
         self.lock = threading.Lock()
         self._max_ops = max_ops
         self._conn_deadline = conn_deadline
-        # Per-recv socket timeout AND the bound on a push_dense
-        # continuation frame: a client that announces a binary frame
-        # and never sends it holds the single-connection endpoint for
+        # Per-recv socket timeout AND the bound on a push_dense/
+        # push_packed continuation frame: a client that announces a
+        # binary frame and never sends it holds its handler slot for
         # at most this long, not until conn_deadline.
         self._io_timeout = io_timeout
+        self._max_conns = max_conns
         # codec passthrough, mirroring sync.sync_json: replicas with
         # custom-typed keys/values need the same coders over TCP
         self._kenc, self._venc = key_encoder, value_encoder
@@ -338,7 +452,12 @@ class SyncServer:
         # answers "how far behind is replica B?" without the server
         # knowing about gossip state.
         self.metrics_extra = None
-        self._active: Optional[socket.socket] = None
+        # Live connections + their handler threads, guarded by
+        # _conns_lock: stop() shuts every socket down so a handler
+        # blocked in a 30 s recv exits promptly.
+        self._conns_lock = threading.Lock()
+        self._conns: set = set()
+        self._handlers: set = set()
         self._lsock = socket.create_server((host, port))
         self._lsock.settimeout(0.2)  # poll the stop flag
         self.host, self.port = self._lsock.getsockname()[:2]
@@ -350,31 +469,46 @@ class SyncServer:
         self._thread.start()
         return self
 
+    def _shutdown_conns(self) -> None:
+        with self._conns_lock:
+            conns = list(self._conns)
+        for c in conns:
+            try:
+                c.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass
+
     def stop(self) -> None:
-        """Stop serving and wait for quiescence: the active
+        """Stop serving and wait for quiescence: every live
         connection (a handler may be blocked in a 30 s recv) is shut
-        down so the serve thread exits promptly — after stop()
+        down so its handler thread exits promptly — after stop()
         returns, no server-side thread touches the replica again."""
         self._stop.set()
-        if self._thread is not None:
-            # repeatedly shut down whatever connection is active: a
+        import time as _time
+        deadline = _time.monotonic() + 60
+
+        def _join(thread) -> None:
+            # repeatedly shut down whatever connections are live: a
             # conn accepted concurrently with stop() would otherwise
-            # slip past a single _active read and idle out a 30 s recv
-            import time as _time
-            deadline = _time.monotonic() + 60
-            while self._thread.is_alive():
-                active = self._active
-                if active is not None:
-                    try:
-                        active.shutdown(socket.SHUT_RDWR)
-                    except OSError:
-                        pass
-                self._thread.join(timeout=0.2)
+            # slip past a single read and idle out a 30 s recv
+            while thread.is_alive():
+                self._shutdown_conns()
+                thread.join(timeout=0.2)
                 if _time.monotonic() > deadline:
                     raise RuntimeError(
                         "SyncServer thread failed to stop; the "
                         "replica may still be accessed — do not "
                         "reuse it")
+
+        if self._thread is not None:
+            _join(self._thread)
+        while True:
+            with self._conns_lock:
+                handler = next((t for t in self._handlers
+                                if t.is_alive()), None)
+            if handler is None:
+                break
+            _join(handler)
         self._lsock.close()
 
     def __enter__(self) -> "SyncServer":
@@ -396,16 +530,50 @@ class SyncServer:
                 # listener is still bound — keep serving
                 self._stop.wait(0.05)
                 continue
-            with conn:
-                self._active = conn
+            with self._conns_lock:
+                self._handlers = {t for t in self._handlers
+                                  if t.is_alive()}
+                full = len(self._conns) >= self._max_conns
+                if not full:
+                    self._conns.add(conn)
+            if full or self._stop.is_set():
+                # over capacity (or stopping): hang up immediately —
+                # the peer sees EOF, a retryable transport fault
                 try:
-                    self._handle(conn)
-                except Exception:
-                    # one misbehaving peer must never take the server
-                    # down for everyone else
+                    conn.close()
+                except OSError:
                     pass
-                finally:
-                    self._active = None
+                continue
+            t = threading.Thread(target=self._conn_main, args=(conn,),
+                                 daemon=True)
+            with self._conns_lock:
+                self._handlers.add(t)
+            t.start()
+
+    def _conn_main(self, conn: socket.socket) -> None:
+        try:
+            with conn:
+                self._handle(conn)
+        except Exception:
+            # one misbehaving peer must never take the server down
+            # for everyone else
+            pass
+        finally:
+            with self._conns_lock:
+                self._conns.discard(conn)
+
+    def _caps(self) -> set:
+        """Capabilities this endpoint may advertise in a hello reply.
+        "dense" is deliberately NOT negotiated: dense/JSON discovery
+        stays rejection-based (`dense_rejected` → sticky downgrade),
+        the contract the gossip fallback counters are pinned to."""
+        caps = {"zlib"}
+        with self.lock:
+            packed = (hasattr(self.crdt, "pack_since")
+                      and hasattr(self.crdt, "merge_packed"))
+        if packed:
+            caps.add("packed")
+        return caps
 
     def _handle(self, conn: socket.socket) -> None:
         conn.settimeout(self._io_timeout)
@@ -415,25 +583,37 @@ class SyncServer:
         ring = _tracer()
         deadline = _time.monotonic() + self._conn_deadline
         ops = 0
+        codec: Optional[FrameCodec] = None
         while not self._stop.is_set():
             sent0, received0 = self.tally.sent, self.tally.received
             try:
                 msg = recv_frame(conn, deadline=deadline,
-                                 tally=self.tally)
+                                 tally=self.tally, codec=codec)
             except (socket.timeout, OSError, ValueError):
                 return
             if msg is None or not isinstance(msg, dict) \
                     or msg.get("op") == "bye":
                 return
-            # Bound what one connection can monopolize (single-
-            # connection server: others queue behind this peer).
-            # Checked after recv so a frame landing past the deadline
-            # is dropped, not granted one more op.
+            # Bound what one connection can monopolize (every request
+            # contends for the replica lock). Checked after recv so a
+            # frame landing past the deadline is dropped, not granted
+            # one more op.
             ops += 1
             if ops > self._max_ops or _time.monotonic() > deadline:
                 return
             op = msg.get("op")
-            if op == "push":
+            if op == "hello":
+                want = msg.get("caps")
+                want = set(want) if isinstance(want, list) else set()
+                agreed = sorted(want & self._caps())
+                if not self._reply(conn, {"ok": True, "proto": 1,
+                                          "caps": agreed},
+                                   self.tally, codec):
+                    return
+                # The reply itself crossed untagged; everything AFTER
+                # it speaks the tagged framing.
+                codec = FrameCodec(compress="zlib" in agreed)
+            elif op == "push":
                 try:
                     with self.lock:
                         self.crdt.merge_json(msg["payload"],
@@ -446,9 +626,10 @@ class SyncServer:
                                        "code": "merge_rejected",
                                        "error": type(e).__name__,
                                        "detail": str(e)},
-                                self.tally)
+                                self.tally, codec)
                     return
-                if not self._reply(conn, {"ok": True}, self.tally):
+                if not self._reply(conn, {"ok": True}, self.tally,
+                                   codec):
                     return
             elif op == "delta":
                 try:
@@ -464,22 +645,22 @@ class SyncServer:
                     self._reply(conn, {"code": "delta_failed",
                                        "error": type(e).__name__,
                                        "detail": str(e)},
-                                self.tally)
+                                self.tally, codec)
                     return
                 if not self._reply(conn, {"payload": payload},
-                                   self.tally):
+                                   self.tally, codec):
                     return
             elif op == "push_dense":
                 # The meta frame is followed by ONE raw binary frame,
                 # bounded by io_timeout (not the whole conn_deadline):
                 # a peer that announces a frame and goes silent must
-                # not hold the single-connection endpoint for minutes.
+                # not hold its handler slot for minutes.
                 try:
                     blob = recv_bytes_frame(
                         conn, deadline=min(
                             deadline,
                             _time.monotonic() + self._io_timeout),
-                        tally=self.tally)
+                        tally=self.tally, codec=codec)
                 except (socket.timeout, OSError, ValueError):
                     return
                 if blob is None:
@@ -498,9 +679,10 @@ class SyncServer:
                                        "code": "dense_rejected",
                                        "error": type(e).__name__,
                                        "detail": str(e)},
-                                self.tally)
+                                self.tally, codec)
                     return
-                if not self._reply(conn, {"ok": True}, self.tally):
+                if not self._reply(conn, {"ok": True}, self.tally,
+                                   codec):
                     return
             elif op == "delta_dense":
                 try:
@@ -514,12 +696,69 @@ class SyncServer:
                     self._reply(conn, {"code": "dense_rejected",
                                        "error": type(e).__name__,
                                        "detail": str(e)},
-                                self.tally)
+                                self.tally, codec)
                     return
-                if not self._reply(conn, meta_msg, self.tally):
+                if not self._reply(conn, meta_msg, self.tally, codec):
                     return
                 try:
-                    send_bytes_frame(conn, bufs, self.tally)
+                    send_bytes_frame(conn, bufs, self.tally, codec)
+                except (OSError, ValueError):
+                    return
+            elif op == "push_packed":
+                # Same continuation-frame shape as push_dense, but the
+                # lanes are the O(k) modified-rows form
+                # (`ops.packing.unpack_rows` / `merge_packed`).
+                try:
+                    blob = recv_bytes_frame(
+                        conn, deadline=min(
+                            deadline,
+                            _time.monotonic() + self._io_timeout),
+                        tally=self.tally, codec=codec)
+                except (socket.timeout, OSError, ValueError):
+                    return
+                if blob is None:
+                    return
+                try:
+                    from .ops.packing import unpack_rows
+                    packed = unpack_rows(msg.get("meta"), blob)
+                    ids = msg.get("node_ids")
+                    if not isinstance(ids, list):
+                        raise ValueError("push_packed without node_ids")
+                    if packed.k:
+                        with self.lock:
+                            self.crdt.merge_packed(packed, ids)
+                    # k == 0: nothing to join — skipping the merge
+                    # keeps the clock (and thus the pack cache) still.
+                except Exception as e:
+                    self._reply(conn, {"ok": False,
+                                       "code": "packed_rejected",
+                                       "error": type(e).__name__,
+                                       "detail": str(e)},
+                                self.tally, codec)
+                    return
+                if not self._reply(conn, {"ok": True}, self.tally,
+                                   codec):
+                    return
+            elif op == "delta_packed":
+                try:
+                    since = msg.get("since")
+                    with self.lock:
+                        packed, ids = self.crdt.pack_since(
+                            None if since is None else Hlc.parse(since))
+                    from .ops.packing import pack_rows
+                    meta, bufs = pack_rows(packed)
+                    meta_msg = {"meta": meta, "node_ids": list(ids),
+                                "k": packed.k}
+                except Exception as e:
+                    self._reply(conn, {"code": "packed_rejected",
+                                       "error": type(e).__name__,
+                                       "detail": str(e)},
+                                self.tally, codec)
+                    return
+                if not self._reply(conn, meta_msg, self.tally, codec):
+                    return
+                try:
+                    send_bytes_frame(conn, bufs, self.tally, codec)
                 except (OSError, ValueError):
                     return
             elif op == "metrics":
@@ -543,15 +782,15 @@ class SyncServer:
                     self._reply(conn, {"code": "metrics_failed",
                                        "error": type(e).__name__,
                                        "detail": str(e)},
-                                self.tally)
+                                self.tally, codec)
                     return
                 if not self._reply(conn, {"metrics": snap},
-                                   self.tally):
+                                   self.tally, codec):
                     return
             else:
                 self._reply(conn, {"code": "unknown_op",
                                    "error": f"unknown op {op!r}"},
-                            self.tally)
+                            self.tally, codec)
                 return
             if ring.enabled:
                 with self.lock:
@@ -562,11 +801,12 @@ class SyncServer:
 
     @staticmethod
     def _reply(conn: socket.socket, obj: Any,
-               tally: Optional[WireTally] = None) -> bool:
+               tally: Optional[WireTally] = None,
+               codec: Optional[FrameCodec] = None) -> bool:
         """Send a reply; a peer that vanished mid-reply just ends the
         connection, never the server."""
         try:
-            send_frame(conn, obj, tally)
+            send_frame(conn, obj, tally, codec)
             return True
         except (OSError, ValueError):
             return False
@@ -584,6 +824,327 @@ def _check_reply(what: str, reply: Any, want_field: str) -> None:
                                     or reply.get("ok") is False):
         raise SyncProtocolError.from_reply(what, reply)
     raise SyncTransportError(f"{what}: {reply!r}")
+
+
+class PeerConnection:
+    """One keep-alive framed session to a :class:`SyncServer`.
+
+    Connect + hello happen at most once per session (``ensure``); the
+    `*_over_conn` round functions then reuse the socket round after
+    round — the fresh-TCP-setup cost the pooled gossip path removes.
+    Failure handling is by RESET, not repair: any round error closes
+    the socket, and the next ``ensure`` reconnects (and renegotiates),
+    which is exactly the shape `GossipNode`'s retry/breaker machinery
+    expects — a replayed round is an idempotent lattice join.
+
+    Negotiation: ``ensure`` sends ``hello`` with ``want_caps`` and
+    intersects with the server's reply (:attr:`caps`); a pre-hello
+    server answers ``unknown_op`` and hangs up, so the session marks
+    itself ``legacy`` (sticky) and reconnects speaking the untagged
+    pre-hello framing. ``negotiate=False`` skips hello entirely — the
+    one-shot `sync_over_tcp` wrappers use it to keep their legacy
+    wire bytes byte-identical.
+
+    ``idle_timeout`` must stay BELOW the server's ``io_timeout``
+    (default 20 s vs 30 s): a session parked longer than that may
+    already be half-closed server-side, so ``ensure`` proactively
+    reconnects instead of racing a dead socket. Passing
+    ``idle_timeout=None`` disables the bound and is flagged by the
+    crdtlint socket-timeout rule."""
+
+    def __init__(self, host: str, port: int, timeout: float = 30.0,
+                 idle_timeout: Optional[float] = 20.0,
+                 negotiate: bool = True,
+                 want_caps: Iterable[str] = ("zlib", "packed")):
+        self.host = host
+        self.port = port
+        self.timeout = timeout
+        self.idle_timeout = idle_timeout
+        self.negotiate = negotiate
+        self.want_caps = tuple(want_caps)
+        self.legacy = False
+        self.caps: frozenset = frozenset()
+        self.codec: Optional[FrameCodec] = None
+        self.connects = 0      # raw TCP connects (tests/bench hook)
+        self._sock: Optional[socket.socket] = None
+        self._last_used = 0.0
+
+    @property
+    def connected(self) -> bool:
+        return self._sock is not None
+
+    def ensure(self, tally: Optional[WireTally] = None
+               ) -> socket.socket:
+        """The live socket — connecting (and negotiating) if needed.
+        Raises :class:`SyncTransportError` when the peer is
+        unreachable or the hello exchange dies mid-flight."""
+        import time as _time
+        if self._sock is not None:
+            if self.idle_timeout is not None and (
+                    _time.monotonic() - self._last_used
+                    > self.idle_timeout):
+                self.reset()
+            else:
+                self._last_used = _time.monotonic()
+                return self._sock
+        try:
+            sock = socket.create_connection((self.host, self.port),
+                                            timeout=self.timeout)
+            sock.settimeout(self.timeout)
+        except OSError as e:
+            raise SyncTransportError(
+                f"connect to {self.host}:{self.port} failed: {e!r}"
+            ) from e
+        self.connects += 1
+        self.caps = frozenset()
+        self.codec = None
+        if self.negotiate and not self.legacy:
+            try:
+                send_frame(sock, {"op": "hello", "proto": 1,
+                                  "caps": list(self.want_caps)}, tally)
+                reply = recv_frame(
+                    sock, deadline=_time.monotonic() + self.timeout,
+                    tally=tally)
+            except (OSError, ValueError) as e:
+                sock.close()
+                raise SyncTransportError(f"hello failed: {e!r}") from e
+            if isinstance(reply, dict) and reply.get("ok") \
+                    and isinstance(reply.get("caps"), list):
+                self.caps = frozenset(reply["caps"])
+                self.codec = FrameCodec(compress="zlib" in self.caps)
+            elif isinstance(reply, dict) and ("error" in reply
+                                              or reply.get("ok")
+                                              is False):
+                # Pre-hello server: it reported unknown_op and hung
+                # up. Sticky — reconnect once, without hello, and
+                # speak the legacy framing from here on.
+                sock.close()
+                self.legacy = True
+                return self.ensure(tally)
+            else:
+                # None / garbage: the link died mid-handshake.
+                sock.close()
+                raise SyncTransportError(f"hello failed: {reply!r}")
+        self._sock = sock
+        self._last_used = _time.monotonic()
+        return sock
+
+    def reset(self) -> None:
+        """Drop the session (error path); the next ``ensure``
+        reconnects. The ``legacy`` mark survives — a pre-hello peer
+        does not grow a hello by reconnecting."""
+        sock, self._sock = self._sock, None
+        self.codec = None
+        self.caps = frozenset()
+        if sock is not None:
+            try:
+                sock.close()
+            except OSError:
+                pass
+
+    def close(self, tally: Optional[WireTally] = None) -> None:
+        """Polite teardown: best-effort ``bye`` (ends the server's
+        handler loop promptly instead of waiting out its io_timeout),
+        then close."""
+        sock = self._sock
+        if sock is not None:
+            try:
+                send_frame(sock, {"op": "bye"}, tally, self.codec)
+            except (OSError, ValueError):
+                pass
+        self.reset()
+
+    def __enter__(self) -> "PeerConnection":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+
+def sync_over_conn(crdt: Crdt, conn: PeerConnection,
+                   since: Optional[Hlc] = None,
+                   key_encoder=None, value_encoder=None,
+                   key_decoder=None, value_decoder=None,
+                   lock: Optional[threading.Lock] = None,
+                   tally: Optional[WireTally] = None) -> Hlc:
+    """One JSON anti-entropy round over a pooled session — the
+    semantics of :func:`sync_over_tcp` (watermark captured before the
+    push, inclusive delta bound, lock held only around local replica
+    calls) minus the per-round connect, and with the session's
+    negotiated codec applied to every frame. No ``bye`` is sent: the
+    session stays parked for the next round. ANY failure resets the
+    session (the error taxonomy is unchanged), so a retry reconnects
+    cleanly."""
+    if lock is None:
+        lock = threading.Lock()   # uncontended no-op
+    with lock:
+        watermark = crdt.canonical_time
+        payload = crdt.to_json(key_encoder=key_encoder,
+                               value_encoder=value_encoder)
+    import time as _time
+    sock = conn.ensure(tally)
+    try:
+        codec = conn.codec
+        send_frame(sock, {"op": "push", "payload": payload}, tally,
+                   codec)
+        reply = recv_frame(sock,
+                           deadline=_time.monotonic() + conn.timeout,
+                           tally=tally, codec=codec)
+        _check_reply("push rejected", reply, "ok")
+        send_frame(sock, {"op": "delta",
+                          "since": None if since is None
+                          else str(since)}, tally, codec)
+        reply = recv_frame(sock,
+                           deadline=_time.monotonic() + conn.timeout,
+                           tally=tally, codec=codec)
+        _check_reply("delta failed", reply, "payload")
+        pulled = reply["payload"]
+        with lock:
+            crdt.merge_json(pulled, key_decoder=key_decoder,
+                            value_decoder=value_decoder)
+    except SyncError:
+        conn.reset()
+        raise
+    except (OSError, ValueError) as e:
+        conn.reset()
+        raise SyncTransportError(f"sync round failed: {e!r}") from e
+    return watermark
+
+
+def sync_dense_over_conn(crdt, conn: PeerConnection,
+                         since: Optional[Hlc] = None,
+                         lock: Optional[threading.Lock] = None,
+                         tally: Optional[WireTally] = None) -> Hlc:
+    """One DENSE (kernel wire form) round over a pooled session —
+    :func:`sync_dense_over_tcp` semantics minus the per-round
+    connect. See :func:`sync_over_conn` for the session contract."""
+    if lock is None:
+        lock = threading.Lock()   # uncontended no-op
+    with lock:
+        watermark = crdt.canonical_time
+        scs, ids = crdt.export_split_delta()
+        meta, bufs = _pack_split(scs)
+    import time as _time
+    sock = conn.ensure(tally)
+    try:
+        codec = conn.codec
+        send_frame(sock, {"op": "push_dense", "meta": meta,
+                          "node_ids": list(ids)}, tally, codec)
+        send_bytes_frame(sock, bufs, tally, codec)
+        reply = recv_frame(sock,
+                           deadline=_time.monotonic() + conn.timeout,
+                           tally=tally, codec=codec)
+        _check_reply("push rejected", reply, "ok")
+        send_frame(sock, {"op": "delta_dense",
+                          "since": None if since is None
+                          else str(since)}, tally, codec)
+        reply = recv_frame(sock,
+                           deadline=_time.monotonic() + conn.timeout,
+                           tally=tally, codec=codec)
+        _check_reply("delta failed", reply, "meta")
+        blob = recv_bytes_frame(sock,
+                                deadline=_time.monotonic()
+                                + conn.timeout,
+                                tally=tally, codec=codec)
+        if blob is None:
+            raise SyncTransportError("delta binary frame missing")
+        peer_scs = _unpack_split(reply["meta"], blob)
+        ids_in = reply.get("node_ids")
+        if not isinstance(ids_in, list) or not ids_in:
+            raise SyncTransportError("delta reply without node_ids")
+        with lock:
+            crdt.merge_split(peer_scs, ids_in)
+    except SyncError:
+        conn.reset()
+        raise
+    except (OSError, ValueError) as e:
+        conn.reset()
+        raise SyncTransportError(f"sync round failed: {e!r}") from e
+    return watermark
+
+
+def sync_packed_over_conn(crdt, conn: PeerConnection,
+                          since: Optional[Hlc] = None,
+                          lock: Optional[threading.Lock] = None,
+                          tally: Optional[WireTally] = None,
+                          _prepacked: Optional[Tuple] = None) -> Hlc:
+    """One INCREMENTAL round over a pooled session: both directions
+    ship the O(k) packed columnar form (`DenseCrdt.pack_since` /
+    `merge_packed`), so bytes are proportional to the rows modified
+    since ``since`` — not to store capacity (the dense form) or to
+    full-state JSON. The same single watermark bounds BOTH halves:
+    after a successful round the peer holds everything stamped before
+    it, so the next round's ``pack_since(watermark)`` (inclusive)
+    misses nothing; the first round (``since=None``) pushes and pulls
+    full state. An empty half (k == 0) is skipped entirely — no op on
+    the wire for the push, no merge for the pull — which keeps both
+    clocks (and so both pack caches) untouched on a no-change round.
+
+    Requires the peer to have advertised the "packed" cap
+    (:class:`SyncProtocolError` code ``packed_rejected`` otherwise —
+    the sticky-downgrade signal, raised before any bytes move).
+    ``_prepacked`` is the pipelined gossip hook: a
+    ``(watermark, packed, ids)`` triple packed earlier (overlapped
+    with another peer's network phase) to use instead of packing
+    here."""
+    if lock is None:
+        lock = threading.Lock()   # uncontended no-op
+    from .ops.packing import pack_rows, unpack_rows
+    if _prepacked is not None:
+        watermark, packed, ids = _prepacked
+    else:
+        with lock:
+            watermark = crdt.canonical_time
+            packed, ids = crdt.pack_since(since)
+    import time as _time
+    sock = conn.ensure(tally)
+    if "packed" not in conn.caps:
+        # Raised before any bytes move: the session is still in sync,
+        # so no reset — the caller can immediately retry dense/JSON
+        # over the same connection.
+        raise SyncProtocolError(
+            "peer did not advertise the 'packed' capability",
+            code="packed_rejected")
+    try:
+        codec = conn.codec
+        if packed.k:
+            meta, bufs = pack_rows(packed)
+            send_frame(sock, {"op": "push_packed", "meta": meta,
+                              "node_ids": list(ids)}, tally, codec)
+            send_bytes_frame(sock, bufs, tally, codec)
+            reply = recv_frame(
+                sock, deadline=_time.monotonic() + conn.timeout,
+                tally=tally, codec=codec)
+            _check_reply("push rejected", reply, "ok")
+        send_frame(sock, {"op": "delta_packed",
+                          "since": None if since is None
+                          else str(since)}, tally, codec)
+        reply = recv_frame(sock,
+                           deadline=_time.monotonic() + conn.timeout,
+                           tally=tally, codec=codec)
+        _check_reply("delta failed", reply, "meta")
+        blob = recv_bytes_frame(sock,
+                                deadline=_time.monotonic()
+                                + conn.timeout,
+                                tally=tally, codec=codec)
+        if blob is None:
+            raise SyncTransportError("delta binary frame missing")
+        peer_packed = unpack_rows(reply["meta"], blob)
+        ids_in = reply.get("node_ids")
+        if not isinstance(ids_in, list):
+            raise SyncTransportError("delta reply without node_ids")
+        if peer_packed.k:
+            if not ids_in:
+                raise SyncTransportError("delta reply without node_ids")
+            with lock:
+                crdt.merge_packed(peer_packed, ids_in)
+    except SyncError:
+        conn.reset()
+        raise
+    except (OSError, ValueError) as e:
+        conn.reset()
+        raise SyncTransportError(f"sync round failed: {e!r}") from e
+    return watermark
 
 
 def sync_over_tcp(crdt: Crdt, host: str, port: int,
@@ -615,42 +1176,26 @@ def sync_over_tcp(crdt: Crdt, host: str, port: int,
     retryable :class:`SyncTransportError`, peer rejections as fatal
     :class:`SyncProtocolError` — both still `ConnectionError`.
     ``tally``, when given, accumulates wire bytes for the round.
+
+    This is the one-shot wrapper around :func:`sync_over_conn`: a
+    non-negotiating session (no hello — the wire bytes are exactly
+    the pre-hello protocol, so any server vintage interoperates) that
+    lives for one round and says ``bye``. Gossip pools a
+    :class:`PeerConnection` instead.
     """
-    if lock is None:
-        lock = threading.Lock()   # uncontended no-op
-    with lock:
-        watermark = crdt.canonical_time
-        payload = crdt.to_json(key_encoder=key_encoder,
-                               value_encoder=value_encoder)
-    import time as _time
+    conn = PeerConnection(host, port, timeout=timeout,
+                          negotiate=False)
     try:
-        with socket.create_connection((host, port),
-                                      timeout=timeout) as sock:
-            sock.settimeout(timeout)
-            # Each reply frame is bounded WHOLE (not per recv chunk):
-            # a server trickling bytes can't hold the round open past
-            # ``timeout`` per frame.
-            send_frame(sock, {"op": "push", "payload": payload}, tally)
-            reply = recv_frame(sock,
-                               deadline=_time.monotonic() + timeout,
-                               tally=tally)
-            _check_reply("push rejected", reply, "ok")
-            send_frame(sock, {"op": "delta",
-                              "since": None if since is None
-                              else str(since)}, tally)
-            reply = recv_frame(sock,
-                               deadline=_time.monotonic() + timeout,
-                               tally=tally)
-            _check_reply("delta failed", reply, "payload")
-            pulled = reply["payload"]
-            with lock:
-                crdt.merge_json(pulled, key_decoder=key_decoder,
-                                value_decoder=value_decoder)
-            send_frame(sock, {"op": "bye"}, tally)
-    except SyncError:
+        watermark = sync_over_conn(crdt, conn, since=since,
+                                   key_encoder=key_encoder,
+                                   value_encoder=value_encoder,
+                                   key_decoder=key_decoder,
+                                   value_decoder=value_decoder,
+                                   lock=lock, tally=tally)
+        conn.close(tally)
+    except BaseException:
+        conn.reset()
         raise
-    except (OSError, ValueError) as e:
-        raise SyncTransportError(f"sync round failed: {e!r}") from e
     return watermark
 
 
@@ -672,48 +1217,20 @@ def sync_dense_over_tcp(crdt, host: str, port: int,
     compiled can exceed the default 30 s ``timeout`` on its FIRST
     round (Mosaic compiles run ~20-40 s on some TPU runtimes) — warm
     the replica with one local merge, or pass a larger timeout for
-    first contact."""
-    if lock is None:
-        lock = threading.Lock()   # uncontended no-op
-    with lock:
-        watermark = crdt.canonical_time
-        scs, ids = crdt.export_split_delta()
-        meta, bufs = _pack_split(scs)
-    import time as _time
+    first contact.
+
+    One-shot wrapper around :func:`sync_dense_over_conn` (no hello —
+    exactly the pre-hello wire bytes); gossip pools a
+    :class:`PeerConnection` instead."""
+    conn = PeerConnection(host, port, timeout=timeout,
+                          negotiate=False)
     try:
-        with socket.create_connection((host, port),
-                                      timeout=timeout) as sock:
-            sock.settimeout(timeout)
-            send_frame(sock, {"op": "push_dense", "meta": meta,
-                              "node_ids": list(ids)}, tally)
-            send_bytes_frame(sock, bufs, tally)
-            reply = recv_frame(sock,
-                               deadline=_time.monotonic() + timeout,
-                               tally=tally)
-            _check_reply("push rejected", reply, "ok")
-            send_frame(sock, {"op": "delta_dense",
-                              "since": None if since is None
-                              else str(since)}, tally)
-            reply = recv_frame(sock,
-                               deadline=_time.monotonic() + timeout,
-                               tally=tally)
-            _check_reply("delta failed", reply, "meta")
-            blob = recv_bytes_frame(sock,
-                                    deadline=_time.monotonic() + timeout,
-                                    tally=tally)
-            if blob is None:
-                raise SyncTransportError("delta binary frame missing")
-            peer_scs = _unpack_split(reply["meta"], blob)
-            ids_in = reply.get("node_ids")
-            if not isinstance(ids_in, list) or not ids_in:
-                raise SyncTransportError("delta reply without node_ids")
-            with lock:
-                crdt.merge_split(peer_scs, ids_in)
-            send_frame(sock, {"op": "bye"}, tally)
-    except SyncError:
+        watermark = sync_dense_over_conn(crdt, conn, since=since,
+                                         lock=lock, tally=tally)
+        conn.close(tally)
+    except BaseException:
+        conn.reset()
         raise
-    except (OSError, ValueError) as e:
-        raise SyncTransportError(f"sync round failed: {e!r}") from e
     return watermark
 
 

@@ -265,22 +265,11 @@ def fanin_stream(store: DenseStore, chunks: DenseChangeset,
                            first_is_dup=fd, canonical_at_fail=caf)
 
 
-@jax.jit
-def sparse_fanin_step(store: DenseStore, slot: jax.Array, lt: jax.Array,
-                      node: jax.Array, val: jax.Array, tomb: jax.Array,
-                      valid: jax.Array, stamp_lt: jax.Array,
-                      local_node: jax.Array
-                      ) -> Tuple[DenseStore, jax.Array]:
-    """O(k) slot-indexed scatter join of a k-record delta into an
-    N-slot store — the wire-delta shape (a 10-record JSON sync into a
-    1M-slot replica must not materialize 1M-wide lanes).
-
-    Clock absorption and recv guards are the CALLER's job (run
-    host-side in the payload's visit order, crdt.dart:80-85, before
-    invoking); ``stamp_lt`` is the post-absorption canonical that
-    winners' ``modified`` lanes take (crdt.dart:86-87). Slots must be
-    unique (a dict-keyed delta guarantees it). Returns
-    ``(new_store, win)`` with ``win`` over the k entries."""
+def _sparse_fanin_body(store: DenseStore, slot: jax.Array,
+                       lt: jax.Array, node: jax.Array, val: jax.Array,
+                       tomb: jax.Array, valid: jax.Array,
+                       stamp_lt: jax.Array, local_node: jax.Array
+                       ) -> Tuple[DenseStore, jax.Array]:
     l_lt = store.lt.at[slot].get(mode="fill", fill_value=0)
     l_node = store.node.at[slot].get(mode="fill", fill_value=0)
     l_occ = store.occupied.at[slot].get(mode="fill", fill_value=False)
@@ -305,24 +294,10 @@ def sparse_fanin_step(store: DenseStore, slot: jax.Array, lt: jax.Array,
     return new_store, win
 
 
-@jax.jit
-def wire_join_step(store: DenseStore, lt: jax.Array, node: jax.Array,
-                   val: jax.Array, tomb: jax.Array, valid: jax.Array,
-                   stamp_lt: jax.Array, local_node: jax.Array
-                   ) -> Tuple[DenseStore, jax.Array]:
-    """Elementwise N-wide join of a SLOT-ALIGNED wire delta (lane i is
-    slot i's record, ``valid`` masking absent slots) — the large-k
-    companion of `sparse_fanin_step`: no gather, no scatter (TPU
-    scatters serialize per index; at k ≈ n_slots the elementwise form
-    is >10× faster), just one fused compare/select sweep.
-
-    Clock absorption and recv guards are the CALLER's job (the host
-    recv fold, crdt.dart:80-85); ``stamp_lt`` is the post-absorption
-    canonical for winners' ``modified`` lanes (crdt.dart:86-87).
-    ``node`` may arrive int16 and ``val`` int32 (narrow wire
-    transfers); both widen in-jit, so the host→device bytes shrink
-    without touching the compare semantics. Returns
-    ``(new_store, win)`` with ``win`` over the N slots."""
+def _wire_join_body(store: DenseStore, lt: jax.Array, node: jax.Array,
+                    val: jax.Array, tomb: jax.Array, valid: jax.Array,
+                    stamp_lt: jax.Array, local_node: jax.Array
+                    ) -> Tuple[DenseStore, jax.Array]:
     lt = jnp.where(valid, lt, _NEG)
     node = node.astype(jnp.int32)
     val = val.astype(jnp.int64)
@@ -340,6 +315,87 @@ def wire_join_step(store: DenseStore, lt: jax.Array, node: jax.Array,
         tomb=jnp.where(win, tomb, store.tomb),
     )
     return new_store, win
+
+
+# Jit-cached merge entry points, keyed on (donate, sharding) like the
+# local-write scatters below: donating the old store lets XLA update
+# the O(n_slots) lanes in place for an O(k) delta (on backends that
+# honor donation), and the sharding constraint pins a sharded model's
+# merge output onto its key-axis layout — without it XLA picks, and
+# every sharded merge pays a full-store re-shard copy on top of the
+# multi-chip dispatch floor (docs/PERF.md MULTICHIP_SCALE_r05).
+
+import functools as _ft
+
+
+@_ft.lru_cache(maxsize=None)
+def _sparse_fanin_jit(donate: bool, sharding=None):
+    def step(store, slot, lt, node, val, tomb, valid, stamp_lt,
+             local_node):
+        new_store, win = _sparse_fanin_body(
+            store, slot, lt, node, val, tomb, valid, stamp_lt,
+            local_node)
+        if sharding is not None:
+            new_store = jax.lax.with_sharding_constraint(new_store,
+                                                         sharding)
+        return new_store, win
+    return jax.jit(step, donate_argnums=(0,) if donate else ())
+
+
+@_ft.lru_cache(maxsize=None)
+def _wire_join_jit(donate: bool, sharding=None):
+    def step(store, lt, node, val, tomb, valid, stamp_lt, local_node):
+        new_store, win = _wire_join_body(store, lt, node, val, tomb,
+                                         valid, stamp_lt, local_node)
+        if sharding is not None:
+            new_store = jax.lax.with_sharding_constraint(new_store,
+                                                         sharding)
+        return new_store, win
+    return jax.jit(step, donate_argnums=(0,) if donate else ())
+
+
+def sparse_fanin_step(store: DenseStore, slot: jax.Array, lt: jax.Array,
+                      node: jax.Array, val: jax.Array, tomb: jax.Array,
+                      valid: jax.Array, stamp_lt: jax.Array,
+                      local_node: jax.Array, *, donate: bool = False,
+                      sharding=None) -> Tuple[DenseStore, jax.Array]:
+    """O(k) slot-indexed scatter join of a k-record delta into an
+    N-slot store — the wire-delta shape (a 10-record JSON sync into a
+    1M-slot replica must not materialize 1M-wide lanes).
+
+    Clock absorption and recv guards are the CALLER's job (run
+    host-side in the payload's visit order, crdt.dart:80-85, before
+    invoking); ``stamp_lt`` is the post-absorption canonical that
+    winners' ``modified`` lanes take (crdt.dart:86-87). Slots must be
+    unique (a dict-keyed delta guarantees it). ``donate`` hands the old
+    store buffers to XLA (caller must not reuse them); ``sharding``
+    pins the output layout. Returns ``(new_store, win)`` with ``win``
+    over the k entries."""
+    return _sparse_fanin_jit(donate, sharding)(
+        store, slot, lt, node, val, tomb, valid, stamp_lt, local_node)
+
+
+def wire_join_step(store: DenseStore, lt: jax.Array, node: jax.Array,
+                   val: jax.Array, tomb: jax.Array, valid: jax.Array,
+                   stamp_lt: jax.Array, local_node: jax.Array, *,
+                   donate: bool = False, sharding=None
+                   ) -> Tuple[DenseStore, jax.Array]:
+    """Elementwise N-wide join of a SLOT-ALIGNED wire delta (lane i is
+    slot i's record, ``valid`` masking absent slots) — the large-k
+    companion of `sparse_fanin_step`: no gather, no scatter (TPU
+    scatters serialize per index; at k ≈ n_slots the elementwise form
+    is >10× faster), just one fused compare/select sweep.
+
+    Clock absorption and recv guards are the CALLER's job (the host
+    recv fold, crdt.dart:80-85); ``stamp_lt`` is the post-absorption
+    canonical for winners' ``modified`` lanes (crdt.dart:86-87).
+    ``node`` may arrive int16 and ``val`` int32 (narrow wire
+    transfers); both widen in-jit, so the host→device bytes shrink
+    without touching the compare semantics. ``donate``/``sharding``
+    follow `sparse_fanin_step`. Returns ``(new_store, win)`` with
+    ``win`` over the N slots."""
+    return _wire_join_jit(donate, sharding)(
+        store, lt, node, val, tomb, valid, stamp_lt, local_node)
 
 
 @jax.jit

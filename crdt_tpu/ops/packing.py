@@ -15,7 +15,7 @@ they are replicas, not records).
 from __future__ import annotations
 
 import bisect
-from typing import Any, List, Optional, Sequence, Tuple
+from typing import Any, List, NamedTuple, Optional, Sequence, Tuple
 
 import numpy as np
 
@@ -103,6 +103,86 @@ class NodeTable:
         omap = self._omap
         return np.fromiter((omap[n] for n in node_ids), np.int32,
                            count=len(node_ids))
+
+
+# Exact host lane dtypes of the PACKED wire form, in field order.
+# Anything else from a peer is a protocol violation (mirrors
+# net._SPLIT_LANE_DTYPES: never trust np.dtype as a parser for
+# untrusted dtype strings).
+PACKED_LANE_DTYPES = ("int32", "int64", "int32", "int64", "uint8")
+
+
+class PackedDelta(NamedTuple):
+    """Incremental columnar wire form: ONE row per modified slot.
+
+    The dense binary form (`net.sync_dense_over_tcp`) always ships
+    n_slots-wide lanes with a validity mask — O(store) bytes even for
+    a 3-record delta. This form is the O(k) counterpart: host numpy
+    lanes holding only the rows ``DenseCrdt.pack_since`` selected, so
+    a steady-state gossip round costs bytes proportional to what
+    actually changed (~25 B/row). ``node`` carries ordinals into the
+    ``node_ids`` list that travels beside the delta; ``modified``
+    stamps are local-only and never serialized (record.dart:28-31)."""
+
+    slots: np.ndarray   # int32[k], unique (last-wins collapsed)
+    lt: np.ndarray      # int64[k] packed logical times
+    node: np.ndarray    # int32[k] ordinals into the wire node_ids
+    val: np.ndarray     # int64[k] (0 where tombstoned)
+    tomb: np.ndarray    # uint8[k] 0/1 tombstone flags
+
+    @property
+    def k(self) -> int:
+        return len(self.slots)
+
+    @property
+    def nbytes(self) -> int:
+        return sum(lane.nbytes for lane in self)
+
+
+def pack_rows(delta: "PackedDelta") -> Tuple[dict, List[memoryview]]:
+    """(meta, bufs) for a packed delta: lane descriptors plus host
+    buffers in field order — the shape `net.send_bytes_frame` ships as
+    one raw binary frame."""
+    arrs = [np.ascontiguousarray(np.asarray(lane, dtype))
+            for lane, dtype in zip(delta, PACKED_LANE_DTYPES)]
+    meta = {"form": "packed",
+            "lanes": [[f, str(a.dtype), [len(a)]]
+                      for f, a in zip(delta._fields, arrs)]}
+    return meta, [a.data.cast("B") for a in arrs]
+
+
+def unpack_rows(meta: Any, blob: bytes) -> "PackedDelta":
+    """Validate + reconstruct the packed delta a peer announced.
+    Raises ValueError on any structural violation (wrong fields or
+    dtypes, ragged lane lengths, frame size mismatch) BEFORE the
+    replica is touched. ``k == 0`` is a legal empty delta."""
+    if not isinstance(meta, dict) or meta.get("form") != "packed":
+        raise ValueError("bad packed meta")
+    lanes_meta = meta.get("lanes")
+    if not isinstance(lanes_meta, list) \
+            or [l[0] for l in lanes_meta] != list(PackedDelta._fields):
+        raise ValueError("packed lane fields mismatch")
+    lanes = []
+    off = 0
+    k = None
+    for (_, dt, shape), want in zip(lanes_meta, PACKED_LANE_DTYPES):
+        if dt != want:
+            raise ValueError(f"lane dtype {dt!r} != expected {want!r}")
+        if not isinstance(shape, list) or len(shape) != 1 \
+                or int(shape[0]) < 0:
+            raise ValueError("bad packed lane shape")
+        n = int(shape[0])
+        if k is None:
+            k = n
+        elif n != k:
+            raise ValueError("ragged packed lanes")
+        a = np.frombuffer(blob, np.dtype(dt), count=n, offset=off)
+        off += a.nbytes
+        lanes.append(a)
+    if off != len(blob):
+        raise ValueError(f"packed frame size mismatch: lanes describe "
+                         f"{off} bytes, frame holds {len(blob)}")
+    return PackedDelta(*lanes)
 
 
 def pack_hlcs(hlcs: Sequence[Hlc], table: NodeTable

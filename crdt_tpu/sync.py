@@ -9,13 +9,17 @@ convergence through an intermediary relies on merged records being
 re-stamped with the relay's ``modified`` time (crdt.dart:87) — the
 relay's deltas then include records it learned from others.
 
-Two transports:
+Three transports:
 
 - :func:`sync` — in-process record maps (replicas share a process, the
   reference's own test topology).
 - :func:`sync_json` — the JSON wire format (crdt_json.dart), what
   crosses a real replica boundary; transport remains the application's
   job (example/crdt_example.dart:21-25).
+- :func:`sync_packed` — the O(k) packed columnar form
+  (`DenseCrdt.pack_since` / `merge_packed`), the in-process twin of
+  `net.sync_packed_over_conn` — same one-watermark round shape, no
+  sockets. Both replicas must speak the packed form.
 """
 
 from __future__ import annotations
@@ -71,4 +75,30 @@ def sync_json(local: Crdt, remote: Crdt,
         value_encoder=value_encoder),
         key_decoder=key_decoder,
         value_decoder=value_decoder)
+    return watermark
+
+
+def sync_packed(local, remote, since=_SAME_ROUND) -> Hlc:
+    """The same round on the packed columnar wire form: push only the
+    rows the local replica modified since ``since``, pull only the
+    rows the remote modified since the same watermark. ``since``
+    follows :func:`sync`'s contract (omit: one-shot round bounded by
+    this round's pre-push canonical time; ``None``: cold-start full
+    exchange; a prior round's return: resume delta sync — the single
+    watermark soundly bounds BOTH halves, exactly as
+    `net.sync_packed_over_conn`). Empty halves (k == 0) skip the
+    merge, keeping both clocks — and so both pack caches — still on
+    a no-change round."""
+    watermark = local.canonical_time
+    # One-shot shape: FULL push (the reference pushes its whole record
+    # map), pull bounded by the pre-push canonical time. With an
+    # explicit watermark, the same bound governs both halves.
+    push_bound = None if since is _SAME_ROUND else since
+    pull_bound = watermark if since is _SAME_ROUND else since
+    packed, ids = local.pack_since(push_bound)
+    if packed.k:
+        remote.merge_packed(packed, ids)
+    pulled, pulled_ids = remote.pack_since(pull_bound)
+    if pulled.k:
+        local.merge_packed(pulled, pulled_ids)
     return watermark

@@ -127,13 +127,14 @@ class FaultProxy:
     counted), plus ``"connections"``. The soak asserts on these to
     prove its faults happened."""
 
+    _passthrough = False
+
     def __init__(self, target_host: str, target_port: int,
                  schedule=None,
                  host: str = "127.0.0.1", port: int = 0):
         self.target_host = target_host
         self.target_port = target_port
         self.schedule = schedule or FaultSchedule()
-        self.passthrough = False
         self.counters: Dict[str, int] = {}
         self._counter_lock = threading.Lock()
         self._lsock = socket.create_server((host, port))
@@ -163,6 +164,23 @@ class FaultProxy:
 
     def __exit__(self, *exc) -> None:
         self.stop()
+
+    @property
+    def passthrough(self) -> bool:
+        """Faulting disabled? Flipping True→False (a test starting
+        its partition) also tears down ESTABLISHED relays: pooled
+        clients hold sessions open across rounds, and a partition
+        must cut those flows too — not just refuse new connects."""
+        return self._passthrough
+
+    @passthrough.setter
+    def passthrough(self, value: bool) -> None:
+        was = self._passthrough
+        self._passthrough = value
+        if was and not value:
+            for sock in list(self._open):
+                self._open.discard(sock)
+                _teardown(sock)
 
     def _count(self, key: str) -> None:
         with self._counter_lock:

@@ -98,6 +98,25 @@ def test_donated_buffer_rebind_not_flagged():
     assert "donated-buffer-reuse" not in rules
 
 
+def test_peer_connection_idle_timeout_none_flagged():
+    src = (
+        "from crdt_tpu.net import PeerConnection\n"
+        "conn = PeerConnection('h', 1, idle_timeout=None)\n")
+    findings = [f for f in lint_source(src, "snippet.py")
+                if f.rule == "socket-no-timeout"]
+    assert len(findings) == 1
+    assert "idle_timeout" in findings[0].message
+
+
+def test_peer_connection_with_idle_timeout_not_flagged():
+    src = (
+        "from crdt_tpu.net import PeerConnection\n"
+        "a = PeerConnection('h', 1)\n"
+        "b = PeerConnection('h', 1, idle_timeout=5.0)\n")
+    rules = {f.rule for f in lint_source(src, "snippet.py")}
+    assert "socket-no-timeout" not in rules
+
+
 def test_shipped_tree_lints_clean():
     from crdt_tpu.analysis.host_lint import lint_package
     import crdt_tpu

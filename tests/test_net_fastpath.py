@@ -1,0 +1,497 @@
+"""Sync fast path: pooled sessions, hello negotiation, compressed and
+packed frames — and, critically, that none of it breaks a pre-PR peer.
+
+The legacy-interop tests speak the OLD wire by hand (raw sockets,
+untagged frames, no hello) so the bytes they exchange are exactly what
+a pre-fast-path build would send; the new endpoints must serve and
+consume them unchanged.
+"""
+
+import json
+import socket
+import struct
+import threading
+
+import numpy as np
+import pytest
+
+from crdt_tpu import (DenseCrdt, FrameCodec, GossipNode, MapCrdt,
+                      PeerConnection, SyncProtocolError, SyncServer,
+                      SyncTransportError, WireTally, sync_over_conn,
+                      sync_packed, sync_packed_over_conn)
+from crdt_tpu.ops.packing import PackedDelta, pack_rows, unpack_rows
+from crdt_tpu.testing import FakeClock
+from crdt_tpu.testing_faults import FaultProxy, FaultSchedule
+
+pytestmark = pytest.mark.net
+
+
+# --- FrameCodec ---
+
+def test_codec_raw_roundtrip_and_tag():
+    c = FrameCodec(compress=False)
+    pieces = c.encode([b"hello ", b"world"])
+    assert pieces[0] == FrameCodec.TAG_RAW
+    assert c.decode(b"".join(pieces)) == b"hello world"
+
+
+def test_codec_compresses_large_compressible_bodies():
+    c = FrameCodec(compress=True)
+    body = b"abc" * 1000
+    tally = WireTally()
+    pieces = c.encode([body], tally)
+    assert pieces[0] == FrameCodec.TAG_ZLIB
+    wire = b"".join(pieces)
+    assert len(wire) < len(body)
+    assert tally.z_raw == len(body) and tally.z_wire == len(wire) - 1
+    assert tally.z_ratio > 1.0
+    assert c.decode(wire) == body
+
+
+def test_codec_small_and_incompressible_ship_raw():
+    c = FrameCodec(compress=True)
+    # under the threshold: never compressed
+    assert c.encode([b"tiny"])[0] == FrameCodec.TAG_RAW
+    # over the threshold but incompressible: raw beats a larger stream
+    noise = np.random.default_rng(3).bytes(4096)
+    pieces = c.encode([noise])
+    assert pieces[0] == FrameCodec.TAG_RAW
+    assert c.decode(b"".join(pieces)) == noise
+
+
+def test_codec_rejects_garbage():
+    c = FrameCodec()
+    with pytest.raises(ValueError):
+        c.decode(b"")                       # empty tagged body
+    with pytest.raises(ValueError):
+        c.decode(b"\x07data")               # unknown tag
+    with pytest.raises(ValueError):
+        c.decode(FrameCodec.TAG_ZLIB + b"not zlib at all")
+    import zlib
+    ok = zlib.compress(b"x" * 100)
+    with pytest.raises(ValueError):
+        c.decode(FrameCodec.TAG_ZLIB + ok[:-3])   # truncated stream
+    with pytest.raises(ValueError):
+        c.decode(FrameCodec.TAG_ZLIB + ok + b"trailing")
+
+
+# --- hello negotiation + pooling ---
+
+def test_hello_negotiates_cap_intersection():
+    with SyncServer(DenseCrdt("s", n_slots=32)) as server:
+        with PeerConnection(server.host, server.port,
+                            timeout=5.0) as conn:
+            conn.ensure()
+            assert conn.caps == frozenset({"zlib", "packed"})
+            assert not conn.legacy
+        with PeerConnection(server.host, server.port, timeout=5.0,
+                            want_caps=("zlib",)) as conn:
+            conn.ensure()
+            assert conn.caps == frozenset({"zlib"})
+
+
+def test_map_server_does_not_advertise_packed():
+    with SyncServer(MapCrdt("s", wall_clock=FakeClock())) as server:
+        with PeerConnection(server.host, server.port,
+                            timeout=5.0) as conn:
+            conn.ensure()
+            assert conn.caps == frozenset({"zlib"})
+
+
+def test_pooled_session_reuses_one_connect():
+    clk = FakeClock()
+    hub = MapCrdt("hub", wall_clock=clk)
+    edge = MapCrdt("edge", wall_clock=clk)
+    with SyncServer(hub) as server:
+        with PeerConnection(server.host, server.port,
+                            timeout=5.0) as conn:
+            mark = None
+            for i in range(5):
+                edge.put(f"k{i}", i)
+                mark = sync_over_conn(edge, conn, since=mark)
+            assert conn.connects == 1
+    assert hub.map == edge.map
+
+
+def test_pooled_session_reconnects_after_server_drop():
+    clk = FakeClock()
+    hub = MapCrdt("hub", wall_clock=clk)
+    edge = MapCrdt("edge", wall_clock=clk)
+    edge.put("a", 1)
+    server = SyncServer(hub).start()
+    try:
+        conn = PeerConnection(server.host, server.port, timeout=5.0)
+        mark = sync_over_conn(edge, conn, since=None)
+        # the server restarts out from under the parked session
+        host, port = server.host, server.port
+        server.stop()
+        server = SyncServer(hub, host, port).start()
+        edge.put("b", 2)
+        try:
+            sync_over_conn(edge, conn, since=mark)
+        except SyncTransportError:
+            # dead socket detected mid-round: session was reset,
+            # the retry reconnects — exactly what gossip does
+            sync_over_conn(edge, conn, since=mark)
+        assert conn.connects == 2
+        assert hub.get("b") == 2
+        conn.close()
+    finally:
+        server.stop()
+
+
+def test_no_zlib_cap_means_raw_frames():
+    clk = FakeClock()
+    hub = MapCrdt("hub", wall_clock=clk)
+    edge = MapCrdt("edge", wall_clock=clk)
+    for i in range(200):
+        edge.put(f"key-number-{i}", f"value-{i}" * 4)
+    with SyncServer(hub) as server:
+        tally = WireTally()
+        with PeerConnection(server.host, server.port, timeout=5.0,
+                            want_caps=("packed",)) as conn:
+            sync_over_conn(edge, conn, since=None, tally=tally)
+        assert tally.z_wire == 0            # nothing compressed...
+    assert hub.map == edge.map              # ...round still converges
+
+
+def test_zlib_cap_compresses_big_payloads():
+    clk = FakeClock()
+    hub = MapCrdt("hub", wall_clock=clk)
+    edge = MapCrdt("edge", wall_clock=clk)
+    for i in range(200):
+        edge.put(f"key-number-{i}", f"value-{i}" * 4)
+    with SyncServer(hub) as server:
+        tally = WireTally()
+        with PeerConnection(server.host, server.port,
+                            timeout=5.0) as conn:
+            sync_over_conn(edge, conn, since=None, tally=tally)
+        assert tally.z_ratio > 1.5
+    assert hub.map == edge.map
+
+
+# --- legacy interop: the pre-PR wire, both directions ---
+
+def _legacy_send(sock, obj):
+    body = json.dumps(obj).encode()
+    sock.sendall(struct.pack(">I", len(body)) + body)
+
+
+def _legacy_recv(sock):
+    head = b""
+    while len(head) < 4:
+        chunk = sock.recv(4 - len(head))
+        assert chunk, "legacy peer saw EOF"
+        head += chunk
+    (n,) = struct.unpack(">I", head)
+    body = b""
+    while len(body) < n:
+        chunk = sock.recv(n - len(body))
+        assert chunk, "legacy peer saw EOF mid-frame"
+        body += chunk
+    return json.loads(body)
+
+
+def test_legacy_client_against_new_server():
+    """A pre-PR client (no hello, untagged frames) must sync against
+    the new multi-capability server byte-for-byte as before."""
+    clk = FakeClock()
+    hub = MapCrdt("hub", wall_clock=clk)
+    hub.put("motd", "hi")
+    edge = MapCrdt("edge", wall_clock=clk)
+    edge.put("n", 7)
+    with SyncServer(hub) as server:
+        with socket.create_connection((server.host, server.port),
+                                      timeout=5.0) as sock:
+            _legacy_send(sock, {"op": "push",
+                                "payload": edge.to_json()})
+            assert _legacy_recv(sock).get("ok") is True
+            _legacy_send(sock, {"op": "delta", "since": None})
+            reply = _legacy_recv(sock)
+            assert "payload" in reply
+            edge.merge_json(reply["payload"])
+            _legacy_send(sock, {"op": "bye"})
+    assert edge.map == hub.map
+    assert hub.get("n") == 7
+
+
+class _LegacyServer:
+    """A hand-rolled pre-hello server: answers ``unknown_op`` to
+    anything but push/delta/bye — including hello — then hangs up,
+    exactly like a pre-PR SyncServer. One connection at a time."""
+
+    def __init__(self, crdt):
+        self.crdt = crdt
+        self.lock = threading.Lock()
+        self._lsock = socket.create_server(("127.0.0.1", 0))
+        self._lsock.settimeout(0.2)
+        self.host, self.port = self._lsock.getsockname()[:2]
+        self._stop = threading.Event()
+        self._thread = threading.Thread(target=self._serve, daemon=True)
+
+    def __enter__(self):
+        self._thread.start()
+        return self
+
+    def __exit__(self, *exc):
+        self._stop.set()
+        self._thread.join(timeout=10)
+        self._lsock.close()
+
+    def _serve(self):
+        while not self._stop.is_set():
+            try:
+                conn, _ = self._lsock.accept()
+            except socket.timeout:
+                continue
+            except OSError:
+                return
+            with conn:
+                conn.settimeout(5.0)
+                try:
+                    self._handle(conn)
+                except (OSError, AssertionError, ValueError):
+                    pass
+
+    def _handle(self, conn):
+        while True:
+            req = _legacy_recv(conn)
+            op = req.get("op")
+            if op == "push":
+                with self.lock:
+                    self.crdt.merge_json(req["payload"])
+                _legacy_send(conn, {"ok": True})
+            elif op == "delta":
+                with self.lock:
+                    payload = self.crdt.to_json(
+                        modified_since=None if req["since"] is None
+                        else __import__("crdt_tpu").Hlc.parse(
+                            req["since"]))
+                _legacy_send(conn, {"payload": payload})
+            elif op == "bye":
+                return
+            else:
+                _legacy_send(conn, {"ok": False, "code": "unknown_op",
+                                    "error": "unknown_op"})
+                return
+
+
+def test_new_client_against_legacy_server():
+    """The pooled client must detect a pre-hello server (unknown_op +
+    hangup), mark the session legacy (sticky), reconnect, and run
+    plain JSON rounds on the untagged framing."""
+    clk = FakeClock()
+    hub = MapCrdt("hub", wall_clock=clk)
+    hub.put("old", "state")
+    edge = MapCrdt("edge", wall_clock=clk)
+    edge.put("n", 7)
+    with _LegacyServer(hub) as server:
+        conn = PeerConnection(server.host, server.port, timeout=5.0)
+        mark = sync_over_conn(edge, conn, since=None)
+        assert conn.legacy is True
+        assert conn.caps == frozenset()
+        edge.put("m", 8)
+        sync_over_conn(edge, conn, since=mark)
+        conn.reset()     # legacy server closed after bye-less rounds
+    assert edge.map == hub.map
+    assert hub.get("n") == 7 and hub.get("m") == 8
+
+
+def test_gossip_node_against_legacy_server():
+    """End-to-end: a GossipNode aiming packed-first degrades through
+    the caps gate (no fallback counted — capability selection) and
+    converges with a legacy JSON-only peer."""
+    a = GossipNode(MapCrdt("a", wall_clock=FakeClock()))
+    hub = MapCrdt("hub", wall_clock=FakeClock())
+    hub.put("old", 1)
+    with a, _LegacyServer(hub) as server:
+        a.add_peer("legacy", server.host, server.port)
+        with a.lock:
+            a.crdt.put("new", 2)
+        assert a.sync_peer("legacy") == "ok"
+        snap = a.stats_snapshot()["legacy"]
+        assert snap["fallbacks"] == 0
+        assert snap["rounds_ok"] == 1
+    assert hub.get("new") == 2
+    with a.lock:
+        assert a.crdt.get("old") == 1
+
+
+# --- packed wire over sockets ---
+
+def test_packed_round_over_socket_and_empty_delta():
+    a = DenseCrdt("a", n_slots=64)
+    b = DenseCrdt("b", n_slots=64)
+    a.put_batch([1, 2], [10, 20])
+    with SyncServer(b) as server:
+        with PeerConnection(server.host, server.port,
+                            timeout=5.0) as conn:
+            mark = sync_packed_over_conn(a, conn, since=None,
+                                         lock=server.lock)
+            assert b.get(1) == 10 and b.get(2) == 20
+            # boundary rows (modified == watermark, inclusive bound)
+            # re-ship for a round or two; then a no-change round is
+            # k == 0 both ways and touches neither clock
+            for _ in range(6):
+                before = (a.canonical_time, b.canonical_time)
+                mark = sync_packed_over_conn(a, conn, since=mark,
+                                             lock=server.lock)
+                if (a.canonical_time, b.canonical_time) == before:
+                    break
+            else:
+                raise AssertionError("clocks never settled")
+            assert mark == before[0]
+
+
+def test_packed_rejected_before_any_bytes_on_capless_session():
+    a = DenseCrdt("a", n_slots=64)
+    a.put_batch([1], [10])
+    hub = MapCrdt("hub", wall_clock=FakeClock())
+    with SyncServer(hub) as server:
+        with PeerConnection(server.host, server.port,
+                            timeout=5.0) as conn:
+            with pytest.raises(SyncProtocolError) as ei:
+                sync_packed_over_conn(a, conn, since=None,
+                                      lock=server.lock)
+            assert ei.value.code == "packed_rejected"
+            # the session was NOT reset: it is immediately reusable
+            assert conn.connected and conn.connects == 1
+
+
+def test_server_rejects_malformed_packed_meta():
+    b = DenseCrdt("b", n_slots=64)
+    with SyncServer(b) as server:
+        with PeerConnection(server.host, server.port,
+                            timeout=5.0) as conn:
+            sock = conn.ensure()
+            from crdt_tpu.net import recv_frame, send_bytes_frame, \
+                send_frame
+            send_frame(sock, {"op": "push_packed",
+                              "meta": {"form": "packed",
+                                       "lanes": [["bogus", "int32",
+                                                  [1]]]},
+                              "node_ids": ["a"]}, codec=conn.codec)
+            send_bytes_frame(sock, [b"\x00" * 4], codec=conn.codec)
+            reply = recv_frame(sock, codec=conn.codec)
+            assert reply["ok"] is False
+            assert reply["code"] == "packed_rejected"
+
+
+def test_gossip_packed_pair_fault_proxy_midstream_recovery():
+    """A fault proxy truncating/dropping mid-stream during pooled
+    packed rounds: the session resets, the retry reconnects, and the
+    pair still converges."""
+    from crdt_tpu.testing_faults import ScriptedSchedule
+    a = GossipNode(DenseCrdt("a", n_slots=128))
+    b = GossipNode(DenseCrdt("b", n_slots=128))
+    # connection 1 (the initial pooled session) is cut 20 bytes into
+    # the stream — mid-hello — then every reconnect behaves
+    schedule = ScriptedSchedule([{"kind": "truncate", "after": 20}])
+    with a, b:
+        with FaultProxy(b.host, b.port, schedule) as proxy:
+            a.add_peer("b", proxy.host, proxy.port)
+            with a.lock:
+                a.crdt.put_batch([3, 4], [30, 40])
+            with b.lock:
+                b.crdt.put_batch([5], [50])
+            for _ in range(4):
+                a.run_round()
+            snap = a.stats_snapshot()["b"]
+            assert snap["rounds_ok"] > 0
+            assert snap["retries"] > 0
+            assert snap["connects"] >= 2     # reset + reconnect
+            assert proxy.counters.get("truncate", 0) > 0
+            with a.lock:
+                got_a = {s: a.crdt.get(s) for s in (3, 4, 5)}
+            with b.lock:
+                got_b = {s: b.crdt.get(s) for s in (3, 4, 5)}
+    assert got_a == {3: 30, 4: 40, 5: 50}
+    assert got_b == got_a
+
+
+# --- pack_since cache + merge_packed validation ---
+
+def test_pack_since_cache_hits_and_invalidation():
+    from crdt_tpu.obs.registry import default_registry
+    counter = default_registry().counter("crdt_tpu_pack_cache_total",
+                                         "")
+    crdt = DenseCrdt("n", n_slots=64)
+    crdt.put_batch([1, 2], [10, 20])
+    mark = crdt.canonical_time
+
+    def counts():
+        return (counter.value(outcome="hit", node="n"),
+                counter.value(outcome="miss", node="n"))
+
+    h0, m0 = counts()
+    p1, ids1 = crdt.pack_since(None)
+    h1, m1 = counts()
+    assert (h1, m1) == (h0, m0 + 1)
+    p2, ids2 = crdt.pack_since(None)            # same key: cached
+    h2, m2 = counts()
+    assert (h2, m2) == (h1 + 1, m1)
+    assert p2 is p1 and ids2 == ids1
+    crdt.pack_since(mark)                       # new since: misses
+    assert counts() == (h2, m2 + 1)
+    crdt.put_batch([3], [30])                   # store replaced:
+    crdt.pack_since(None)                       # cache invalidated
+    assert counts() == (h2, m2 + 2)
+
+
+def test_merge_packed_rejects_bad_lanes():
+    crdt = DenseCrdt("n", n_slots=8)
+    ragged = PackedDelta(
+        slots=np.array([1, 2], np.int32),
+        lt=np.array([5], np.int64),             # ragged
+        node=np.zeros(2, np.int32),
+        val=np.zeros(2, np.int64),
+        tomb=np.zeros(2, np.uint8))
+    with pytest.raises(ValueError):
+        crdt.merge_packed(ragged, ["peer"])
+    bad_ord = PackedDelta(
+        slots=np.array([1], np.int32),
+        lt=np.array([5 << 16], np.int64),
+        node=np.array([7], np.int32),           # only 1 id shipped
+        val=np.array([1], np.int64),
+        tomb=np.zeros(1, np.uint8))
+    with pytest.raises(ValueError):
+        crdt.merge_packed(bad_ord, ["peer"])
+
+
+def test_pack_roundtrip_and_unpack_validation():
+    d = PackedDelta(
+        slots=np.array([3, 9], np.int32),
+        lt=np.array([1 << 20, 2 << 20], np.int64),
+        node=np.array([0, 1], np.int32),
+        val=np.array([30, 90], np.int64),
+        tomb=np.array([0, 1], np.uint8))
+    meta, bufs = pack_rows(d)
+    blob = b"".join(bytes(b) for b in bufs)
+    back = unpack_rows(meta, blob)
+    for lane, orig in zip(back, d):
+        assert np.array_equal(lane, orig)
+    with pytest.raises(ValueError):
+        unpack_rows(meta, blob + b"\x00")        # size mismatch
+    with pytest.raises(ValueError):
+        unpack_rows({"form": "nope"}, blob)
+
+
+def test_sync_packed_in_process_matches_wire_semantics():
+    a = DenseCrdt("a", n_slots=32)
+    b = DenseCrdt("b", n_slots=32)
+    a.put_batch([1], [10])
+    b.put_batch([2], [20])
+    mark = sync_packed(a, b)
+    assert a.get(2) == 20 and b.get(1) == 10
+    a.put_batch([3], [30])
+    mark2 = sync_packed(a, b, since=mark)
+    assert b.get(3) == 30
+    # after boundary rows settle, a no-change resume keeps both
+    # clocks still
+    for _ in range(6):
+        before = (a.canonical_time, b.canonical_time)
+        mark2 = sync_packed(a, b, since=mark2)
+        if (a.canonical_time, b.canonical_time) == before:
+            break
+    else:
+        raise AssertionError("clocks never settled")
