@@ -575,6 +575,139 @@ def bench_sync(n_slots: int = 1 << 14, k: int = 256,
     return out
 
 
+def bench_ingest(n_slots: int = 1 << 14, rows: int = 1024,
+                 batches: int = 64, repeats: int = 24) -> dict:
+    """Write-path fast lane: staged ingest() vs unbatched put_batch.
+
+    One JSON line with the three acceptance signals of the write
+    combiner (docs/INGEST.md): staged vs unbatched puts/sec through
+    the model API (same random batches, device-fenced), a flush
+    latency histogram for a 1024-row commit on a single device, and
+    the same flush on a sharded store against the pre-combiner
+    put_batch baseline (MULTICHIP_SCALE_r05.json: sharded 4.81 ms /
+    single 1.73 ms, dispatch floors 2.132 / 0.856) with the measured
+    dispatch floor subtracted so the scatter's own cost is visible."""
+    import statistics
+    import numpy as np
+    from crdt_tpu.models.dense_crdt import DenseCrdt, ShardedDenseCrdt
+    from crdt_tpu.parallel import make_fanin_mesh
+
+    platform = jax.devices()[0].platform
+    med = statistics.median
+    rng = np.random.default_rng(11)
+    data = [rng.choice(n_slots, size=rows, replace=False)
+            for _ in range(batches)]
+    vals = [(s % 1000).astype(np.int64) for s in data]
+    total = rows * batches
+
+    def fence(crdt):
+        jax.block_until_ready(crdt._store.lt)
+
+    # --- throughput: one scatter per call vs one fused flush ---
+    def run_unbatched():
+        c = DenseCrdt("i", n_slots=n_slots)
+        c.put_batch(data[0], vals[0])     # compile the per-call scatter
+        fence(c)
+        t0 = time.perf_counter()
+        for s, v in zip(data, vals):
+            c.put_batch(s, v)
+        fence(c)
+        return time.perf_counter() - t0
+
+    def run_staged():
+        c = DenseCrdt("i", n_slots=n_slots)
+        t0 = time.perf_counter()
+        with c.ingest() as wc:
+            for s, v in zip(data, vals):
+                c.put_batch(s, v)
+        fence(c)
+        return time.perf_counter() - t0, wc.flushes
+
+    run_staged()                          # compile the fused flush
+    staged_s, flushes = run_staged()
+    unbatched_s = run_unbatched()
+
+    # --- flush latency: 1024 staged rows to committed, fenced ---
+    def flush_hist(crdt):
+        times = []
+        with crdt.ingest() as wc:
+            for i in range(repeats + 2):
+                crdt.put_batch(data[i % batches], vals[i % batches])
+                t0 = time.perf_counter()
+                wc.flush()
+                fence(crdt)
+                if i >= 2:                # first two warm the jit
+                    times.append(time.perf_counter() - t0)
+        return times
+
+    def floor_ms(crdt):
+        # What merely RUNNING a trivial program over this store costs
+        # (benchmarks/sharded_scale.py's dispatch-floor probe) — the
+        # irreducible per-dispatch overhead under the flush number.
+        @jax.jit
+        def _touch(store):
+            return type(store)(*((ln if ln.dtype == bool else ln + 0)
+                                 for ln in store))
+        st = crdt._store
+        jax.block_until_ready(_touch(st))
+        best = float("inf")
+        for _ in range(max(4, repeats // 2)):
+            t0 = time.perf_counter()
+            jax.block_until_ready(_touch(st))
+            best = min(best, time.perf_counter() - t0)
+        return best * 1e3
+
+    single = DenseCrdt("i", n_slots=n_slots)
+    hist = flush_hist(single)
+    single_floor = floor_ms(single)
+
+    # --- the same flush on a sharded store (largest mesh that fits) ---
+    d = jax.device_count()
+    if d >= 8:
+        mesh = make_fanin_mesh(2, 4)
+    else:
+        ks = 1
+        while ks * 2 <= d and n_slots % (ks * 2) == 0:
+            ks *= 2
+        mesh = make_fanin_mesh(1, ks)
+    sharded = ShardedDenseCrdt("i", n_slots, mesh)
+    sh_hist = flush_hist(sharded)
+    sh_floor = floor_ms(sharded)
+
+    def ms(xs):
+        xs = sorted(xs)
+        return {"min": round(xs[0] * 1e3, 3),
+                "p50": round(med(xs) * 1e3, 3),
+                "p90": round(xs[int(0.9 * (len(xs) - 1))] * 1e3, 3),
+                "max": round(xs[-1] * 1e3, 3)}
+
+    sh_min_ms = min(sh_hist) * 1e3
+    return {
+        "metric": "ingest_fast_lane", "unit": "puts/s",
+        "n_slots": n_slots, "rows_per_batch": rows, "batches": batches,
+        "platform": platform,
+        "unbatched_puts_per_sec": round(total / unbatched_s, 1),
+        "staged_puts_per_sec": round(total / staged_s, 1),
+        "staged_speedup": round(unbatched_s / staged_s, 3),
+        "staged_flushes": flushes,
+        "flush_ms": ms(hist),
+        "single_dispatch_floor_ms": round(single_floor, 3),
+        "sharded": {
+            "mesh": f"(replica={mesh.shape['replica']}, "
+                    f"key={mesh.shape['key']})",
+            "flush_1024_ms": round(sh_min_ms, 3),
+            "flush_hist_ms": ms(sh_hist),
+            "dispatch_floor_ms": round(sh_floor, 3),
+            "flush_over_floor_ms": round(sh_min_ms - sh_floor, 3),
+            "baseline_put_batch_1024_ms": {"sharded": 4.81,
+                                           "single_device": 1.73},
+            "baseline_dispatch_floor_ms": {"sharded": 2.132,
+                                           "single_device": 0.856},
+            "vs_sharded_put_batch_baseline": round(sh_min_ms / 4.81, 3),
+        },
+    }
+
+
 def result_dict(metric: str, merges: int, secs: float,
                 path: str = None, platform: str = None) -> dict:
     """The one-line JSON contract shared by bench.py and the suite.
@@ -604,7 +737,7 @@ def main() -> None:
                     help="chained timed runs (one readback at the end)")
     ap.add_argument("--mode",
                     choices=("stream", "distinct", "e2e", "e2e-kernel",
-                             "sync"),
+                             "sync", "ingest"),
                     default="stream",
                     help="stream: write-stream replay (chunk replayed "
                          "with +1ms offsets); distinct: HBM-resident "
@@ -614,7 +747,11 @@ def main() -> None:
                          "against the raw kernel; sync: two-replica "
                          "gossip over loopback sockets — pooled vs "
                          "fresh-connect latency, delta bytes, "
-                         "compression ratio, pack-cache hits")
+                         "compression ratio, pack-cache hits; ingest: "
+                         "write-combiner fast lane — staged vs "
+                         "unbatched puts/sec, flush latency histogram, "
+                         "sharded flush vs the pre-combiner put_batch "
+                         "baseline")
     ap.add_argument("--rows", type=int, default=128,
                     help="distinct mode: replica rows resident in HBM")
     ap.add_argument("--loops", type=int, default=48,
@@ -632,7 +769,13 @@ def main() -> None:
     n_replicas = args.replicas or n_replicas
     chunk = args.chunk or chunk
 
-    if args.mode == "sync":
+    if args.mode == "ingest":
+        result = bench_ingest(
+            n_slots=1 << 10 if args.smoke else 1 << 14,
+            rows=128 if args.smoke else 1024,
+            batches=4 if args.smoke else 64,
+            repeats=4 if args.smoke else 24)
+    elif args.mode == "sync":
         result = bench_sync(
             n_slots=1 << 10 if args.smoke else 1 << 14,
             k=32 if args.smoke else 256,

@@ -25,6 +25,13 @@ reproduced bugs):
 - ``donated-buffer-reuse`` — reusing a store buffer after passing it
   to a scatter wrapper with ``donate=True``; the donated buffer is
   aliased and its contents are undefined after the call.
+- ``scatter-combiner-bypass`` — calling a store scatter wrapper
+  (``put_scatter``/``record_scatter``/``delete_scatter``/
+  ``ingest_scatter``) in a function with no visible ingest gate (no
+  ``drain_ingest`` call and no ``_ingest`` check before the call); a
+  staged ``ingest()`` window would commit its backlog AFTER such a
+  write, stamping over it out of HLC order. The combiner's own flush
+  is the one sanctioned direct writer (reasoned suppression).
 
 The linter is purely lexical/AST — no imports of the linted code — so
 it runs on broken or unimportable files (the self-test fixtures).
@@ -53,6 +60,7 @@ RULES = (
     "record-mutation",
     "add-batch-unique-keys",
     "donated-buffer-reuse",
+    "scatter-combiner-bypass",
     "suppression-without-reason",
 )
 
@@ -63,7 +71,12 @@ _WALL_CALLS = {
     "datetime.datetime.now", "datetime.datetime.utcnow",
 }
 _HLC_ATTRS = {"hlc", "canonical_time", "_canonical_time", "logical_time"}
-_DONATING_WRAPPERS = {"put_scatter", "record_scatter", "delete_scatter"}
+_DONATING_WRAPPERS = {"put_scatter", "record_scatter", "delete_scatter",
+                      "ingest_scatter"}
+_COMBINER_SCATTERS = _DONATING_WRAPPERS
+# Lexical evidence that a function respects the write-combiner barrier:
+# it drains the window, or it branches on the staging handle.
+_COMBINER_GATES = {"drain_ingest", "_ingest"}
 
 
 def _dotted(node: ast.AST) -> Optional[str]:
@@ -382,6 +395,44 @@ def _check_donated_reuse(tree: ast.AST, path: str) -> List[Finding]:
     return out
 
 
+# --- rule: scatter-combiner-bypass ---
+
+def _check_combiner_bypass(tree: ast.AST, path: str) -> List[Finding]:
+    out: List[Finding] = []
+    for fn in _functions(tree):
+        if fn.name in _COMBINER_SCATTERS:
+            # The public wrappers are definitionally below the barrier.
+            # The combiner's own flush is NOT exempted by name — it
+            # carries a reasoned suppression at its call site instead.
+            continue
+        gates: List[int] = []
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Attribute) \
+                    and node.attr in _COMBINER_GATES:
+                gates.append(node.lineno)
+            elif isinstance(node, ast.Name) and node.id in _COMBINER_GATES:
+                gates.append(node.lineno)
+        for node in ast.walk(fn):
+            if not isinstance(node, ast.Call):
+                continue
+            d = _dotted(node.func)
+            if d is None or d.rsplit(".", 1)[-1] not in _COMBINER_SCATTERS:
+                continue
+            if any(g <= node.lineno for g in gates):
+                continue
+            out.append(Finding(
+                rule="scatter-combiner-bypass", path=path,
+                line=node.lineno,
+                message=f"{d}(...) writes the store with no visible "
+                        "ingest gate (no drain_ingest call or _ingest "
+                        "check earlier in this function); a staged "
+                        "ingest() window would commit its backlog AFTER "
+                        "this write and stamp over it out of HLC order "
+                        "— drain first (suppress only for the "
+                        "combiner's own flush)"))
+    return out
+
+
 _ALL_CHECKS = (
     _check_sockets,
     _check_lock_discipline,
@@ -389,6 +440,7 @@ _ALL_CHECKS = (
     _check_record_mutation,
     _check_add_batch,
     _check_donated_reuse,
+    _check_combiner_bypass,
 )
 
 

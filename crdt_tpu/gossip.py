@@ -428,6 +428,14 @@ class GossipNode:
             for name in fast:
                 p = peers[name]
                 with self.server.lock:
+                    # Drain any ingest-window backlog BEFORE reading
+                    # the watermark: pack_since drains internally, but
+                    # that flush advances the canonical AFTER a
+                    # watermark read here — the stale watermark would
+                    # re-send every flushed row next round.
+                    drain = getattr(self.crdt, "drain_ingest", None)
+                    if drain is not None:
+                        drain()
                     watermark = self.crdt.canonical_time
                     packed, ids = self.crdt.pack_since(p.watermark)
                 # The worker is still (possibly) mid-round on the

@@ -26,7 +26,8 @@ import re
 import secrets
 import time
 from datetime import datetime, timezone
-from typing import Any, Callable, Generic, Optional, TypeVar
+from typing import (Any, Callable, Generic, List, Optional, Tuple,
+                    TypeVar)
 
 T = TypeVar("T")
 
@@ -134,7 +135,10 @@ def _parse_iso_millis(s: str) -> int:
             days = _days_from_civil(y, mo, d)
             return ((days * 86400 + h * 3600 + mi * 60 + sec) * 1000
                     + ms)
-    dt = datetime.fromisoformat(s.strip().replace(" ", "T"))
+    iso = s.strip().replace(" ", "T")
+    if iso.endswith(("Z", "z")):   # fromisoformat grew 'Z' in py3.11
+        iso = iso[:-1] + "+00:00"
+    dt = datetime.fromisoformat(iso)
     if dt.tzinfo is None:
         dt = dt.replace(tzinfo=timezone.utc)
     delta = dt - _EPOCH
@@ -289,6 +293,41 @@ class Hlc(Generic[T]):
             raise OverflowException(counter_new)
 
         return cls(millis_new, counter_new, canonical.node_id)
+
+    @classmethod
+    def send_batch(cls, canonical: "Hlc[T]", count: int,
+                   millis: Optional[int] = None
+                   ) -> Tuple["Hlc[T]", List[int]]:
+        """``count`` successive ``send`` stamps from ONE wall read —
+        the write-combiner flush stamp (docs/INGEST.md).
+
+        Equivalent to ``count`` sequential :meth:`send` calls under a
+        frozen wall clock: every stamp shares
+        ``max(canonical.millis, millis)`` and the counters run
+        consecutively, so the stamps are strictly monotonic in batch
+        order and each later stamp dominates every earlier one.
+        Raises the same exceptions ``send`` would — drift before the
+        first stamp, overflow when the counter run would pass 16 bits
+        (nothing is stamped on either raise).
+
+        Returns ``(new_canonical, logical_times)`` with
+        ``new_canonical == from_logical_time(logical_times[-1], ...)``.
+        """
+        if count <= 0:
+            raise ValueError(f"send_batch needs count >= 1; got {count}")
+        if millis is None:
+            millis = wall_clock_millis()
+        millis_new = max(canonical.millis, millis)
+        start = canonical.counter + 1 if canonical.millis == millis_new \
+            else 0
+        if millis_new - millis > MAX_DRIFT:
+            raise ClockDriftException(millis_new, millis)
+        if start + count - 1 > MAX_COUNTER:
+            raise OverflowException(start + count - 1)
+        base = millis_new << SHIFT
+        lts = [base + c for c in range(start, start + count)]
+        return (cls(millis_new, start + count - 1, canonical.node_id),
+                lts)
 
     @classmethod
     def recv(cls, canonical: "Hlc[T]", remote: "Hlc",

@@ -175,6 +175,8 @@ class DenseCrdt:
                                            node=str(node_id))
         self._hub = ChangeHub()
         self._pipe: Optional[_PipeState] = None
+        # Active ingest() write combiner, or None (models/ingest.py).
+        self._ingest = None
         self._pending_val_overflow = None
         self.refresh_canonical_time()
 
@@ -213,10 +215,12 @@ class DenseCrdt:
         escaped, which disables buffer donation on subsequent
         `put_batch`/`delete_batch` calls until the store is next
         replaced — a snapshot you hold stays readable."""
+        self.drain_ingest()
         self._store_escaped = True
         return self._store
 
     def refresh_canonical_time(self) -> None:
+        self.drain_ingest()
         self._canonical_time = Hlc.from_logical_time(
             int(dense_max_logical_time(self._store)), self._node_id)
 
@@ -280,6 +284,10 @@ class DenseCrdt:
         LANDED when the flush raises (optimistic application)."""
         if self._pipe is not None:
             raise RuntimeError("pipelined() windows do not nest")
+        # A pipelined window threads the canonical as a device scalar
+        # seeded HERE; staged ingest rows would otherwise commit with
+        # stamps the window never sees — barrier first.
+        self.drain_ingest()
         import sys as _sys
         self._pipe = _PipeState(self._canonical_time.logical_time,
                                 exact=exact_guards)
@@ -353,6 +361,74 @@ class DenseCrdt:
                     if bool(overflow) or bool(drift):
                         _coarse_report(include_recv=False)
 
+    # --- ingest fast lane (models/ingest.py, docs/INGEST.md) ---
+
+    @contextmanager
+    def ingest(self, auto_flush_rows: int = 1 << 16):
+        """Write-combining window: inside it, ``put_batch`` /
+        ``delete_batch`` (and everything routed through them —
+        `KeyedDenseCrdt.put`, ``clear``) stage into host-side columnar
+        buffers instead of dispatching a scatter per call. Staged rows
+        commit as ONE fused device program (`ops.dense.ingest_scatter`)
+        stamped by ONE vectorized `Hlc.send_batch` — each staged call
+        keeps its own strictly-later HLC, so per-record LWW order is
+        exactly the unbatched outcome. Commits are non-blocking
+        (double-buffered: the host stages the next backlog while the
+        previous flush executes on device).
+
+        Flush triggers: the backlog reaching ``auto_flush_rows``; any
+        merge/pack/serialization/snapshot barrier (`drain_ingest`);
+        an explicit ``wc.flush()``; window exit.
+
+        Visibility: point reads (``get`` / ``contains_slot`` /
+        ``is_deleted``) and ``count_modified_since`` answer from the
+        staging overlay — read-your-writes without a flush. Every
+        other surface drains first, so nothing outside the window can
+        observe a store missing staged rows. Change events fire at
+        COMMIT with the winning post-dedup value per slot.
+
+        Semantic differences from unbatched writes, stated plainly:
+        staged calls share one flush-time wall read (one `send_batch`
+        counter run) instead of one wall read per call, so injected
+        clocks tick differently — which is why the combiner is opt-in
+        rather than always-on. Refused inside ``pipelined()`` windows
+        (local writes need the host clock there too); opening a
+        pipelined window inside an ingest window drains first.
+
+        Yields the `WriteCombiner` (exposes ``pending_rows``,
+        ``flush()``, ``flushes``/``rows_committed`` counters)."""
+        self._refuse_in_pipeline("ingest")
+        if self._ingest is not None:
+            raise RuntimeError("ingest() windows do not nest")
+        from .ingest import WriteCombiner
+        import sys as _sys
+        wc = WriteCombiner(self, auto_flush_rows=auto_flush_rows)
+        self._ingest = wc
+        try:
+            yield wc
+        finally:
+            try:
+                wc.flush("exit")
+            except Exception:
+                # Never shadow the exception that interrupted the
+                # window body (same contract as pipelined()); with no
+                # in-flight error the flush failure IS the error.
+                if _sys.exc_info()[0] is None:
+                    raise
+            finally:
+                self._ingest = None
+
+    def drain_ingest(self) -> bool:
+        """Commit any staged ingest-window writes NOW. No-op outside a
+        window (returns False). Every merge / pack / serialization /
+        checkpoint / bulk-read surface calls this first — the barrier
+        that keeps staged rows invisible only to the point reads the
+        overlay answers."""
+        ing = self._ingest
+        if ing is None:
+            return False
+        return ing.flush("barrier")
+
     # --- local ops: one send per batch (crdt.dart:39-54) ---
 
     def _write_sharding(self):
@@ -399,6 +475,18 @@ class DenseCrdt:
         slots = np.asarray(slots, np.int32)
         self._check_slots(slots)
         self._check_value_width(values)
+        if self._ingest is not None:
+            # Validation above ran eagerly (staging must fail at the
+            # call site, like the unbatched path); the rows themselves
+            # wait for the flush stamp + fused commit.
+            self._ingest.stage(
+                slots.astype(np.int64),
+                np.ascontiguousarray(np.broadcast_to(
+                    np.asarray(values, np.int64), slots.shape)),
+                None if tombs is None else np.ascontiguousarray(
+                    np.broadcast_to(np.asarray(tombs, bool),
+                                    slots.shape)))
+            return
         slots = jnp.asarray(slots)
         values = jnp.asarray(values, jnp.int64)
         tombs_h = None if tombs is None else np.asarray(tombs, bool)
@@ -423,6 +511,11 @@ class DenseCrdt:
         self._refuse_in_pipeline("delete_batch")
         slots = np.asarray(slots, np.int32)
         self._check_slots(slots)
+        if self._ingest is not None:
+            self._ingest.stage(slots.astype(np.int64),
+                               np.zeros(slots.shape[0], np.int64),
+                               np.ones(slots.shape[0], bool))
+            return
         slots = jnp.asarray(slots)
         self._canonical_time = Hlc.send(self._canonical_time,
                                         millis=self._wall_clock())
@@ -440,6 +533,7 @@ class DenseCrdt:
 
     @property
     def live_mask(self) -> jax.Array:
+        self.drain_ingest()
         return self._store.occupied & ~self._store.tomb
 
     @property
@@ -447,6 +541,7 @@ class DenseCrdt:
         """int64[n_slots]; only positions with ``live_mask`` are live.
         Hands out the live lane, so (like ``store``) it marks the
         snapshot escaped — later writes won't donate its buffer."""
+        self.drain_ingest()
         self._store_escaped = True
         return self._store.val
 
@@ -459,6 +554,13 @@ class DenseCrdt:
 
     def get(self, slot: int) -> Optional[int]:
         self._check_slot(slot)
+        if self._ingest is not None:
+            # Read-your-writes overlay: a staged row answers from host
+            # memory — the later flush stamp beats anything the store
+            # holds for the slot, so this IS the post-commit answer.
+            staged, v = self._ingest.pending_value(slot)
+            if staged:
+                return v
         # One batched fetch: three sequential scalar reads pay three
         # full round trips on remote-proxied backends.
         occ, tomb, val = jax.device_get(
@@ -473,6 +575,9 @@ class DenseCrdt:
         bulk shape; a 1M-slot replica must answer a point read in
         O(1))."""
         self._check_slot(slot)
+        # Records carry stamps, which staged rows only get at flush —
+        # drain rather than synthesize an overlay answer.
+        self.drain_ingest()
         occ, lt, node, val, mod_lt, mod_node, tomb = jax.device_get(
             (self._store.occupied[slot], self._store.lt[slot],
              self._store.node[slot], self._store.val[slot],
@@ -493,12 +598,19 @@ class DenseCrdt:
         """True if the slot holds a record, live OR tombstoned
         (containsKey semantics, crdt.dart:141)."""
         self._check_slot(slot)
+        if self._ingest is not None \
+                and self._ingest.pending_value(slot)[0]:
+            return True
         return bool(self._store.occupied[slot])
 
     def is_deleted(self, slot: int) -> Optional[bool]:
         """None for never-written slots, else the tombstone flag
         (crdt.dart:61-64)."""
         self._check_slot(slot)
+        if self._ingest is not None:
+            staged, v = self._ingest.pending_value(slot)
+            if staged:
+                return v is None
         if not bool(self._store.occupied[slot]):
             return None
         return bool(self._store.tomb[slot])
@@ -515,6 +627,7 @@ class DenseCrdt:
     def purge(self) -> None:
         """Physically drop all records (crdt.dart:168-169). The
         canonical clock and node table are untouched."""
+        self.drain_ingest()
         self._store = empty_dense_store(self.n_slots)
 
     def grow(self, n_slots: int) -> None:
@@ -544,6 +657,7 @@ class DenseCrdt:
                     f"{TILE} == 0; got {n_slots}")
         if n_slots == self.n_slots:
             return
+        self.drain_ingest()
         pad = empty_dense_store(n_slots - self.n_slots)
         self._store = DenseStore(*(
             jnp.concatenate([lane, pad_lane])
@@ -664,6 +778,9 @@ class DenseCrdt:
         `Crdt` storage slots through `KeyedDenseCrdt`."""
         if not record_map:
             return
+        # Verbatim stamps must not interleave with a pending flush's
+        # send_batch stamps — barrier before the raw scatter.
+        self.drain_ingest()
         k = len(record_map)
         slots = np.fromiter(record_map.keys(), np.int64, count=k)
         self._check_slots(slots)
@@ -719,9 +836,18 @@ class DenseCrdt:
                              ) -> int:
         """Delta-backlog size for lag monitoring: occupied slots with
         ``mod_lt >= modified_since`` (tombstones included). One masked
-        sum on device, one scalar fetch — never materializes records."""
-        return int(jax.device_get(
-            jnp.sum(self._delta_mask(modified_since))))
+        sum on device, one scalar fetch — never materializes records.
+
+        Inside an ingest window, staged rows count too (their flush
+        stamp is at-or-after the canonical head, so they are modified
+        under any watermark bound) — lag monitors see the backlog
+        without forcing a flush."""
+        mask = self._delta_mask(modified_since)
+        ing = self._ingest
+        if ing is not None and ing.pending_rows:
+            mask = mask.at[jnp.asarray(
+                ing.pending_slot_array())].set(True)
+        return int(jax.device_get(jnp.sum(mask)))
 
     def record_map(self, modified_since: Optional[Hlc] = None
                    ) -> Dict[int, Record]:
@@ -731,6 +857,7 @@ class DenseCrdt:
         transfer; decode is vectorized (numpy unpack + object-array
         node gather), with per-record work reduced to the raw
         ``Hlc``/``Record`` allocations."""
+        self.drain_ingest()
         mask = self._delta_mask(modified_since)
         # One batched fetch (async prefetch per leaf) instead of seven
         # sequential device->host round trips.
@@ -769,6 +896,7 @@ class DenseCrdt:
         values) — byte-identical to the generic encoder but without
         materializing a Record dict (a 1M-slot export runs in seconds,
         benchmarks/suite.py `dense_to_json`)."""
+        self.drain_ingest()
         if key_encoder is None and value_encoder is None:
             fast = self._to_json_fast(modified_since)
             if fast is not None:
@@ -836,6 +964,7 @@ class DenseCrdt:
         changeset join is property-tested
         (tests/test_dense_crdt.py::TestSparseWireDelta)."""
         self._refuse_in_pipeline("merge_records")  # host recv fold
+        self.drain_ingest()
         if not record_map:
             self.merge_many([])
             return
@@ -863,6 +992,7 @@ class DenseCrdt:
         same decode shape `TpuMapCrdt`/`SqliteCrdt` ingest through).
         Keys decode to int slots by default."""
         self._refuse_in_pipeline("merge_json")  # host recv fold
+        self.drain_ingest()
         # Tick parity with the generic Crdt.merge_json: the decode-time
         # `modified` stamp consumes one wall read there
         # (Crdt._decode_wall_millis contract) — a merge immediately
@@ -1135,6 +1265,7 @@ class DenseCrdt:
     def save(self, path: str) -> None:
         """Columnar snapshot INCLUDING the node-id table the ordinal
         lanes index into (`crdt_tpu.checkpoint.save_dense`)."""
+        self.drain_ingest()
         from ..checkpoint import save_dense
         save_dense(self._store, path,
                    node_ids=self._table.ids())
@@ -1166,6 +1297,7 @@ class DenseCrdt:
         """Outbound changeset: full state, or records with
         ``modified >= since`` (inclusive, map_crdt.dart:44-45), plus the
         node-id list its ordinals index into."""
+        self.drain_ingest()
         since_lt = None if since is None else jnp.int64(since.logical_time)
         # store_to_changeset reshapes lanes; whether jax aliases the
         # underlying buffers is backend-dependent, so treat the export
@@ -1380,6 +1512,7 @@ class DenseCrdt:
         """N-replica fan-in: concatenate peer changesets along the
         replica axis (earlier entries win identical-HLC ties, the
         sequential-merge order) and run ONE fused lattice join."""
+        self.drain_ingest()
         self.stats.merges += 1
         if not changesets:
             # Merging nothing still consumes the absorption-phase wall
@@ -1630,6 +1763,7 @@ class DenseCrdt:
         from ..ops.pallas_merge import (_cs_shape, model_fanin_split,
                                         pad_split_rows,
                                         split_guard_lanes, split_to_wide)
+        self.drain_ingest()
         r, n = _cs_shape(scs)
         if n != self.n_slots:
             raise ValueError(
@@ -1744,6 +1878,10 @@ class DenseCrdt:
         merges may still donate)."""
         from ..obs.registry import default_registry
         from ..obs.trace import span
+        # Drain BEFORE the cache key reads the canonical: a flush
+        # advances the clock AND replaces the store, so a key built
+        # first would alias a pre-flush pack under a stale watermark.
+        self.drain_ingest()
         key = (None if since is None else since.logical_time,
                self._canonical_time.logical_time)
         counter = default_registry().counter(
@@ -1786,6 +1924,7 @@ class DenseCrdt:
         last-wins (`_last_wins_keep`), the same contract every other
         columnar ingest path honors. Cost is O(k) in the delta."""
         self._refuse_in_pipeline("merge_packed")  # host recv fold
+        self.drain_ingest()
         slots = np.asarray(packed.slots)
         lt = np.asarray(packed.lt, np.int64)
         ni = np.asarray(packed.node)
@@ -1963,13 +2102,17 @@ class ShardedDenseCrdt(DenseCrdt):
     def put_batch(self, slots, values, tombs=None) -> None:
         # The scatter's output is constrained to the store sharding
         # inside the jit (_write_sharding); the _shard() call is then
-        # a no-copy identity device_put kept as a safety net.
+        # a no-copy identity device_put kept as a safety net. A staged
+        # call touched no device state at all — the combiner's flush
+        # re-shards through _postprocess_store instead.
         super().put_batch(slots, values, tombs=tombs)
-        self._store = self._shard(self._store)
+        if self._ingest is None:
+            self._store = self._shard(self._store)
 
     def delete_batch(self, slots) -> None:
         super().delete_batch(slots)
-        self._store = self._shard(self._store)
+        if self._ingest is None:
+            self._store = self._shard(self._store)
 
     def purge(self) -> None:
         super().purge()
@@ -1989,6 +2132,15 @@ class ShardedDenseCrdt(DenseCrdt):
                     f"executor={self._executor!r} needs each of the "
                     f"{k} key shards a multiple of {TILE}; got "
                     f"n_slots={n_slots}")
+        if n_slots != self.n_slots:
+            # jnp.concatenate on a key-sharded lane of this 2D mesh
+            # folds the replicated 'replica' axis into a partial sum
+            # (values double per replica) on current jax CPU meshes.
+            # Pull the lanes off the mesh first; the base concat then
+            # runs unsharded and _shard pins the grown layout back on.
+            self.drain_ingest()
+            self._store = DenseStore(
+                *(jnp.asarray(np.asarray(lane)) for lane in self._store))
         super().grow(n_slots)
         self._store = self._shard(self._store)
 

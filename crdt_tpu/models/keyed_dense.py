@@ -84,6 +84,20 @@ class KeyedDenseCrdt(Crdt[K, int]):
     def refresh_canonical_time(self) -> None:
         self._dense.refresh_canonical_time()
 
+    # --- ingest fast lane: keyed surface over the dense combiner ---
+
+    def ingest(self, auto_flush_rows: int = 1 << 16):
+        """The wrapped model's write-combining window
+        (`DenseCrdt.ingest`): ``put``/``put_all``/``delete`` issued
+        inside it stage host-side and commit as one fused dispatch.
+        Keyed reads stay read-your-writes through the dense overlay
+        (``get``/``contains_key`` route to slot point reads)."""
+        return self._dense.ingest(auto_flush_rows=auto_flush_rows)
+
+    def drain_ingest(self) -> bool:
+        """Barrier passthrough (`DenseCrdt.drain_ingest`)."""
+        return self._dense.drain_ingest()
+
     # --- key interning ---
 
     def _intern(self, key: K) -> int:
@@ -135,6 +149,19 @@ class KeyedDenseCrdt(Crdt[K, int]):
     def contains_key(self, key: K) -> bool:
         slot = self._key_to_slot.get(key)
         return slot is not None and self._dense.contains_slot(slot)
+
+    def get(self, key: K) -> Optional[int]:
+        # Route to the dense POINT read, not Crdt.get's get_record
+        # path: one batched scalar fetch instead of a 7-lane record
+        # decode, and inside an ingest() window the staging overlay
+        # answers without forcing a flush (get_slot_record drains —
+        # records need the stamps only the flush assigns).
+        slot = self._key_to_slot.get(key)
+        return None if slot is None else self._dense.get(slot)
+
+    def is_deleted(self, key: K) -> Optional[bool]:
+        slot = self._key_to_slot.get(key)
+        return None if slot is None else self._dense.is_deleted(slot)
 
     def get_record(self, key: K) -> Optional[Record]:
         slot = self._key_to_slot.get(key)

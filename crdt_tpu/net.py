@@ -1094,6 +1094,14 @@ def sync_packed_over_conn(crdt, conn: PeerConnection,
         watermark, packed, ids = _prepacked
     else:
         with lock:
+            # Commit any staged ingest-window writes BEFORE reading
+            # the watermark: pack_since drains too, but its flush
+            # advances the canonical after a watermark read here,
+            # and a stale watermark re-sends every flushed row on
+            # the next round.
+            drain = getattr(crdt, "drain_ingest", None)
+            if drain is not None:
+                drain()
             watermark = crdt.canonical_time
             packed, ids = crdt.pack_since(since)
     import time as _time

@@ -9,7 +9,8 @@ events) with an optional JSONL sink. Events are plain dicts:
 
 - ``kind`` names the event class: ``merge`` (a merge dispatch span),
   ``gossip_round``, ``wire_frame``, ``checkpoint``, ``breaker``,
-  ``bench_phase``.
+  ``bench_phase``, ``ingest`` (a write-combiner flush span,
+  models/ingest.py — carries ``rows`` and ``trigger``).
 - ``hlc`` is the emitting replica's canonical HLC at emission — the
   cluster-orderable stamp. ``mono_s`` (``time.monotonic()``) orders
   events within one process; wall-clock reads stay where they belong

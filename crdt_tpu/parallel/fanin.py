@@ -41,10 +41,26 @@ from __future__ import annotations
 
 from typing import NamedTuple, Tuple
 
+import functools
+
 import jax
 import jax.numpy as jnp
-from jax import P
+try:                                     # jax >= 0.5 re-exports P
+    from jax import P
+except ImportError:                      # pragma: no cover
+    from jax.sharding import PartitionSpec as P
 from jax.sharding import Mesh, NamedSharding
+
+try:                                     # jax >= 0.6: top-level export
+    _shard_map = jax.shard_map
+except AttributeError:                   # pragma: no cover
+    # Older jax ships it under experimental with the replication
+    # check named check_rep (same semantics as check_vma).
+    from jax.experimental.shard_map import shard_map as _shard_map_impl
+
+    def _shard_map(f, *, mesh, in_specs, out_specs, check_vma=True):
+        return _shard_map_impl(f, mesh=mesh, in_specs=in_specs,
+                               out_specs=out_specs, check_rep=check_vma)
 
 from ..hlc import MAX_COUNTER, MAX_DRIFT, SHIFT
 from ..ops.dense import (DenseChangeset, DenseStore, reduce_replicas,
@@ -122,15 +138,23 @@ def replica_extent(mesh: Mesh) -> int:
     return extent
 
 
+@functools.lru_cache(maxsize=None)
 def store_sharding(mesh: Mesh) -> NamedSharding:
     """Store lanes: sharded over keys, replicated over the replica
-    (and slice, if present) axes."""
+    (and slice, if present) axes.
+
+    Cached per mesh (Mesh is hashable): the write fast lane asks for
+    this on EVERY commit (`DenseCrdt._write_sharding` feeds the
+    scatter jit cache key), so the precomputed NamedSharding is
+    handed back instead of re-built per flush."""
     return NamedSharding(mesh, P(KEY_AXIS))
 
 
+@functools.lru_cache(maxsize=None)
 def changeset_sharding(mesh: Mesh) -> NamedSharding:
     """Changeset lanes [R, N]: replicas × keys over the full mesh (the
-    R dim spans every replica axis on a multi-slice mesh)."""
+    R dim spans every replica axis on a multi-slice mesh). Cached per
+    mesh, like `store_sharding`."""
     return NamedSharding(mesh, P(_replica_axes(mesh), KEY_AXIS))
 
 
@@ -220,7 +244,11 @@ def _flat_rank(replica_axes: tuple) -> jax.Array:
     flat rank is the earliest replica row (sequential-merge parity)."""
     rank = jax.lax.axis_index(replica_axes[0])
     for a in replica_axes[1:]:
-        rank = rank * jax.lax.axis_size(a) + jax.lax.axis_index(a)
+        # psum(1, axis) is the portable axis size (jax.lax.axis_size
+        # only exists on newer jax); it folds to a constant in-trace.
+        size = (jax.lax.axis_size(a) if hasattr(jax.lax, "axis_size")
+                else jax.lax.psum(1, a))
+        rank = rank * size + jax.lax.axis_index(a)
     return rank
 
 
@@ -335,7 +363,7 @@ def make_sharded_pallas_fanin(mesh: Mesh, *, chunk_rows: int = 8,
     """
     from functools import partial
     replica_axes = _replica_axes(mesh)
-    step = jax.shard_map(
+    step = _shard_map(
         partial(_pallas_fanin_block, replica_axes, chunk_rows, interpret),
         mesh=mesh,
         in_specs=(
@@ -365,7 +393,7 @@ def make_sharded_fanin(mesh: Mesh):
     """
     from functools import partial
     replica_axes = _replica_axes(mesh)
-    step = jax.shard_map(
+    step = _shard_map(
         partial(_fanin_block, replica_axes),
         mesh=mesh,
         in_specs=(
@@ -392,7 +420,7 @@ def sharded_delta_mask(mesh: Mesh):
     def _mask(store: DenseStore, since_lt: jax.Array) -> jax.Array:
         return store.occupied & (store.mod_lt >= since_lt)
 
-    return jax.jit(jax.shard_map(
+    return jax.jit(_shard_map(
         _mask, mesh=mesh,
         in_specs=(DenseStore(*([P(KEY_AXIS)] * len(DenseStore._fields))),
                   P()),
@@ -409,7 +437,7 @@ def sharded_max_logical_time(mesh: Mesh):
         local = jnp.max(jnp.where(store.occupied, store.lt, 0))
         return jax.lax.pmax(local, mesh.axis_names)
 
-    return jax.jit(jax.shard_map(
+    return jax.jit(_shard_map(
         _max, mesh=mesh,
         in_specs=(DenseStore(*([P(KEY_AXIS)] * len(DenseStore._fields))),),
         out_specs=P(),

@@ -89,6 +89,13 @@ def sync_packed(local, remote, since=_SAME_ROUND) -> Hlc:
     `net.sync_packed_over_conn`). Empty halves (k == 0) skip the
     merge, keeping both clocks — and so both pack caches — still on
     a no-change round."""
+    # Commit any ingest-window backlog before the watermark read:
+    # pack_since drains internally, but that flush advances the
+    # canonical AFTER a watermark captured here, and the stale bound
+    # would re-send every flushed row on the next round.
+    drain = getattr(local, "drain_ingest", None)
+    if drain is not None:
+        drain()
     watermark = local.canonical_time
     # One-shot shape: FULL push (the reference pushes its whole record
     # map), pull bounded by the pre-push canonical time. With an

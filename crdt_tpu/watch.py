@@ -6,6 +6,12 @@ This is the Python equivalent: a synchronous broadcast hub with
 filterable subscriptions. Device backends emit events host-side after
 kernel writes land — reactivity never lives inside jit (SURVEY.md §7
 hard part 6).
+
+Inside a `DenseCrdt.ingest()` window (models/ingest.py), staged writes
+do NOT emit as they are staged: change events fire at COMMIT time, one
+event per distinct slot carrying the winning post-dedup value (a slot
+staged twice in one window emits once, with the last value). Ordering
+across flushes follows commit order, which is also HLC order.
 """
 
 from __future__ import annotations

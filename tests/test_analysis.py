@@ -117,6 +117,59 @@ def test_peer_connection_with_idle_timeout_not_flagged():
     assert "socket-no-timeout" not in rules
 
 
+def test_combiner_bypass_flagged_without_gate():
+    src = (
+        "def commit(self, slots, vals, t, me):\n"
+        "    self._store = put_scatter(self._store, slots, vals, t, me)\n")
+    findings = [f for f in lint_source(src, "snippet.py")
+                if f.rule == "scatter-combiner-bypass"]
+    assert len(findings) == 1
+    assert "drain" in findings[0].message
+
+
+def test_combiner_bypass_gate_must_precede_the_write():
+    # Draining AFTER the scatter is the bug, not the fix: the staged
+    # backlog still commits over the direct write.
+    src = (
+        "def commit(self, slots, vals, t, me):\n"
+        "    self._store = delete_scatter(self._store, slots, t, me)\n"
+        "    self.drain_ingest()\n")
+    rules = [f.rule for f in lint_source(src, "snippet.py")]
+    assert "scatter-combiner-bypass" in rules
+
+
+def test_combiner_bypass_drain_gate_passes():
+    src = (
+        "def put_slot_records(self, recs):\n"
+        "    self.drain_ingest()\n"
+        "    self._store = record_scatter(self._store, recs)\n")
+    rules = {f.rule for f in lint_source(src, "snippet.py")}
+    assert "scatter-combiner-bypass" not in rules
+
+
+def test_combiner_bypass_staging_branch_passes():
+    # put_batch's shape: branch on the staging handle, fall through to
+    # the direct scatter only when no window is open.
+    src = (
+        "def put_batch(self, slots, vals, t, me):\n"
+        "    if self._ingest is not None:\n"
+        "        return self._ingest.stage(slots, vals, None)\n"
+        "    self._store = put_scatter(self._store, slots, vals, t, me)\n")
+    rules = {f.rule for f in lint_source(src, "snippet.py")}
+    assert "scatter-combiner-bypass" not in rules
+
+
+def test_combiner_bypass_suppressible_with_reason():
+    src = (
+        "def flush(self, owner):\n"
+        "    # crdtlint: disable=scatter-combiner-bypass -- the flush"
+        " IS the barrier\n"
+        "    owner._store = ingest_scatter(owner._store, s, lt, v)\n")
+    rules = {f.rule for f in lint_source(src, "snippet.py")}
+    assert "scatter-combiner-bypass" not in rules
+    assert "suppression-without-reason" not in rules
+
+
 def test_shipped_tree_lints_clean():
     from crdt_tpu.analysis.host_lint import lint_package
     import crdt_tpu

@@ -21,6 +21,10 @@ def test_example_runs(name):
     env = dict(os.environ)
     # examples run on the CPU path in CI, like the rest of the tests
     env.setdefault("JAX_PLATFORMS", "cpu")
+    # the examples import the in-tree package; don't require an
+    # editable install for the subprocess to find it
+    env["PYTHONPATH"] = os.pathsep.join(
+        p for p in (ROOT, env.get("PYTHONPATH")) if p)
     proc = subprocess.run(
         [sys.executable, os.path.join(ROOT, "examples", name)],
         capture_output=True, text=True, timeout=300, env=env,

@@ -193,3 +193,24 @@ class TestDuplicateWireKeys:
         oracle.merge_json(payload, key_decoder=int)
         assert dense.get(5) == oracle.get(5) == 7    # local still wins
         assert whole.events == [] and keyed.events == []
+
+    def test_surviving_duplicate_emits_winning_value(self):
+        # Positive shape of finding 2: the surviving (last) occurrence
+        # WINS over the local record — the keyed stream must report
+        # the value the store adopts (post-dedup `get` callback), not
+        # stay silent and not answer with the dropped occurrence.
+        import json
+
+        from crdt_tpu import DenseCrdt
+        dense = DenseCrdt("dd", 64, wall_clock=FakeClock(start=self.BASE))
+        dense.put_batch([5], [7])                    # local, ~BASE
+        whole = dense.watch().record()
+        keyed = dense.watch(5).record()
+        payload = json.dumps({
+            "5": {"hlc": self._hlc(5_000), "value": 111},    # dropped
+            "05": {"hlc": self._hlc(30_000), "value": 222},  # wins
+        })
+        dense.merge_json(payload)
+        assert dense.get(5) == 222
+        assert keyed.events == [(5, 222)]
+        assert whole.events == [(5, 222)]
