@@ -708,6 +708,88 @@ def bench_ingest(n_slots: int = 1 << 14, rows: int = 1024,
     }
 
 
+def bench_types(n_slots: int = 1 << 10, loops: int = 16,
+                rounds: int = 3) -> dict:
+    """Per-semantics merge throughput over the typed inbound path.
+
+    For every entry in the semantics registry (`crdt_tpu.semantics`)
+    this types a writer's whole 1024-slot store with that semantics,
+    packs the full delta once with the sem lane included, and times
+    `merge_packed` replaying it into a same-typed receiver — tag
+    validation plus the per-tag sub-semilattice join, the exact path a
+    typed sync round exercises. One JSON line with merges/s per
+    semantics, single-device and (when >= 8 devices are visible)
+    sharded over the 2x4 fan-in mesh, so regressions in any one type's
+    join kernel show up against this baseline."""
+    import numpy as np
+    from crdt_tpu.models.dense_crdt import DenseCrdt, ShardedDenseCrdt
+    from crdt_tpu.parallel import make_fanin_mesh
+    from crdt_tpu.semantics import all_semantics
+    from crdt_tpu.semantics.types import MVREG_MAX, ORSET_UNIVERSE
+
+    platform = jax.devices()[0].platform
+    slots = list(range(n_slots))
+
+    def payload(spec, slot):
+        # Type-canonical lane values, distinct per slot so the join
+        # does real work on every row.
+        if spec.name == "lww":
+            return slot % 1000
+        if spec.name == "pncounter":
+            return spec.encode(slot - n_slots // 2)
+        if spec.name == "orset":
+            return spec.encode({slot % ORSET_UNIVERSE})
+        if spec.name == "mvreg":
+            return spec.encode(1 + slot % MVREG_MAX)
+        return spec.encode(slot % 1000)   # gcounter and future types
+
+    def measure(make_receiver):
+        rates = {}
+        for spec in all_semantics():
+            w = DenseCrdt("w", n_slots=n_slots)
+            if spec.name != "lww":
+                w.set_semantics(slots, spec.name)
+            w.put_batch(slots, [payload(spec, s) for s in slots])
+            pk, ids = w.pack_since(None, sem_mode="include")
+            r = make_receiver(spec)
+            r.merge_packed(pk, ids)       # compile + first join, fenced
+            jax.block_until_ready(r._store)
+            best = None
+            for _ in range(rounds):
+                t0 = time.perf_counter()
+                for _ in range(loops):
+                    r.merge_packed(pk, ids)
+                jax.block_until_ready(r._store)
+                dt = time.perf_counter() - t0
+                best = dt if best is None else min(best, dt)
+            rates[spec.name] = round(n_slots * loops / best, 1)
+        return rates
+
+    def single(spec):
+        r = DenseCrdt("r", n_slots=n_slots)
+        if spec.name != "lww":
+            r.set_semantics(slots, spec.name)
+        return r
+
+    out = {"metric": "typed_merges_per_sec_1024_slots",
+           "unit": "merges/s", "n_slots": n_slots, "loops": loops,
+           "platform": platform,
+           "single_device": measure(single)}
+    if len(jax.devices()) >= 8:
+        mesh = make_fanin_mesh(2, 4)
+
+        def sharded(spec):
+            r = ShardedDenseCrdt("r", n_slots, mesh)
+            if spec.name != "lww":
+                r.set_semantics(slots, spec.name)
+            return r
+
+        out["sharded"] = measure(sharded)
+    else:
+        out["sharded"] = None
+    return out
+
+
 def result_dict(metric: str, merges: int, secs: float,
                 path: str = None, platform: str = None) -> dict:
     """The one-line JSON contract shared by bench.py and the suite.
@@ -737,7 +819,7 @@ def main() -> None:
                     help="chained timed runs (one readback at the end)")
     ap.add_argument("--mode",
                     choices=("stream", "distinct", "e2e", "e2e-kernel",
-                             "sync", "ingest"),
+                             "sync", "ingest", "types"),
                     default="stream",
                     help="stream: write-stream replay (chunk replayed "
                          "with +1ms offsets); distinct: HBM-resident "
@@ -751,7 +833,9 @@ def main() -> None:
                          "write-combiner fast lane — staged vs "
                          "unbatched puts/sec, flush latency histogram, "
                          "sharded flush vs the pre-combiner put_batch "
-                         "baseline")
+                         "baseline; types: per-semantics merge_packed "
+                         "replay at 1024 slots, single-device and "
+                         "sharded — the type-zoo baseline")
     ap.add_argument("--rows", type=int, default=128,
                     help="distinct mode: replica rows resident in HBM")
     ap.add_argument("--loops", type=int, default=48,
@@ -769,7 +853,11 @@ def main() -> None:
     n_replicas = args.replicas or n_replicas
     chunk = args.chunk or chunk
 
-    if args.mode == "ingest":
+    if args.mode == "types":
+        result = bench_types(n_slots=1 << 10,
+                             loops=4 if args.smoke else 16,
+                             rounds=1 if args.smoke else 3)
+    elif args.mode == "ingest":
         result = bench_ingest(
             n_slots=1 << 10 if args.smoke else 1 << 14,
             rows=128 if args.smoke else 1024,

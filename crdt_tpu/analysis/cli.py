@@ -42,6 +42,36 @@ def _load_law_fixture(path: str):
     return list(targets)
 
 
+def _registry_completeness() -> List:
+    """The semantics-registry CI gate (docs/TYPES.md): every
+    registered lane semantics must declare BOTH a law target and an
+    audit target — registering is what puts a type under CI, so a spec
+    missing either would ship an unverified kernel. One finding per
+    missing factory; the default run fails on them like any other."""
+    from .findings import Finding
+    from ..semantics import all_semantics
+    out = []
+    for spec in all_semantics():
+        where = f"semantics {spec.name!r} (tag {spec.tag})"
+        if spec.law_target is None:
+            out.append(Finding(
+                rule="semantics-missing-law-target",
+                path="crdt_tpu/semantics/types.py", line=0,
+                message=f"{where} registers no law target",
+                detail="declare law_target so the seeded semilattice "
+                       "search covers this kernel (see "
+                       "types._typed_law_target)"))
+        if spec.audit_target is None:
+            out.append(Finding(
+                rule="semantics-missing-audit-target",
+                path="crdt_tpu/semantics/types.py", line=0,
+                message=f"{where} registers no audit target",
+                detail="declare audit_target so the jaxpr audit "
+                       "covers this kernel (see "
+                       "types._typed_audit_target)"))
+    return out
+
+
 def main(argv=None) -> int:
     parser = argparse.ArgumentParser(
         prog="python -m crdt_tpu.analysis",
@@ -96,6 +126,10 @@ def main(argv=None) -> int:
             pkg_root = os.path.dirname(os.path.dirname(
                 os.path.abspath(__file__)))
             findings.extend(lint_package(pkg_root))
+        if not args.skip_laws or not args.skip_jaxpr:
+            # The registry gate guards exactly the law + jaxpr
+            # coverage surfaces, so it runs whenever either does.
+            findings.extend(_registry_completeness())
         if not args.skip_laws:
             from .lattice_laws import builtin_targets, run_laws
             findings.extend(run_laws(builtin_targets(), seeds=seeds))

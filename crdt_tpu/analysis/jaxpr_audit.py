@@ -302,6 +302,33 @@ def builtin_targets(include_sharded: bool = True) -> List[AuditTarget]:
         build=lambda: jax.make_jaxpr(dense_ops._delete_scatter(False))(
             dense_store(), i64(_M), np.int64(0), np.int32(0))))
 
+    # Typed lane kernels (crdt_tpu/semantics): the shared sparse
+    # scatter and fan-in shapes here, plus one per-tag elementwise
+    # wire-join target per registered semantics from the registry
+    # itself — a new type gets audit coverage by registering.
+    from ..semantics import audit_targets as _semantics_audit_targets
+    from ..semantics import kernels as _sem_kernels
+
+    i8 = lambda *s: np.zeros(s, np.int8)
+
+    targets.append(AuditTarget(
+        name="semantics.typed_sparse_join_step", unique_slots=True,
+        notes="dict-keyed delta cannot repeat a slot; gather "
+              "mode=fill + scatter mode=drop over typed rows",
+        build=lambda: jax.make_jaxpr(
+            _sem_kernels.typed_sparse_join_step)(
+            dense_store(), i8(_M), i32(_M), i64(_M), i32(_M),
+            i64(_M), b8(_M), b8(_M), np.int64(0), np.int32(0))))
+
+    targets.append(AuditTarget(
+        name="semantics.typed_fanin_step",
+        notes="elementwise typed fold; no scatter at all",
+        build=lambda: jax.make_jaxpr(_sem_kernels.typed_fanin_step)(
+            dense_store(), i8(_N), dense_cs(), np.int64(0),
+            np.int32(0), np.int64(0))))
+
+    targets.extend(_semantics_audit_targets())
+
     targets.append(AuditTarget(
         name="pallas.pallas_fanin_step[interpret]",
         notes="Mosaic fan-in kernel at N=TILE, traced in interpret "

@@ -335,6 +335,12 @@ def builtin_targets() -> List[LawTarget]:
         notes="R-row masked fold; all three laws, combine=row "
               "concatenation"))
 
+    # The semantics registry contributes one typed wire-join target
+    # per registered lane type (crdt_tpu/semantics/types.py) — a new
+    # type gets law coverage by registering, zero hand-listed targets.
+    from ..semantics import law_targets as _semantics_law_targets
+    targets.extend(_semantics_law_targets())
+
     return targets
 
 

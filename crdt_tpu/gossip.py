@@ -59,8 +59,9 @@ from .checkpoint import load_gossip_state, save_gossip_state
 from .crdt import Crdt
 from .hlc import Hlc
 from .net import (PeerConnection, SyncProtocolError, SyncServer,
-                  SyncTransportError, WireTally, sync_dense_over_conn,
-                  sync_over_conn, sync_packed_over_conn)
+                  SyncTransportError, WireTally, _pack_for_peer,
+                  sync_dense_over_conn, sync_over_conn,
+                  sync_packed_over_conn)
 from .obs.lag import health_status, lag_entry
 from .obs.registry import default_registry
 from .obs.trace import tracer
@@ -437,7 +438,13 @@ class GossipNode:
                     if drain is not None:
                         drain()
                     watermark = self.crdt.canonical_time
-                    packed, ids = self.crdt.pack_since(p.watermark)
+                    # The fast lane requires a live negotiated session
+                    # (checked above), so the caps are authoritative:
+                    # the sem tag lane rides iff this peer agreed to
+                    # "semantics" in its hello.
+                    packed, ids = _pack_for_peer(
+                        self.crdt, p.watermark,
+                        "semantics" in p.conn.caps)
                 # The worker is still (possibly) mid-round on the
                 # previous peer — that socket wait is what the pack
                 # above just overlapped. Collect it before

@@ -178,6 +178,14 @@ class DenseCrdt:
         # Active ingest() write combiner, or None (models/ingest.py).
         self._ingest = None
         self._pending_val_overflow = None
+        # Per-slot semantics tags (`crdt_tpu.semantics`, docs/TYPES.md):
+        # None == every slot is LWW (tag 0), the seed behavior. The
+        # version counter keys outbound pack-cache entries, so a
+        # semantics migration invalidates cached packs even when the
+        # store lanes (and thus the canonical clock) are unchanged.
+        self._sem: Optional[np.ndarray] = None
+        self._sem_dev = None
+        self._sem_version = 0
         self.refresh_canonical_time()
 
     # --- clock (crdt.dart:8-33,114-121) ---
@@ -658,6 +666,11 @@ class DenseCrdt:
         if n_slots == self.n_slots:
             return
         self.drain_ingest()
+        if self._sem is not None:
+            # New slots start as LWW (tag 0) — the untyped default.
+            self._sem = np.concatenate(
+                [self._sem, np.zeros(n_slots - self.n_slots, np.int8)])
+            self._sem_dev = None
         pad = empty_dense_store(n_slots - self.n_slots)
         self._store = DenseStore(*(
             jnp.concatenate([lane, pad_lane])
@@ -665,6 +678,213 @@ class DenseCrdt:
 
     def __len__(self) -> int:
         return int(jnp.sum(self.live_mask))
+
+    # --- per-slot semantics (crdt_tpu.semantics, docs/TYPES.md) ---
+
+    @property
+    def _has_typed(self) -> bool:
+        """Any slot carrying a non-LWW tag? (`_sem` collapses back to
+        None when a migration returns every slot to LWW, so this is a
+        plain None check — the hot paths branch on it.)"""
+        return self._sem is not None
+
+    def _sem_host(self) -> np.ndarray:
+        """The per-slot tag column as host int8 (all zeros when the
+        replica is untyped). Do not mutate — go through
+        `set_semantics`, which versions the column."""
+        if self._sem is None:
+            return np.zeros(self.n_slots, np.int8)
+        return self._sem
+
+    def _sem_device(self) -> jax.Array:
+        """Device mirror of the tag column, rebuilt lazily after each
+        migration/grow (the typed kernels take it as a plain operand,
+        so jit caches stay warm across migrations)."""
+        if self._sem_dev is None:
+            self._sem_dev = jnp.asarray(self._sem_host())
+        return self._sem_dev
+
+    def set_semantics(self, slots, semantics) -> None:
+        """Assign a registered semantics (`crdt_tpu.semantics`) to
+        slots — by spec, name, or tag. Typed slots join through the
+        per-tag sub-semilattice (`semantics.kernels`) instead of the
+        LWW winner-takes-all rule; clock lanes, watermarks and guards
+        are unchanged (the semidirect-product composition).
+
+        This is replica-local CONFIGURATION, not replicated state:
+        every peer must run the same migration before syncing typed
+        slots (the packed wire form carries tags and rejects
+        mismatches; docs/TYPES.md has the rollout recipe). Migrating a
+        slot does not rewrite its lane — migrate before first write."""
+        self._refuse_in_pipeline("set_semantics")
+        self.drain_ingest()
+        from ..semantics import SemanticsSpec, by_tag, get_semantics
+        if isinstance(semantics, SemanticsSpec):
+            spec = semantics
+        elif isinstance(semantics, str):
+            spec = get_semantics(semantics)
+        else:
+            spec = by_tag(int(semantics))
+        if spec.tag != 0:
+            if self._value_width != 64:
+                raise ValueError(
+                    "typed semantics pack state into the full int64 "
+                    "value lane; this replica was built with "
+                    "value_width=32")
+            if self._executor in ("pallas", "pallas-interpret"):
+                raise ValueError(
+                    f"typed semantics run on the XLA path; "
+                    f"executor={self._executor!r} forces the Mosaic "
+                    "kernel (use executor='auto' or 'xla')")
+        slots = np.asarray(slots, np.int32).reshape(-1)
+        self._check_slots(slots)
+        sem = (self._sem if self._sem is not None
+               else np.zeros(self.n_slots, np.int8))
+        sem[slots] = np.int8(spec.tag)
+        self._sem = sem if sem.any() else None
+        self._sem_dev = None
+        self._sem_version += 1
+        # Cached packs may hold rows under the old tags (or withhold
+        # rows that are now LWW) — the version key alone would let an
+        # in-flight entry at the same watermark survive.
+        self._pack_cache.clear()
+
+    def semantics_of(self, slot: int):
+        """The registered `SemanticsSpec` governing a slot."""
+        self._check_slot(slot)
+        from ..semantics import by_tag
+        return by_tag(0 if self._sem is None else int(self._sem[slot]))
+
+    def _lane_value(self, slot: int) -> int:
+        """Raw int64 lane at a slot, ingest-overlay aware — what a
+        typed read-modify-write builds on. Tombstones do NOT zero
+        typed lanes (deletion is the LWW action layered on top, and
+        un-deleting reveals the converged state), so this reads the
+        lane itself, not the live view."""
+        if self._ingest is not None:
+            staged, v = self._ingest.pending_value(slot)
+            if staged:
+                return 0 if v is None else int(v)
+        occ, val = jax.device_get(
+            (self._store.occupied[slot], self._store.val[slot]))
+        return int(val) if bool(occ) else 0
+
+    def _typed_spec(self, slot: int, *names):
+        self._check_slot(slot)
+        spec = self.semantics_of(slot)
+        if spec.name not in names:
+            raise TypeError(
+                f"slot {slot} holds {spec.name!r} semantics; this op "
+                f"needs {' / '.join(names)} (set_semantics first)")
+        return spec
+
+    def counter_add(self, slot: int, delta: int) -> int:
+        """Add ``delta`` to a counter slot and return the new decoded
+        value. ``gcounter`` slots refuse negative deltas; ``pncounter``
+        slots credit the pos/neg half. Works inside ``ingest()``
+        windows (the staged overlay makes consecutive adds
+        accumulate). The dense counter contract: ONE writer per slot —
+        the merge join is per-lane max, so concurrent writers on one
+        slot lose increments; give each replica its own slot and sum
+        (docs/TYPES.md, examples/counter_example.py)."""
+        spec = self._typed_spec(slot, "gcounter", "pncounter")
+        delta = int(delta)
+        lane = self._lane_value(slot)
+        if spec.name == "gcounter":
+            if delta < 0:
+                raise ValueError(
+                    "gcounter is grow-only; use pncounter semantics "
+                    "for decrements")
+            lane = lane + delta
+            if lane >= 1 << 63:
+                raise OverflowError("gcounter lane overflow")
+        else:
+            from ..semantics.kernels import _PN_HALF
+            pos = (lane >> 32) & _PN_HALF
+            neg = lane & _PN_HALF
+            if delta >= 0:
+                pos += delta
+            else:
+                neg -= delta
+            if pos > _PN_HALF or neg > _PN_HALF:
+                raise OverflowError(
+                    "pncounter half overflow (31 bits per direction)")
+            lane = (pos << 32) | neg
+        self.put_batch([slot], [lane])
+        return int(spec.decode(lane))
+
+    def counter_value(self, slot: int) -> int:
+        """Decoded counter value at a slot (pos − neg for pncounter)."""
+        spec = self._typed_spec(slot, "gcounter", "pncounter")
+        return int(spec.decode(self._lane_value(slot)))
+
+    def orset_add(self, slot: int, element: int) -> frozenset:
+        """Add an element (``[0, ORSET_UNIVERSE)``) to an OR-set slot:
+        bump its causal length even→odd. Adding a present element is a
+        no-op (no new write, no clock tick). Returns the updated
+        membership."""
+        spec = self._typed_spec(slot, "orset")
+        from ..semantics import ORSET_MAX_LEN, ORSET_UNIVERSE
+        e = int(element)
+        if not 0 <= e < ORSET_UNIVERSE:
+            raise ValueError(
+                f"orset element out of universe [0, {ORSET_UNIVERSE}): "
+                f"{e}")
+        lane = self._lane_value(slot)
+        n = (lane >> (4 * e)) & 0xF
+        if n % 2 == 1:
+            return spec.decode(lane)
+        if n >= ORSET_MAX_LEN:
+            raise OverflowError(
+                f"orset causal length saturated at {ORSET_MAX_LEN} "
+                f"for element {e} (no further add/remove cycles)")
+        lane = (lane & ~(0xF << (4 * e))) | ((n + 1) << (4 * e))
+        self.put_batch([slot], [lane])
+        return spec.decode(lane)
+
+    def orset_remove(self, slot: int, element: int) -> frozenset:
+        """Remove an element: bump its causal length odd→even.
+        Removing an absent element is a no-op. Returns the updated
+        membership."""
+        spec = self._typed_spec(slot, "orset")
+        from ..semantics import ORSET_MAX_LEN, ORSET_UNIVERSE
+        e = int(element)
+        if not 0 <= e < ORSET_UNIVERSE:
+            raise ValueError(
+                f"orset element out of universe [0, {ORSET_UNIVERSE}): "
+                f"{e}")
+        lane = self._lane_value(slot)
+        n = (lane >> (4 * e)) & 0xF
+        if n % 2 == 0:
+            return spec.decode(lane)
+        if n >= ORSET_MAX_LEN:
+            raise OverflowError(
+                f"orset causal length saturated at {ORSET_MAX_LEN} "
+                f"for element {e} (no further add/remove cycles)")
+        lane = (lane & ~(0xF << (4 * e))) | ((n + 1) << (4 * e))
+        self.put_batch([slot], [lane])
+        return spec.decode(lane)
+
+    def orset_members(self, slot: int) -> frozenset:
+        """Current members of an OR-set slot (odd causal lengths)."""
+        spec = self._typed_spec(slot, "orset")
+        return spec.decode(self._lane_value(slot))
+
+    def mvreg_put(self, slot: int, value: int) -> None:
+        """Write a multi-value register: this write's fresh HLC is
+        strictly newer than anything the replica has seen, so it
+        replaces local values outright; CONCURRENT peer writes (equal
+        lt under different nodes) union on merge up to the top
+        ``MVREG_K``."""
+        spec = self._typed_spec(slot, "mvreg")
+        self.put_batch([slot], [spec.encode(value)])
+
+    def mvreg_get(self, slot: int) -> Tuple[int, ...]:
+        """Concurrent values at an mvreg slot, largest first — one
+        element after any local write, possibly several after merging
+        concurrent peers."""
+        spec = self._typed_spec(slot, "mvreg")
+        return spec.decode(self._lane_value(slot))
 
     # --- watch/reactivity (C13, crdt.dart:162-164) ---
 
@@ -1111,11 +1331,43 @@ class DenseCrdt:
 
     def _merge_validated(self, slots: np.ndarray, lt: np.ndarray,
                          node: np.ndarray, val: np.ndarray,
-                         tomb: np.ndarray) -> None:
+                         tomb: np.ndarray, sem_ok: bool = False) -> None:
         """Columnar merge tail on fully validated int lanes: recv fold,
         store join, watch emission, final send bump. ``node`` already
         holds LOCAL ordinals; stats counters are the caller's job up to
-        ``merges``/``records_seen`` (this adds adopted)."""
+        ``merges``/``records_seen`` (this adds adopted).
+
+        ``sem_ok`` asserts the caller verified the payload's semantics
+        tags against the local column (`merge_packed` with a ``sem``
+        lane). Without it, rows landing on typed slots are WITHHELD —
+        an LWW-framed wire (record dicts, JSON, pre-semantics packed
+        frames) cannot prove it joins under the right lattice, and
+        joining a counter lane by LWW would corrupt it. Withheld rows
+        count in ``crdt_tpu_sync_semantics_downgrade_total``."""
+        if not sem_ok and self._sem is not None:
+            typed = self._sem[slots] != 0
+            if typed.any():
+                from ..obs.registry import default_registry
+                default_registry().counter(
+                    "crdt_tpu_sync_semantics_downgrade_total",
+                    "typed rows withheld from LWW-only wire forms by "
+                    "direction").inc(int(typed.sum()),
+                                     direction="inbound",
+                                     node=str(self._node_id))
+                keep = ~typed
+                slots, lt, node, val, tomb = (
+                    slots[keep], lt[keep], node[keep], val[keep],
+                    tomb[keep])
+                if not len(slots):
+                    # Same two clock ticks as an empty merge
+                    # (absorption wall read + final send bump), so
+                    # injected clocks stay in step with peers that
+                    # shipped nothing.
+                    self._wall_clock()
+                    self._canonical_time = Hlc.send(
+                        self._canonical_time,
+                        millis=self._wall_clock())
+                    return
         k = len(slots)
         my_ord = self._table.ordinal(self._node_id)
         wall = self._wall_clock()
@@ -1204,6 +1456,9 @@ class DenseCrdt:
         """Run a validated columnar delta through the store join.
         Returns ``(new_store, win, slot_aligned)`` — ``win`` is per
         SLOT (N-wide) when ``slot_aligned``, else per payload entry."""
+        if self._sem is not None:
+            return self._dispatch_columns_typed(
+                slots, lt, node, val, tomb, new_canonical, my_ord)
         k = len(slots)
         n = self.n_slots
         if k * self.WIDE_JOIN_FRACTION >= n:
@@ -1258,6 +1513,60 @@ class DenseCrdt:
             jnp.asarray(tomb_p), jnp.asarray(valid),
             jnp.int64(new_canonical), jnp.int32(my_ord),
             donate=self._donate_writes(), sharding=self._write_sharding())
+        return new_store, win, False
+
+    def _dispatch_columns_typed(self, slots, lt, node, val, tomb,
+                                new_canonical: int, my_ord: int):
+        """The typed counterpart of `_dispatch_columns`: same
+        wide-vs-sparse cutover, but routed through the semantics
+        kernels with the per-slot (wide) or per-row (sparse) tag lane.
+        The value lane stays int64 — typed encodings use all 64 bits,
+        so the wide path's int32 narrowing never applies."""
+        from ..semantics.kernels import (typed_sparse_join_step,
+                                         typed_wire_join_step)
+        k = len(slots)
+        n = self.n_slots
+        if k * self.WIDE_JOIN_FRACTION >= n:
+            lt_n = np.zeros((n,), np.int64)
+            node_n = np.zeros((n,), np.int32)
+            val_n = np.zeros((n,), np.int64)
+            tomb_n = np.zeros((n,), bool)
+            valid_n = np.zeros((n,), bool)
+            lt_n[slots] = lt
+            node_n[slots] = node
+            val_n[slots] = val
+            tomb_n[slots] = tomb
+            valid_n[slots] = True
+            new_store, win = typed_wire_join_step(
+                self._store, self._sem_device(), jnp.asarray(lt_n),
+                jnp.asarray(node_n), jnp.asarray(val_n),
+                jnp.asarray(tomb_n), jnp.asarray(valid_n),
+                jnp.int64(new_canonical), jnp.int32(my_ord),
+                donate=self._donate_writes(),
+                sharding=self._write_sharding())
+            return new_store, win, True
+        padded = 1 << max(k - 1, 1).bit_length()
+        sem_rows = np.zeros((padded,), np.int8)
+        lt_p = np.zeros((padded,), np.int64)
+        node_p = np.zeros((padded,), np.int32)
+        val_p = np.zeros((padded,), np.int64)
+        tomb_p = np.zeros((padded,), bool)
+        valid = np.zeros((padded,), bool)
+        slot_arr = np.full((padded,), self.n_slots, np.int32)
+        slot_arr[:k] = slots
+        sem_rows[:k] = self._sem[slots]
+        valid[:k] = True
+        lt_p[:k] = lt
+        node_p[:k] = node
+        val_p[:k] = val
+        tomb_p[:k] = tomb
+        new_store, win = typed_sparse_join_step(
+            self._store, jnp.asarray(sem_rows), jnp.asarray(slot_arr),
+            jnp.asarray(lt_p), jnp.asarray(node_p), jnp.asarray(val_p),
+            jnp.asarray(tomb_p), jnp.asarray(valid),
+            jnp.int64(new_canonical), jnp.int32(my_ord),
+            donate=self._donate_writes(),
+            sharding=self._write_sharding())
         return new_store, win, False
 
     # --- checkpoint/resume (SURVEY.md §5) ---
@@ -1366,6 +1675,12 @@ class DenseCrdt:
         takes the kernel whenever the store is tile-aligned, the node
         table fits the kernel's int16 wire lane, and the backend is an
         accelerator."""
+        if self._sem is not None:
+            # Typed stores join through the semantics kernels (XLA
+            # elementwise); the Mosaic kernel is LWW-only.
+            # `set_semantics` refuses forced-pallas executors, so this
+            # auto-fallback never contradicts an explicit request.
+            return False
         from ..ops.pallas_merge import MAX_NODE_ORDINAL, TILE
         if len(self._table) > MAX_NODE_ORDINAL:
             # The kernel's changeset node lane is int16 (ordinals are
@@ -1391,6 +1706,8 @@ class DenseCrdt:
         Returns ``(new_store, res)`` with a FaninResult-compatible res."""
         canonical = self._canonical_lt()
         local = jnp.int32(self._table.ordinal(self._node_id))
+        if self._sem is not None:
+            return self._typed_fanin(cs, canonical, local, wall)
         if self._use_pallas():
             return self._dispatch_pallas(cs, canonical, local, wall)
         r = cs.lt.shape[0]
@@ -1405,6 +1722,21 @@ class DenseCrdt:
                             jnp.max(jnp.where(cs.valid, cs.lt, _NEG)))
         return fanin_stream(self._store, chunks, canonical, local,
                             jnp.int64(wall), stamp)
+
+    def _typed_fanin(self, cs: DenseChangeset, canonical, local,
+                     wall: int):
+        """Changeset fan-in on a typed store: the semantics kernels'
+        Python-unrolled elementwise fold. Shared by the base AND
+        sharded models — typed joins are purely elementwise, so the
+        sharded store runs the same jit with its key-axis sharding
+        pinned, no collective dispatch (replica rows fold locally
+        against key-sharded lanes). Guard flags here are exact (same
+        `recv_guards` as the XLA fold), so `_exact_guards` passes the
+        result through unchanged."""
+        from ..semantics.kernels import typed_fanin_step
+        return typed_fanin_step(self._store, self._sem_device(), cs,
+                                canonical, local, jnp.int64(wall),
+                                sharding=self._write_sharding())
 
     def _dispatch_pallas(self, cs: DenseChangeset, canonical, local,
                          wall: int):
@@ -1859,7 +2191,8 @@ class DenseCrdt:
     # beyond that are churn, not reuse.
     PACK_CACHE_SLOTS = 4
 
-    def pack_since(self, since: Optional[Hlc] = None
+    def pack_since(self, since: Optional[Hlc] = None,
+                   sem_mode: str = "auto"
                    ) -> Tuple[PackedDelta, List[Any]]:
         """Outbound O(k) columnar delta: host lanes for the rows with
         ``modified >= since`` (inclusive, the `export_delta` bound) —
@@ -1868,22 +2201,40 @@ class DenseCrdt:
         `count_modified_since` mask), so steady-state gossip bytes are
         proportional to what changed, not to capacity.
 
-        Results are cached keyed on ``(since, canonical)``; every store
-        replacement — puts, deletes, merges, grow, ordinal remaps —
-        clears the cache through the ``_store`` setter, so an unchanged
-        replica answers repeat packs (the no-change gossip round) with
-        ZERO device work. Hits/misses are counted in
+        ``sem_mode`` is how the transport's capability negotiation
+        reaches the pack (docs/WIRE.md): ``"include"`` attaches the
+        uint8 ``sem`` tag lane (peer negotiated the ``semantics``
+        hello cap); ``"withhold"`` drops non-LWW rows instead —
+        withheld, never corrupted — counting them in
+        ``crdt_tpu_sync_semantics_downgrade_total``; ``"auto"``
+        (in-process callers) withholds only when the store actually
+        holds typed slots. An all-LWW replica omits the lane under
+        every mode — the legacy 5-lane frame stays byte-identical.
+
+        Results are cached keyed on ``(since, canonical, semantics
+        version, mode)``; every store replacement — puts, deletes,
+        merges, grow, ordinal remaps — clears the cache through the
+        ``_store`` setter, and a `set_semantics` migration bumps the
+        version (and clears outright), so a cached pack can never leak
+        rows under stale tags. Hits/misses are counted in
         ``crdt_tpu_pack_cache_total``. The device lanes are copied to
-        host here, so packing does NOT escape the store snapshot (later
-        merges may still donate)."""
+        host here, so packing does NOT escape the store snapshot
+        (later merges may still donate)."""
         from ..obs.registry import default_registry
         from ..obs.trace import span
+        if sem_mode not in ("auto", "include", "withhold"):
+            raise ValueError(f"unknown sem_mode {sem_mode!r}")
         # Drain BEFORE the cache key reads the canonical: a flush
         # advances the clock AND replaces the store, so a key built
         # first would alias a pre-flush pack under a stale watermark.
         self.drain_ingest()
+        # "plain": untyped store — no lane to attach, nothing to
+        # withhold (the seed wire form, whatever the caller asked).
+        resolved = "plain" if self._sem is None else (
+            "withhold" if sem_mode == "auto" else sem_mode)
         key = (None if since is None else since.logical_time,
-               self._canonical_time.logical_time)
+               self._canonical_time.logical_time,
+               self._sem_version, resolved)
         counter = default_registry().counter(
             "crdt_tpu_pack_cache_total",
             "pack_since cache lookups by outcome")
@@ -1903,12 +2254,27 @@ class DenseCrdt:
                 (mask, self._store.lt, self._store.node,
                  self._store.val, self._store.tomb))
             idx = np.nonzero(mask)[0]
+            sem_lane = None
+            if resolved == "withhold":
+                typed = self._sem[idx] != 0
+                withheld = int(typed.sum())
+                if withheld:
+                    default_registry().counter(
+                        "crdt_tpu_sync_semantics_downgrade_total",
+                        "typed rows withheld from LWW-only wire forms "
+                        "by direction").inc(withheld,
+                                            direction="outbound",
+                                            node=str(self._node_id))
+                    idx = idx[~typed]
+            elif resolved == "include":
+                sem_lane = self._sem[idx].astype(np.uint8)
             packed = PackedDelta(
                 slots=idx.astype(np.int32, copy=False),
                 lt=np.ascontiguousarray(lt[idx], np.int64),
                 node=node[idx].astype(np.int32, copy=False),
                 val=np.ascontiguousarray(val[idx], np.int64),
-                tomb=tomb[idx].astype(np.uint8, copy=False))
+                tomb=tomb[idx].astype(np.uint8, copy=False),
+                sem=sem_lane)
         out = (packed, self._table.ids())
         self._pack_cache[key] = out
         while len(self._pack_cache) > self.PACK_CACHE_SLOTS:
@@ -1930,8 +2296,11 @@ class DenseCrdt:
         ni = np.asarray(packed.node)
         val = np.asarray(packed.val, np.int64)
         tomb = np.asarray(packed.tomb).astype(bool)
+        sem = (None if getattr(packed, "sem", None) is None
+               else np.asarray(packed.sem).astype(np.int8))
         k = len(slots)
-        if not (len(lt) == len(ni) == len(val) == len(tomb) == k):
+        if not (len(lt) == len(ni) == len(val) == len(tomb) == k) \
+                or (sem is not None and len(sem) != k):
             raise ValueError("packed delta lanes are ragged")
         if k == 0:
             self.merge_many([])
@@ -1944,14 +2313,31 @@ class DenseCrdt:
         if keep is not None:
             slots, lt, ni, val, tomb = (slots[keep], lt[keep], ni[keep],
                                         val[keep], tomb[keep])
+            if sem is not None:
+                sem = sem[keep]
             k = len(slots)
         self.stats.merges += 1
         self.stats.add_seen_lazy(k)
         self._check_slots(slots)
+        if sem is not None:
+            # Two replicas must never join one slot under two
+            # different lattices: the peer's announced tag has to
+            # match the local column exactly (LWW rows included), and
+            # the rejection lands BEFORE the first clock mutation.
+            mism = sem != self._sem_host()[slots]
+            if bool(mism.any()):
+                i = int(np.nonzero(mism)[0][0])
+                raise ValueError(
+                    f"semantics tag mismatch at slot {int(slots[i])}: "
+                    f"peer sent tag {int(sem[i])}, local column holds "
+                    f"{int(self._sem_host()[slots[i]])}; run the same "
+                    "set_semantics migration on both replicas before "
+                    "syncing")
         self._check_value_width(val)
         self._intern_ids(node_ids)
         node = self._table.encode(node_ids)[ni]
-        self._merge_validated(slots, lt, node, val, tomb)
+        self._merge_validated(slots, lt, node, val, tomb,
+                              sem_ok=sem is not None)
 
     def _pipe_send_bump(self, wall: int) -> None:
         """The final crdt.dart:93 send bump, on device, flags
@@ -2023,6 +2409,12 @@ class ShardedDenseCrdt(DenseCrdt):
     def _dispatch_fanin(self, cs: DenseChangeset, wall: int):
         from ..parallel import (make_sharded_pallas_fanin, replica_extent,
                                 shard_changeset)
+        if self._sem is not None:
+            # Typed joins are elementwise — the shared typed fold runs
+            # directly on the key-sharded lanes, no collective step.
+            return self._typed_fanin(
+                cs, self._canonical_lt(),
+                jnp.int32(self._table.ordinal(self._node_id)), wall)
         # The replica dim shards over EVERY non-key mesh axis (just
         # "replica" on a flat mesh; ("slice", "replica") on a
         # multi-slice one).
@@ -2064,6 +2456,8 @@ class ShardedDenseCrdt(DenseCrdt):
         on, "xla" off); "auto" takes the kernel when each device's key
         shard is tile-aligned, the node table fits the kernel's int16
         wire lane, and the backend is TPU."""
+        if self._sem is not None:
+            return False  # typed stores route through _typed_fanin
         from ..ops.pallas_merge import MAX_NODE_ORDINAL, TILE
         from ..parallel import KEY_AXIS
         if len(self._table) > MAX_NODE_ORDINAL:

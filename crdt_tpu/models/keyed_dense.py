@@ -144,6 +144,43 @@ class KeyedDenseCrdt(Crdt[K, int]):
         self._dense.merge_records(
             {self._intern(k): r for k, r in remote_records.items()})
 
+    # --- typed lanes: keyed surface over crdt_tpu.semantics ---
+
+    def set_semantics(self, keys, semantics) -> None:
+        """Assign a registered semantics (`docs/TYPES.md`) to the
+        slots behind ``keys``, interning unseen keys. Same rules as
+        `DenseCrdt.set_semantics` (empty lanes only, no pipeline)."""
+        self._dense.set_semantics(
+            [self._intern(k) for k in keys], semantics)
+
+    def semantics_of(self, key: K):
+        slot = self._key_to_slot.get(key)
+        if slot is None:
+            from ..semantics import LWW
+            return LWW
+        return self._dense.semantics_of(slot)
+
+    def counter_add(self, key: K, delta: int = 1) -> int:
+        return self._dense.counter_add(self._intern(key), delta)
+
+    def counter_value(self, key: K) -> int:
+        return self._dense.counter_value(self._intern(key))
+
+    def orset_add(self, key: K, element: int) -> frozenset:
+        return self._dense.orset_add(self._intern(key), element)
+
+    def orset_remove(self, key: K, element: int) -> frozenset:
+        return self._dense.orset_remove(self._intern(key), element)
+
+    def orset_members(self, key: K) -> frozenset:
+        return self._dense.orset_members(self._intern(key))
+
+    def mvreg_put(self, key: K, value: int) -> None:
+        self._dense.mvreg_put(self._intern(key), value)
+
+    def mvreg_get(self, key: K):
+        return self._dense.mvreg_get(self._intern(key))
+
     # --- storage primitives (crdt.dart:140-169) ---
 
     def contains_key(self, key: K) -> bool:

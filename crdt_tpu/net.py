@@ -571,8 +571,15 @@ class SyncServer:
         with self.lock:
             packed = (hasattr(self.crdt, "pack_since")
                       and hasattr(self.crdt, "merge_packed"))
+            # "semantics" gates the packed frame's 6th (sem tag) lane
+            # (docs/TYPES.md): only a replica that can VALIDATE tags
+            # may receive them, so the cap requires the typed surface,
+            # not just packed framing.
+            semantics = packed and hasattr(self.crdt, "set_semantics")
         if packed:
             caps.add("packed")
+        if semantics:
+            caps.add("semantics")
         return caps
 
     def _handle(self, conn: socket.socket) -> None:
@@ -584,6 +591,7 @@ class SyncServer:
         deadline = _time.monotonic() + self._conn_deadline
         ops = 0
         codec: Optional[FrameCodec] = None
+        sem_ok = False   # this session negotiated the sem tag lane
         while not self._stop.is_set():
             sent0, received0 = self.tally.sent, self.tally.received
             try:
@@ -613,6 +621,7 @@ class SyncServer:
                 # The reply itself crossed untagged; everything AFTER
                 # it speaks the tagged framing.
                 codec = FrameCodec(compress="zlib" in agreed)
+                sem_ok = "semantics" in agreed
             elif op == "push":
                 try:
                     with self.lock:
@@ -743,8 +752,10 @@ class SyncServer:
                 try:
                     since = msg.get("since")
                     with self.lock:
-                        packed, ids = self.crdt.pack_since(
-                            None if since is None else Hlc.parse(since))
+                        packed, ids = _pack_for_peer(
+                            self.crdt,
+                            None if since is None else Hlc.parse(since),
+                            sem_ok)
                     from .ops.packing import pack_rows
                     meta, bufs = pack_rows(packed)
                     meta_msg = {"meta": meta, "node_ids": list(ids),
@@ -855,7 +866,8 @@ class PeerConnection:
     def __init__(self, host: str, port: int, timeout: float = 30.0,
                  idle_timeout: Optional[float] = 20.0,
                  negotiate: bool = True,
-                 want_caps: Iterable[str] = ("zlib", "packed")):
+                 want_caps: Iterable[str] = ("zlib", "packed",
+                                             "semantics")):
         self.host = host
         self.port = port
         self.timeout = timeout
@@ -1063,6 +1075,20 @@ def sync_dense_over_conn(crdt, conn: PeerConnection,
     return watermark
 
 
+def _pack_for_peer(crdt, since: Optional[Hlc],
+                   sem_include: bool) -> Tuple:
+    """`pack_since` with the semantics tag lane included only when the
+    session negotiated the "semantics" capability. Crdts predating the
+    ``sem_mode`` kwarg (no typed surface) get the plain call — their
+    packs are 5-lane regardless. An un-negotiated session against a
+    typed store gets ``sem_mode="auto"``, i.e. typed rows WITHHELD
+    (never silently stripped of their tags — docs/TYPES.md)."""
+    if hasattr(crdt, "set_semantics"):
+        return crdt.pack_since(
+            since, sem_mode="include" if sem_include else "auto")
+    return crdt.pack_since(since)
+
+
 def sync_packed_over_conn(crdt, conn: PeerConnection,
                           since: Optional[Hlc] = None,
                           lock: Optional[threading.Lock] = None,
@@ -1090,6 +1116,17 @@ def sync_packed_over_conn(crdt, conn: PeerConnection,
     if lock is None:
         lock = threading.Lock()   # uncontended no-op
     from .ops.packing import pack_rows, unpack_rows
+    import time as _time
+    # Negotiate BEFORE packing: whether the sem tag lane rides (and so
+    # whether typed rows ship at all) depends on the session's caps.
+    sock = conn.ensure(tally)
+    if "packed" not in conn.caps:
+        # Raised before any bytes move: the session is still in sync,
+        # so no reset — the caller can immediately retry dense/JSON
+        # over the same connection.
+        raise SyncProtocolError(
+            "peer did not advertise the 'packed' capability",
+            code="packed_rejected")
     if _prepacked is not None:
         watermark, packed, ids = _prepacked
     else:
@@ -1103,16 +1140,8 @@ def sync_packed_over_conn(crdt, conn: PeerConnection,
             if drain is not None:
                 drain()
             watermark = crdt.canonical_time
-            packed, ids = crdt.pack_since(since)
-    import time as _time
-    sock = conn.ensure(tally)
-    if "packed" not in conn.caps:
-        # Raised before any bytes move: the session is still in sync,
-        # so no reset — the caller can immediately retry dense/JSON
-        # over the same connection.
-        raise SyncProtocolError(
-            "peer did not advertise the 'packed' capability",
-            code="packed_rejected")
+            packed, ids = _pack_for_peer(crdt, since,
+                                         "semantics" in conn.caps)
     try:
         codec = conn.codec
         if packed.k:

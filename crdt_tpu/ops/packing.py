@@ -108,8 +108,13 @@ class NodeTable:
 # Exact host lane dtypes of the PACKED wire form, in field order.
 # Anything else from a peer is a protocol violation (mirrors
 # net._SPLIT_LANE_DTYPES: never trust np.dtype as a parser for
-# untrusted dtype strings).
+# untrusted dtype strings). The optional 6th lane ("sem", uint8
+# semantics tags) rides only between peers that negotiated the
+# "semantics" hello capability — a pre-semantics receiver's field
+# check rejects 6-lane frames, which is exactly why senders withhold
+# the lane (and the rows needing it) from un-negotiated sessions.
 PACKED_LANE_DTYPES = ("int32", "int64", "int32", "int64", "uint8")
+PACKED_SEM_DTYPE = "uint8"
 
 
 class PackedDelta(NamedTuple):
@@ -122,13 +127,20 @@ class PackedDelta(NamedTuple):
     a steady-state gossip round costs bytes proportional to what
     actually changed (~25 B/row). ``node`` carries ordinals into the
     ``node_ids`` list that travels beside the delta; ``modified``
-    stamps are local-only and never serialized (record.dart:28-31)."""
+    stamps are local-only and never serialized (record.dart:28-31).
+
+    ``sem`` (None on all-LWW deltas and from pre-semantics peers)
+    carries each row's semantics tag (`crdt_tpu.semantics`): the
+    receiver validates tags against its own per-slot column before
+    merging, so two replicas can never silently join one slot under
+    two different lattices."""
 
     slots: np.ndarray   # int32[k], unique (last-wins collapsed)
     lt: np.ndarray      # int64[k] packed logical times
     node: np.ndarray    # int32[k] ordinals into the wire node_ids
     val: np.ndarray     # int64[k] (0 where tombstoned)
     tomb: np.ndarray    # uint8[k] 0/1 tombstone flags
+    sem: Optional[np.ndarray] = None  # uint8[k] semantics tags
 
     @property
     def k(self) -> int:
@@ -136,18 +148,26 @@ class PackedDelta(NamedTuple):
 
     @property
     def nbytes(self) -> int:
-        return sum(lane.nbytes for lane in self)
+        return sum(lane.nbytes for lane in self if lane is not None)
 
 
 def pack_rows(delta: "PackedDelta") -> Tuple[dict, List[memoryview]]:
     """(meta, bufs) for a packed delta: lane descriptors plus host
     buffers in field order — the shape `net.send_bytes_frame` ships as
-    one raw binary frame."""
+    one raw binary frame. The ``sem`` lane is appended only when
+    present (capability-gated by the caller)."""
+    lanes = list(delta[:5])
+    fields = list(PackedDelta._fields[:5])
+    dtypes = list(PACKED_LANE_DTYPES)
+    if delta.sem is not None:
+        lanes.append(delta.sem)
+        fields.append("sem")
+        dtypes.append(PACKED_SEM_DTYPE)
     arrs = [np.ascontiguousarray(np.asarray(lane, dtype))
-            for lane, dtype in zip(delta, PACKED_LANE_DTYPES)]
+            for lane, dtype in zip(lanes, dtypes)]
     meta = {"form": "packed",
             "lanes": [[f, str(a.dtype), [len(a)]]
-                      for f, a in zip(delta._fields, arrs)]}
+                      for f, a in zip(fields, arrs)]}
     return meta, [a.data.cast("B") for a in arrs]
 
 
@@ -155,17 +175,22 @@ def unpack_rows(meta: Any, blob: bytes) -> "PackedDelta":
     """Validate + reconstruct the packed delta a peer announced.
     Raises ValueError on any structural violation (wrong fields or
     dtypes, ragged lane lengths, frame size mismatch) BEFORE the
-    replica is touched. ``k == 0`` is a legal empty delta."""
+    replica is touched. ``k == 0`` is a legal empty delta. Accepts
+    the 5-lane legacy form and the 6-lane form with the trailing
+    ``sem`` tag lane."""
     if not isinstance(meta, dict) or meta.get("form") != "packed":
         raise ValueError("bad packed meta")
     lanes_meta = meta.get("lanes")
+    base = list(PackedDelta._fields[:5])
     if not isinstance(lanes_meta, list) \
-            or [l[0] for l in lanes_meta] != list(PackedDelta._fields):
+            or [l[0] for l in lanes_meta] not in (base, base + ["sem"]):
         raise ValueError("packed lane fields mismatch")
+    want_dtypes = PACKED_LANE_DTYPES + (
+        (PACKED_SEM_DTYPE,) if len(lanes_meta) == 6 else ())
     lanes = []
     off = 0
     k = None
-    for (_, dt, shape), want in zip(lanes_meta, PACKED_LANE_DTYPES):
+    for (_, dt, shape), want in zip(lanes_meta, want_dtypes):
         if dt != want:
             raise ValueError(f"lane dtype {dt!r} != expected {want!r}")
         if not isinstance(shape, list) or len(shape) != 1 \

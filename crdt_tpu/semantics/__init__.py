@@ -1,0 +1,58 @@
+"""crdt_tpu.semantics — the per-lane CRDT type zoo.
+
+A first-class registry of lane semantics: every entry bundles a
+donated/jit-cacheable merge kernel branch, a wire tag, a value codec
+and a law spec, and registering it is what puts it under CI (the
+analysis gate consumes :func:`law_targets` / :func:`audit_targets`
+and fails on a spec missing either). Five semantics ship: ``lww``
+(tag 0, the seed behavior), ``gcounter``, ``pncounter``, ``orset``
+and ``mvreg`` — encodings and laws in `kernels` and `types`, usage in
+docs/TYPES.md.
+
+Models consume this through `DenseCrdt.set_semantics` (per-slot tag
+column) plus the typed op helpers (``counter_add``, ``orset_add``,
+``mvreg_put``, ...); the wire carries tags only to peers that
+negotiated the ``semantics`` hello capability (docs/WIRE.md).
+"""
+
+from __future__ import annotations
+
+from typing import List
+
+from .types import (LWW, GCOUNTER, PNCOUNTER, ORSET, MVREG,
+                    SemanticsSpec, all_semantics, by_tag,
+                    get_semantics, names, register)
+from .kernels import (MVREG_K, MVREG_MAX, ORSET_MAX_LEN,
+                      ORSET_UNIVERSE, SEM_GCOUNTER, SEM_LWW,
+                      SEM_MVREG, SEM_ORSET, SEM_PNCOUNTER,
+                      typed_fanin_step, typed_join_lanes,
+                      typed_sparse_join_step, typed_wire_join_step)
+
+__all__ = [
+    "SemanticsSpec", "register", "get_semantics", "by_tag",
+    "all_semantics", "names",
+    "LWW", "GCOUNTER", "PNCOUNTER", "ORSET", "MVREG",
+    "SEM_LWW", "SEM_GCOUNTER", "SEM_PNCOUNTER", "SEM_ORSET",
+    "SEM_MVREG", "ORSET_UNIVERSE", "ORSET_MAX_LEN", "MVREG_K",
+    "MVREG_MAX",
+    "typed_join_lanes", "typed_wire_join_step",
+    "typed_sparse_join_step", "typed_fanin_step",
+    "law_targets", "audit_targets",
+]
+
+
+def law_targets() -> List:
+    """Seeded-law targets for every registered semantics that declares
+    one — what `analysis.lattice_laws.builtin_targets` appends, so a
+    new type gets law coverage by registering, with zero hand-listed
+    targets."""
+    return [spec.law_target() for spec in all_semantics()
+            if spec.law_target is not None]
+
+
+def audit_targets() -> List:
+    """Jaxpr-audit targets for every registered semantics that
+    declares one — appended by `analysis.jaxpr_audit.builtin_targets`
+    beside the shared typed sparse/fanin kernel targets."""
+    return [spec.audit_target() for spec in all_semantics()
+            if spec.audit_target is not None]

@@ -1,0 +1,336 @@
+"""Typed per-lane merge kernels: one fused join for mixed semantics.
+
+`ops.dense` joins every slot by the LWW rule — strict ``(lt, node)``
+lexicographic compare, winner takes all lanes. The semantics registry
+(`crdt_tpu.semantics`) generalizes that to a per-slot *type tag* lane
+(``sem``: int8, 0 = LWW) while keeping the columnar store layout and
+the HLC machinery untouched. The composition is the semidirect-product
+construction (PAPERS.md, "Composing and Decomposing Op-Based CRDTs
+with Semidirect Products"):
+
+- The **clock lanes** (lt, node) always join by the strict lex max —
+  identical for every semantics, so watermarks, ``pack_since`` deltas,
+  recv guards and the canonical-clock absorption all keep working
+  unchanged on typed stores.
+- The **value lane** joins by the tag's own sub-semilattice when both
+  sides are present (counter max, per-half max, per-nibble max, top-k
+  union), and by presence otherwise. For ``sem == 0`` the value
+  follows the clock winner bit-for-bit — the LWW branch reproduces
+  `ops.dense._wire_join_body` exactly.
+- The **tomb flag** is the clock winner's: deletion stays an
+  LWW-resettable action *on top of* the typed state (the semidirect
+  action) — a tombstoned counter keeps its monotone lane and joins
+  normally, so un-deleting reveals the converged count.
+
+Each composed per-slot join is a lexicographic/product lattice, so
+idempotence/commutativity/associativity hold by construction — and are
+*checked*, not trusted: every registered tag generates a seeded
+`LawTarget` and a jaxpr `AuditTarget` (see `crdt_tpu.semantics.types`).
+
+Value-lane encodings (all within one int64; value_width must be 64):
+
+====== === ===========================================================
+name   tag encoding
+====== === ===========================================================
+lww      0 opaque payload; clock winner takes the lane
+gcount   1 non-negative count; join = max
+pncount  2 pos in bits 32..62, neg in bits 0..30; join = per-half max;
+           user value = pos - neg
+orset    3 causal-length set over 16 elements: 4-bit causal length per
+           element (PAPERS.md: low-cost set CRDT based on causal
+           lengths); join = per-nibble max; element present iff its
+           length is ODD; lengths saturate at 15 (7 add/remove cycles)
+mvreg    4 top-4 concurrent 16-bit values (1..65535, 0 = empty) packed
+           descending (bits 63:48 hold the largest); strictly newer lt
+           wins outright, equal lt joins by dedup-union-top-4
+====== === ===========================================================
+
+Kernel surface mirrors `ops.dense`: jit-cached factories keyed on
+``(donate, sharding)``, store donation for O(k) in-place lane updates,
+``with_sharding_constraint`` pinning sharded outputs. Everything is
+elementwise (plus one small last-axis sort for mvreg), so the typed
+kernels shard under jit without new collectives.
+"""
+
+from __future__ import annotations
+
+import functools as _ft
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from ..ops.dense import (DenseStore, DenseChangeset, FaninResult,
+                         _NEG)
+from ..ops.merge import recv_guards
+
+# Wire tags. LWW MUST be 0: a store with no semantics column is
+# all-zeros by construction, and the packed wire form omits the sem
+# lane entirely for all-LWW stores.
+SEM_LWW = 0
+SEM_GCOUNTER = 1
+SEM_PNCOUNTER = 2
+SEM_ORSET = 3
+SEM_MVREG = 4
+
+_PN_HALF = (1 << 31) - 1     # 31-bit pos/neg halves; bit 63 stays 0
+ORSET_UNIVERSE = 16          # elements per orset lane (4-bit lengths)
+ORSET_MAX_LEN = 15           # causal-length saturation point
+MVREG_K = 4                  # concurrent values kept per mvreg lane
+MVREG_MAX = 0xFFFF           # 16-bit values, 0 reserved for "empty"
+
+
+def _pn_join(l_val: jax.Array, r_val: jax.Array) -> jax.Array:
+    pos = jnp.maximum((l_val >> 32) & _PN_HALF, (r_val >> 32) & _PN_HALF)
+    neg = jnp.maximum(l_val & _PN_HALF, r_val & _PN_HALF)
+    return (pos << 32) | neg
+
+
+def _orset_join(l_val: jax.Array, r_val: jax.Array) -> jax.Array:
+    """Per-nibble max of 16 packed causal lengths — Python-unrolled
+    shift/mask, the elementwise shape TPU tiles well (no gather)."""
+    out = jnp.zeros_like(l_val)
+    for i in range(ORSET_UNIVERSE):
+        sh = 4 * i
+        out = out | (jnp.maximum((l_val >> sh) & 0xF,
+                                 (r_val >> sh) & 0xF) << sh)
+    return out
+
+
+def _mvreg_union(l_val: jax.Array, r_val: jax.Array) -> jax.Array:
+    """Dedup-union of two top-4 packs, keeping the 4 largest. Taking
+    top-k after a union is a closure (top4(top4(a∪b)∪c) ==
+    top4(a∪b∪c)), so the equal-lt branch stays associative."""
+    shifts = (48, 32, 16, 0)
+    cand = jnp.stack([(l_val >> s) & MVREG_MAX for s in shifts]
+                     + [(r_val >> s) & MVREG_MAX for s in shifts],
+                     axis=-1)
+    cand = -jnp.sort(-cand, axis=-1)          # descending
+    prev = jnp.concatenate(
+        [jnp.full(cand.shape[:-1] + (1,), -1, cand.dtype),
+         cand[..., :-1]], axis=-1)
+    keep = (cand != prev) & (cand > 0)        # first occurrence, nonzero
+    rank = jnp.cumsum(keep.astype(jnp.int64), axis=-1) - 1
+    sel = keep & (rank < MVREG_K)
+    shift = jnp.clip(48 - 16 * rank, 0, 48)
+    return jnp.sum(jnp.where(sel, cand << shift, 0), axis=-1)
+
+
+def _typed_val(sem: jax.Array, l_lt: jax.Array, r_lt: jax.Array,
+               l_val: jax.Array, r_val: jax.Array,
+               winner_val: jax.Array) -> jax.Array:
+    """Value join for BOTH-PRESENT lanes by tag; unknown tags fall
+    back to the clock winner's value (safe: still a semilattice)."""
+    mv = jnp.where(l_lt == r_lt, _mvreg_union(l_val, r_val),
+                   jnp.where(r_lt > l_lt, r_val, l_val))
+    out = winner_val
+    out = jnp.where(sem == SEM_GCOUNTER, jnp.maximum(l_val, r_val), out)
+    out = jnp.where(sem == SEM_PNCOUNTER, _pn_join(l_val, r_val), out)
+    out = jnp.where(sem == SEM_ORSET, _orset_join(l_val, r_val), out)
+    out = jnp.where(sem == SEM_MVREG, mv, out)
+    return out
+
+
+def typed_join_lanes(sem, l_lt, l_node, l_val, l_occ, l_tomb,
+                     r_lt, r_node, r_val, r_tomb, r_valid
+                     ) -> Tuple[jax.Array, jax.Array, jax.Array,
+                                jax.Array, jax.Array, jax.Array]:
+    """One elementwise typed join of remote lanes into local lanes.
+
+    Returns ``(lt, node, val, tomb, occupied, win)``. ``win`` is the
+    adoption mask LWW lanes use (strictly-newer remote, exactly
+    `_wire_join_body`) and the CHANGED mask for typed lanes (a
+    re-delivered or dominated typed row is a no-op, so its ``mod``
+    stamp — and its watch event — must not fire)."""
+    lt_m = jnp.where(r_valid, r_lt, _NEG)
+    node32 = r_node.astype(jnp.int32)
+    val64 = r_val.astype(jnp.int64)
+    # Strict (lt, node) compare: local wins exact ties (crdt.dart:84).
+    remote_newer = ((lt_m > l_lt) | ((lt_m == l_lt) & (node32 > l_node)))
+    take = r_valid & (~l_occ | remote_newer)
+
+    lt_out = jnp.where(take, lt_m, l_lt)
+    node_out = jnp.where(take, node32, l_node)
+    tomb_out = jnp.where(take, r_tomb, l_tomb)
+    occ_out = l_occ | r_valid
+
+    winner_val = jnp.where(take, val64, l_val)
+    both = l_occ & r_valid
+    tval = jnp.where(
+        both, _typed_val(sem, l_lt, lt_m, l_val, val64, winner_val),
+        jnp.where(r_valid & ~l_occ, val64, l_val))
+    val_out = jnp.where(sem == SEM_LWW, winner_val, tval)
+
+    changed = r_valid & ((lt_out != l_lt) | (node_out != l_node)
+                         | (val_out != l_val) | (tomb_out != l_tomb)
+                         | ~l_occ)
+    win = jnp.where(sem == SEM_LWW, take, changed)
+    return lt_out, node_out, val_out, tomb_out, occ_out, win
+
+
+# --- jit-cached entry points, keyed (donate, sharding) like ops.dense
+
+
+@_ft.lru_cache(maxsize=None)
+def _typed_wire_join_jit(donate: bool, sharding=None):
+    def step(store, sem, lt, node, val, tomb, valid, stamp_lt,
+             local_node):
+        lt_o, node_o, val_o, tomb_o, occ_o, win = typed_join_lanes(
+            sem, store.lt, store.node, store.val, store.occupied,
+            store.tomb, lt, node, val, tomb, valid)
+        new_store = DenseStore(
+            lt=lt_o, node=node_o, val=val_o,
+            mod_lt=jnp.where(win, stamp_lt, store.mod_lt),
+            mod_node=jnp.where(win, local_node, store.mod_node),
+            occupied=occ_o, tomb=tomb_o)
+        if sharding is not None:
+            new_store = jax.lax.with_sharding_constraint(new_store,
+                                                         sharding)
+        return new_store, win
+    return jax.jit(step, donate_argnums=(0,) if donate else ())
+
+
+def typed_wire_join_step(store: DenseStore, sem: jax.Array,
+                         lt: jax.Array, node: jax.Array,
+                         val: jax.Array, tomb: jax.Array,
+                         valid: jax.Array, stamp_lt: jax.Array,
+                         local_node: jax.Array, *,
+                         donate: bool = False, sharding=None
+                         ) -> Tuple[DenseStore, jax.Array]:
+    """Elementwise N-wide typed join of a slot-aligned wire delta —
+    the `ops.dense.wire_join_step` shape plus a per-slot ``sem`` tag
+    lane. Clock absorption and recv guards stay the CALLER's job;
+    ``stamp_lt`` stamps winners' ``modified`` lanes. For an all-zero
+    ``sem`` lane the result is bit-identical to `wire_join_step`."""
+    return _typed_wire_join_jit(donate, sharding)(
+        store, sem, lt, node, val, tomb, valid, stamp_lt, local_node)
+
+
+@_ft.lru_cache(maxsize=None)
+def _typed_sparse_join_jit(donate: bool, sharding=None):
+    def step(store, sem_rows, slot, lt, node, val, tomb, valid,
+             stamp_lt, local_node):
+        l_lt = store.lt.at[slot].get(mode="fill", fill_value=0)
+        l_node = store.node.at[slot].get(mode="fill", fill_value=0)
+        l_val = store.val.at[slot].get(mode="fill", fill_value=0)
+        l_occ = store.occupied.at[slot].get(mode="fill",
+                                            fill_value=False)
+        l_tomb = store.tomb.at[slot].get(mode="fill", fill_value=False)
+        lt_o, node_o, val_o, tomb_o, _occ_o, win = typed_join_lanes(
+            sem_rows, l_lt, l_node, l_val, l_occ, l_tomb,
+            lt, node, val, tomb, valid)
+        target = jnp.where(win, slot, store.n_slots).astype(jnp.int32)
+        k = slot.shape[0]
+        new_store = DenseStore(
+            lt=store.lt.at[target].set(lt_o, mode="drop"),
+            node=store.node.at[target].set(node_o, mode="drop"),
+            val=store.val.at[target].set(val_o, mode="drop"),
+            mod_lt=store.mod_lt.at[target].set(
+                jnp.zeros((k,), jnp.int64) + stamp_lt, mode="drop"),
+            mod_node=store.mod_node.at[target].set(
+                jnp.zeros((k,), jnp.int32) + local_node, mode="drop"),
+            occupied=store.occupied.at[target].set(True, mode="drop"),
+            tomb=store.tomb.at[target].set(tomb_o, mode="drop"),
+        )
+        if sharding is not None:
+            new_store = jax.lax.with_sharding_constraint(new_store,
+                                                         sharding)
+        return new_store, win
+    return jax.jit(step, donate_argnums=(0,) if donate else ())
+
+
+def typed_sparse_join_step(store: DenseStore, sem_rows: jax.Array,
+                           slot: jax.Array, lt: jax.Array,
+                           node: jax.Array, val: jax.Array,
+                           tomb: jax.Array, valid: jax.Array,
+                           stamp_lt: jax.Array, local_node: jax.Array,
+                           *, donate: bool = False, sharding=None
+                           ) -> Tuple[DenseStore, jax.Array]:
+    """O(k) typed scatter join — `ops.dense.sparse_fanin_step` with a
+    per-ROW ``sem_rows`` tag lane (the host gathers the store's tags
+    at the delta's slots). Gathers the local rows (mode="fill"),
+    joins row-wise, scatters the MERGED rows back at winning slots
+    (``slot == n_slots`` sentinel padding drops, mode="drop"). Slots
+    must be unique within one delta — the same contract as
+    `sparse_fanin_step`, and why duplicate-index scatter order can
+    never matter here."""
+    return _typed_sparse_join_jit(donate, sharding)(
+        store, sem_rows, slot, lt, node, val, tomb, valid, stamp_lt,
+        local_node)
+
+
+@_ft.lru_cache(maxsize=None)
+def _typed_fanin_jit(donate: bool, sharding=None):
+    def step(store, sem, cs, canonical_lt, local_node, wall_millis,
+             stamp_lt):
+        any_bad, first_bad, first_is_dup, canonical_at_fail = \
+            recv_guards(cs.lt, cs.node, cs.valid, canonical_lt,
+                        local_node, wall_millis)
+        new_canonical = jnp.maximum(
+            canonical_lt, jnp.max(jnp.where(cs.valid, cs.lt, _NEG)))
+        stamp = new_canonical if stamp_lt is None else stamp_lt
+        # Python-unrolled fold of the typed join over the R rows —
+        # join associativity makes this the union join; the typed
+        # kernels never stream (merge sizes that need lax.scan are an
+        # LWW fast-path concern, and typed stores disable Pallas too).
+        lt, node, val = store.lt, store.node, store.val
+        occ, tomb = store.occupied, store.tomb
+        for r in range(cs.lt.shape[0]):
+            lt, node, val, tomb, occ, _w = typed_join_lanes(
+                sem, lt, node, val, occ, tomb,
+                cs.lt[r], cs.node[r], cs.val[r], cs.tomb[r],
+                cs.valid[r])
+        win = ((lt != store.lt) | (node != store.node)
+               | (val != store.val) | (tomb != store.tomb)
+               | (occ & ~store.occupied))
+        new_store = DenseStore(
+            lt=lt, node=node, val=val,
+            mod_lt=jnp.where(win, stamp, store.mod_lt),
+            mod_node=jnp.where(win, local_node, store.mod_node),
+            occupied=occ, tomb=tomb)
+        if sharding is not None:
+            new_store = jax.lax.with_sharding_constraint(new_store,
+                                                         sharding)
+        return new_store, FaninResult(
+            new_canonical=new_canonical,
+            win_count=jnp.sum(win).astype(jnp.int32),
+            win=win, any_bad=any_bad, first_bad=first_bad,
+            first_is_dup=first_is_dup,
+            canonical_at_fail=canonical_at_fail)
+    return jax.jit(step, donate_argnums=(0,) if donate else ())
+
+
+def typed_fanin_step(store: DenseStore, sem: jax.Array,
+                     cs: DenseChangeset, canonical_lt: jax.Array,
+                     local_node: jax.Array, wall_millis: jax.Array,
+                     stamp_lt: Optional[jax.Array] = None, *,
+                     donate: bool = False, sharding=None
+                     ) -> Tuple[DenseStore, FaninResult]:
+    """R-replica typed fan-in — `ops.dense.fanin_step` plus the
+    per-slot ``sem`` lane: recv guards and canonical absorption are
+    identical (the clock lanes ARE identical across semantics), the
+    fold applies the typed join per row, and ``win`` is the
+    changed-vs-original mask. Purely elementwise, so a sharded model
+    runs it under jit with its store sharding pinned — no collective
+    dispatch needed."""
+    return _typed_fanin_jit(donate, sharding)(
+        store, sem, cs, canonical_lt, local_node, wall_millis,
+        stamp_lt)
+
+
+def combine_wire_deltas(sem, a: dict, b: dict) -> dict:
+    """Join two slot-aligned wire deltas into one, per the SAME typed
+    join the kernels apply — the associativity ``combine`` for
+    registry law targets (a combine that disagrees with the kernel is
+    exactly what the law search must catch). Runs eagerly on host
+    arrays; returns plain numpy lanes."""
+    import numpy as np
+    lt, node, val, tomb, occ, _w = typed_join_lanes(
+        sem, jnp.asarray(a["lt"]), jnp.asarray(a["node"], jnp.int32),
+        jnp.asarray(a["val"], jnp.int64), jnp.asarray(a["valid"]),
+        jnp.asarray(a["tomb"]), jnp.asarray(b["lt"]),
+        jnp.asarray(b["node"]), jnp.asarray(b["val"]),
+        jnp.asarray(b["tomb"]), jnp.asarray(b["valid"]))
+    return {"lt": np.asarray(lt), "node": np.asarray(node, np.int32),
+            "val": np.asarray(val), "tomb": np.asarray(tomb),
+            "valid": np.asarray(occ)}

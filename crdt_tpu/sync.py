@@ -102,10 +102,16 @@ def sync_packed(local, remote, since=_SAME_ROUND) -> Hlc:
     # explicit watermark, the same bound governs both halves.
     push_bound = None if since is _SAME_ROUND else since
     pull_bound = watermark if since is _SAME_ROUND else since
-    packed, ids = local.pack_since(push_bound)
+    # In-process twin of the hello negotiation: the sem tag lane rides
+    # only when BOTH replicas expose the typed surface (docs/TYPES.md);
+    # otherwise typed rows are withheld, never stripped of their tags.
+    from .net import _pack_for_peer
+    sem_ok = (hasattr(local, "set_semantics")
+              and hasattr(remote, "set_semantics"))
+    packed, ids = _pack_for_peer(local, push_bound, sem_ok)
     if packed.k:
         remote.merge_packed(packed, ids)
-    pulled, pulled_ids = remote.pack_since(pull_bound)
+    pulled, pulled_ids = _pack_for_peer(remote, pull_bound, sem_ok)
     if pulled.k:
         local.merge_packed(pulled, pulled_ids)
     return watermark

@@ -1,0 +1,74 @@
+"""PN-counter metrics workload: per-replica in-flight request gauges.
+
+Three web replicas each track their own "requests in flight" gauge —
+increment on arrival, decrement on completion — and gossip typed
+packed deltas so every replica can report the cluster-wide total.
+
+The load-bearing pattern is ONE WRITER PER SLOT: the pncounter join
+is a per-half max (each replica's lane converges to the largest
+pos/neg counts ever shipped for that slot), not a sum, so two
+replicas incrementing the SAME slot would collapse to the max instead
+of adding. Giving each replica its own slot and summing across slots
+at read time is the dense-store form of the classic per-actor-entry
+PN-counter. The full contract is in docs/TYPES.md.
+"""
+
+from crdt_tpu.models.dense_crdt import DenseCrdt
+
+N_REPLICAS = 3
+GAUGE_SLOTS = list(range(N_REPLICAS))   # slot i: replica i's gauge
+
+
+def make_replica(i: int) -> DenseCrdt:
+    c = DenseCrdt(f"web-{i}", n_slots=8)
+    # Replica-local configuration: every replica types the same slots
+    # the same way BEFORE syncing them (docs/TYPES.md, rollout rules).
+    c.set_semantics(GAUGE_SLOTS, "pncounter")
+    return c
+
+
+def exchange(a: DenseCrdt, b: DenseCrdt) -> None:
+    """One bidirectional typed sync round over the packed wire form.
+
+    `sem_mode="include"` is what a negotiated `semantics` hello
+    session ships; both ends here are typed, so nothing is withheld.
+    """
+    pa, ids_a = a.pack_since(None, sem_mode="include")
+    pb, ids_b = b.pack_since(None, sem_mode="include")
+    b.merge_packed(pa, ids_a)
+    a.merge_packed(pb, ids_b)
+
+
+def main() -> None:
+    replicas = [make_replica(i) for i in range(N_REPLICAS)]
+
+    # Each replica records only its own traffic (one writer per slot):
+    # (requests started, requests finished).
+    traffic = [(40, 37), (25, 25), (60, 52)]
+    for i, (started, finished) in enumerate(traffic):
+        replicas[i].counter_add(i, started)
+        replicas[i].counter_add(i, -finished)
+        print(f"web-{i}: started={started} finished={finished} "
+              f"local gauge={replicas[i].counter_value(i)}")
+
+    # Gossip around the ring until everyone has seen everything.
+    exchange(replicas[0], replicas[1])
+    exchange(replicas[1], replicas[2])
+    exchange(replicas[0], replicas[1])
+
+    expected = sum(s - f for s, f in traffic)
+    for r in replicas:
+        total = sum(r.counter_value(s) for s in GAUGE_SLOTS)
+        print(f"{r.node_id}: cluster in-flight = {total}")
+        assert total == expected, (r.node_id, total, expected)
+
+    # Redelivery is free: the join is idempotent, so a duplicated
+    # gossip round cannot double-count.
+    exchange(replicas[0], replicas[2])
+    assert sum(replicas[0].counter_value(s)
+               for s in GAUGE_SLOTS) == expected
+    print(f"converged at {expected} in-flight across the cluster")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,5 +1,6 @@
 """Shim: the conformance kit is exported as crdt_tpu.testing."""
 
-from crdt_tpu.testing import CrdtConformance, FakeClock
+from crdt_tpu.testing import (CrdtConformance, FakeClock,
+                              SemanticsConformance)
 
-__all__ = ["CrdtConformance", "FakeClock"]
+__all__ = ["CrdtConformance", "FakeClock", "SemanticsConformance"]

@@ -1,0 +1,60 @@
+"""Deliberately BROKEN counter kernel — crdtlint self-test fixture.
+
+A typed-lane bug class the semantics registry exists to catch: the
+counter "join" below applies the remote value as an INCREMENT
+(``2*local + remote``) instead of taking the per-lane max the real
+`semantics.kernels` gcounter join uses. Increment application is not
+a semilattice join — re-delivering the same delta changes the value
+again (no idempotence) and the two merge orders disagree (no
+commutativity) — so the seeded law search must find a counterexample
+and print the violating input:
+
+    python -m crdt_tpu.analysis --law-fixture tests/fixtures/broken_counter.py
+
+The clock lanes are kept CORRECT on purpose (strict (lt, node) lex,
+winner adoption): the breakage is confined to the value lattice,
+proving the law harness sees typed-value bugs even when every clock
+guard passes — exactly the blind spot a hand-written counter merge
+would ship with.
+"""
+
+import jax
+import jax.numpy as jnp
+
+from crdt_tpu.analysis.lattice_laws import make_wire_join_target
+from crdt_tpu.ops.dense import DenseStore, _NEG
+
+
+@jax.jit
+def skewed_counter_join_step(store: DenseStore, lt, node, val, tomb,
+                             valid, stamp_lt, local_node):
+    """Counter wire join with the max→increment bug planted."""
+    lt = jnp.where(valid, lt, _NEG)
+    node = node.astype(jnp.int32)
+    val = val.astype(jnp.int64)
+    remote_newer = ((lt > store.lt) |
+                    ((lt == store.lt) & (node > store.node)))
+    take = valid & (~store.occupied | remote_newer)
+    both = valid & store.occupied
+    # BUG: increment application instead of a per-lane max join —
+    # 2*local + remote is neither commutative nor idempotent.
+    joined = jnp.where(both, 2 * store.val + val,
+                       jnp.where(take, val, store.val))
+    win = take | (valid & (joined != store.val))
+    new_store = DenseStore(
+        lt=jnp.where(take, lt, store.lt),
+        node=jnp.where(take, node, store.node),
+        val=joined,
+        mod_lt=jnp.where(win, stamp_lt, store.mod_lt),
+        mod_node=jnp.where(win, local_node, store.mod_node),
+        occupied=store.occupied | valid,
+        tomb=jnp.where(take, tomb, store.tomb),
+    )
+    return new_store, win
+
+
+LAW_TARGETS = [
+    make_wire_join_target(skewed_counter_join_step,
+                          "broken-counter-join",
+                          notes="max→increment planted bug"),
+]

@@ -82,7 +82,8 @@ def test_hello_negotiates_cap_intersection():
         with PeerConnection(server.host, server.port,
                             timeout=5.0) as conn:
             conn.ensure()
-            assert conn.caps == frozenset({"zlib", "packed"})
+            assert conn.caps == frozenset({"zlib", "packed",
+                                           "semantics"})
             assert not conn.legacy
         with PeerConnection(server.host, server.port, timeout=5.0,
                             want_caps=("zlib",)) as conn:
@@ -495,3 +496,105 @@ def test_sync_packed_in_process_matches_wire_semantics():
             break
     else:
         raise AssertionError("clocks never settled")
+
+
+# --- semantics on the wire: cache keying + downgrade compatibility ---
+
+
+def test_pack_cache_keyed_on_semantics_version_and_mode():
+    # satellite regression: a semantics migration must invalidate
+    # cached packs (the key carries the column version), and the two
+    # negotiated modes get DISTINCT entries under one watermark
+    crdt = DenseCrdt("n", n_slots=64)
+    crdt.put_batch([1, 2], [10, 20])
+    p1, _ = crdt.pack_since(None)
+    p1b, _ = crdt.pack_since(None)
+    assert p1b is p1 and p1.sem is None      # plain repeat: cached
+    crdt.set_semantics([2], "gcounter")      # migration: invalidates
+    p2, _ = crdt.pack_since(None, sem_mode="include")
+    assert p2 is not p1 and p2.sem is not None
+    assert set(p2.slots) == {1, 2}
+    p3, _ = crdt.pack_since(None, sem_mode="withhold")
+    assert p3 is not p2 and p3.sem is None
+    assert list(p3.slots) == [1]             # typed row stays home
+    p2b, _ = crdt.pack_since(None, sem_mode="include")
+    p3b, _ = crdt.pack_since(None, sem_mode="withhold")
+    assert p2b is p2 and p3b is p3           # modes cache side by side
+    crdt.set_semantics([2], "lww")           # migrating BACK also
+    p4, _ = crdt.pack_since(None)            # invalidates
+    assert p4 is not p1 and p4 is not p2 and p4.sem is None
+    assert set(p4.slots) == {1, 2}
+
+
+def test_packed_round_negotiated_session_ships_typed_slots():
+    a = DenseCrdt("a", n_slots=64)
+    b = DenseCrdt("b", n_slots=64)
+    for c in (a, b):
+        c.set_semantics([0], "pncounter")
+    a.counter_add(0, 7)
+    a.put_batch([5], [50])
+    with SyncServer(b) as server:
+        with PeerConnection(server.host, server.port,
+                            timeout=5.0) as conn:
+            sync_packed_over_conn(a, conn, since=None,
+                                  lock=server.lock)
+            assert "semantics" in conn.caps
+    assert b.counter_value(0) == 7 and b.get(5) == 50
+
+
+def test_packed_round_without_semantics_cap_withholds_both_ways():
+    # "LWW-only peer" compatibility, BOTH directions of one round: a
+    # session that did not negotiate the semantics cap moves only LWW
+    # rows — typed slots are withheld at each sender (never shipped
+    # tagless, never corrupted at the receiver) and counted in the
+    # downgrade metric
+    from crdt_tpu.obs.registry import default_registry
+    a = DenseCrdt("a", n_slots=64)
+    b = DenseCrdt("b", n_slots=64)
+    for c in (a, b):
+        c.set_semantics([0], "gcounter")
+    a.counter_add(0, 7)      # typed write at the client
+    a.put_batch([5], [50])
+    b.counter_add(0, 3)      # typed write at the server
+    b.put_batch([6], [60])
+    counter = default_registry().counter(
+        "crdt_tpu_sync_semantics_downgrade_total")
+    out_a = counter.value(direction="outbound", node="a")
+    out_b = counter.value(direction="outbound", node="b")
+    with SyncServer(b) as server:
+        # the client deliberately does NOT want the semantics cap —
+        # the stand-in for an older LWW-only build on either end
+        with PeerConnection(server.host, server.port, timeout=5.0,
+                            want_caps=("zlib", "packed")) as conn:
+            sync_packed_over_conn(a, conn, since=None,
+                                  lock=server.lock)
+            assert "semantics" not in conn.caps
+    # push half: a's typed row stayed home, b's lattice untouched
+    assert b.counter_value(0) == 3 and b.get(5) == 50
+    # pull half: b's typed row stayed home, a's lattice untouched
+    assert a.counter_value(0) == 7 and a.get(6) == 60
+    assert counter.value(direction="outbound", node="a") == out_a + 1
+    assert counter.value(direction="outbound", node="b") == out_b + 1
+
+
+def test_gossip_downgrade_is_sticky_and_converges_lww_rows():
+    # a mesh mixing a typed replica with one that never negotiates
+    # semantics keeps converging its LWW rows round after round
+    a = DenseCrdt("a", n_slots=64)
+    a.set_semantics([0], "orset")
+    a.orset_add(0, 1)
+    a.put_batch([8], [80])
+    b = DenseCrdt("b", n_slots=64)
+    b.put_batch([9], [90])
+    with SyncServer(b) as server:
+        with PeerConnection(server.host, server.port, timeout=5.0,
+                            want_caps=("zlib", "packed")) as conn:
+            mark = sync_packed_over_conn(a, conn, since=None,
+                                         lock=server.lock)
+            a.put_batch([10], [100])
+            sync_packed_over_conn(a, conn, since=mark,
+                                  lock=server.lock)
+    assert b.get(8) == 80 and b.get(9) == 90 and b.get(10) == 100
+    assert a.get(9) == 90
+    assert b.get(0) is None                   # withheld, not mangled
+    assert a.orset_members(0) == frozenset({1})

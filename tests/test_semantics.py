@@ -1,0 +1,397 @@
+"""The CRDT type zoo end to end: registry contracts, typed dense
+model behavior, the semantics-parametrized conformance suite on the
+single-device and sharded models, keyed delegation, wire downgrade
+behavior against LWW-only peers, and a mixed-semantics three-replica
+gossip round under fault injection (docs/TYPES.md)."""
+
+import random
+import time
+
+import numpy as np
+import pytest
+
+import jax
+
+from crdt_tpu import semantics
+from crdt_tpu.models.dense_crdt import DenseCrdt, ShardedDenseCrdt
+from crdt_tpu.models.keyed_dense import KeyedDenseCrdt
+from crdt_tpu.obs.registry import default_registry
+from crdt_tpu.parallel import make_fanin_mesh
+from crdt_tpu.semantics import (GCOUNTER, LWW, MVREG, ORSET, PNCOUNTER,
+                                SemanticsSpec, all_semantics, by_tag,
+                                get_semantics, names)
+from crdt_tpu.testing import FakeClock, SemanticsConformance
+
+N = 64
+BASE = 1_700_000_000_000
+
+
+# ---------------------------------------------------------------- registry
+
+
+def test_registry_ships_five_semantics_with_unique_tags():
+    specs = all_semantics()
+    assert [s.name for s in specs] == ["lww", "gcounter", "pncounter",
+                                       "orset", "mvreg"]
+    assert [s.tag for s in specs] == [0, 1, 2, 3, 4]
+    assert LWW.tag == 0   # untyped store must be all-zeros
+    for s in specs:
+        assert get_semantics(s.name) is s
+        assert by_tag(s.tag) is s
+
+
+def test_registry_rejects_duplicate_name_and_tag():
+    with pytest.raises(ValueError, match="already registered"):
+        semantics.register(SemanticsSpec(
+            name="lww", tag=99, doc="", encode=int, decode=int,
+            law_val=lambda lt, node: lt))
+    with pytest.raises(ValueError, match="already registered"):
+        semantics.register(SemanticsSpec(
+            name="fresh", tag=0, doc="", encode=int, decode=int,
+            law_val=lambda lt, node: lt))
+    with pytest.raises(KeyError, match="unknown semantics"):
+        get_semantics("nope")
+    with pytest.raises(KeyError, match="unknown semantics tag"):
+        by_tag(77)
+
+
+def test_registry_codecs_round_trip():
+    assert PNCOUNTER.decode(PNCOUNTER.encode(-5)) == -5
+    assert PNCOUNTER.decode(PNCOUNTER.encode(9)) == 9
+    assert GCOUNTER.encode(3) == 3
+    with pytest.raises(ValueError, match="non-negative"):
+        GCOUNTER.encode(-1)
+    assert ORSET.decode(ORSET.encode([1, 5])) == frozenset({1, 5})
+    with pytest.raises(ValueError, match="universe"):
+        ORSET.encode([16])
+    assert MVREG.decode(MVREG.encode(7)) == (7,)
+    with pytest.raises(ValueError, match="16-bit"):
+        MVREG.encode(0)
+
+
+def test_registry_drives_law_and_audit_target_generation():
+    # zero hand-listed targets: every registered semantics surfaces in
+    # BOTH analysis target lists, by name
+    from crdt_tpu.analysis.jaxpr_audit import (builtin_targets
+                                               as audit_builtins)
+    from crdt_tpu.analysis.lattice_laws import (builtin_targets
+                                                as law_builtins)
+    law_names = {t.name for t in law_builtins()}
+    audit_names = {t.name for t in audit_builtins(include_sharded=False)}
+    for s in all_semantics():
+        assert f"semantics.{s.name}.typed_wire_join" in law_names
+        assert f"semantics.{s.name}.typed_wire_join" in audit_names
+    assert "semantics.typed_sparse_join_step" in audit_names
+    assert "semantics.typed_fanin_step" in audit_names
+
+
+def test_cli_completeness_gate_flags_spec_missing_targets(monkeypatch):
+    from crdt_tpu.analysis.cli import _registry_completeness
+    bare = SemanticsSpec(name="bare", tag=9, doc="", encode=int,
+                         decode=int, law_val=lambda lt, node: lt)
+    monkeypatch.setattr(semantics, "all_semantics",
+                        lambda: all_semantics() + [bare])
+    rules = sorted(f.rule for f in _registry_completeness())
+    assert rules == ["semantics-missing-audit-target",
+                     "semantics-missing-law-target"]
+    for f in _registry_completeness():
+        assert "'bare'" in f.message
+    # and the shipped registry is complete
+    monkeypatch.undo()
+    assert _registry_completeness() == []
+
+
+def test_broken_counter_fixture_fails_law_search():
+    from crdt_tpu.analysis.lattice_laws import run_laws
+    from tests.fixtures.broken_counter import LAW_TARGETS
+    findings = run_laws(LAW_TARGETS, seeds=(0, 1, 2))
+    rules = {f.rule for f in findings}
+    # increment-instead-of-max breaks every law the harness checks
+    assert {"law-idempotence", "law-commutativity"} <= rules
+    for f in findings:
+        assert "violating input (seed=" in (f.detail or "")
+
+
+# ---------------------------------------------------- typed model surface
+
+
+def _dense(node_id, **kw):
+    kw.setdefault("wall_clock", FakeClock(start=BASE))
+    return DenseCrdt(node_id, N, **kw)
+
+
+def test_set_semantics_accepts_spec_name_and_tag():
+    c = _dense("a")
+    c.set_semantics([0], PNCOUNTER)
+    c.set_semantics([1], "orset")
+    c.set_semantics([2], 4)
+    assert c.semantics_of(0) is PNCOUNTER
+    assert c.semantics_of(1) is ORSET
+    assert c.semantics_of(2) is MVREG
+    assert c.semantics_of(3) is LWW
+    # resetting every typed slot back to lww collapses the column
+    c.set_semantics([0, 1, 2], "lww")
+    assert not c._has_typed
+
+
+def test_counter_ops_and_overflow_guards():
+    c = _dense("a")
+    c.set_semantics([0], "gcounter")
+    c.set_semantics([1], "pncounter")
+    assert c.counter_add(0, 5) == 5
+    assert c.counter_add(0, 2) == 7
+    assert c.counter_value(0) == 7
+    with pytest.raises(ValueError, match="grow-only"):
+        c.counter_add(0, -1)
+    assert c.counter_add(1, 10) == 10
+    assert c.counter_add(1, -25) == -15
+    assert c.counter_value(1) == -15
+    with pytest.raises((ValueError, OverflowError)):
+        c.counter_add(1, 1 << 40)
+    with pytest.raises((TypeError, ValueError)):
+        c.counter_add(2, 1)   # slot 2 is lww, not a counter
+
+
+def test_orset_add_remove_and_saturation():
+    c = _dense("a")
+    c.set_semantics([0], "orset")
+    assert c.orset_add(0, 3) == frozenset({3})
+    assert c.orset_add(0, 3) == frozenset({3})   # no-op re-add
+    assert c.orset_add(0, 7) == frozenset({3, 7})
+    assert c.orset_remove(0, 3) == frozenset({7})
+    assert c.orset_remove(0, 3) == frozenset({7})  # no-op re-remove
+    assert c.orset_members(0) == frozenset({7})
+    with pytest.raises(ValueError, match="universe"):
+        c.orset_add(0, 16)
+    for _ in range(6):   # causal length climbs 2 per add/remove pair
+        c.orset_add(0, 3)
+        c.orset_remove(0, 3)
+    c.orset_add(0, 3)    # length 15: the final odd state
+    with pytest.raises(OverflowError, match="satur"):
+        c.orset_remove(0, 3)
+
+
+def test_mvreg_put_get():
+    c = _dense("a")
+    c.set_semantics([0], "mvreg")
+    assert c.mvreg_get(0) == ()
+    c.mvreg_put(0, 42)
+    assert c.mvreg_get(0) == (42,)
+    c.mvreg_put(0, 7)    # strictly newer lt: replaces, not unions
+    assert c.mvreg_get(0) == (7,)
+
+
+def test_mvreg_equal_lt_union_across_replicas():
+    # identical frozen clocks => equal lt stamps => true concurrency:
+    # the register must UNION, newest-first, instead of dropping one
+    a = DenseCrdt("a", N, wall_clock=FakeClock(start=BASE))
+    b = DenseCrdt("b", N, wall_clock=FakeClock(start=BASE))
+    for c in (a, b):
+        c.set_semantics([0], "mvreg")
+    a.mvreg_put(0, 5)
+    b.mvreg_put(0, 9)
+    cs, ids = b.export_delta()
+    a.merge(cs, ids)
+    assert a.mvreg_get(0) == (9, 5)
+
+
+def test_ingest_window_accumulates_counter_rmw():
+    c = _dense("a")
+    c.set_semantics([0], "pncounter")
+    with c.ingest():
+        for _ in range(5):
+            c.counter_add(0, 2)
+        assert c.counter_value(0) == 10   # read-your-writes overlay
+    assert c.counter_value(0) == 10
+
+
+def test_grow_preserves_semantics_column():
+    c = _dense("a")
+    c.set_semantics([0], "gcounter")
+    c.counter_add(0, 3)
+    c.grow(N * 2)
+    assert c.semantics_of(0) is GCOUNTER
+    assert c.semantics_of(N) is LWW
+    assert c.counter_value(0) == 3
+
+
+def test_merge_packed_rejects_semantics_tag_mismatch():
+    a = _dense("a")
+    b = _dense("b")
+    a.set_semantics([0], "pncounter")
+    b.set_semantics([0], "gcounter")
+    a.counter_add(0, 4)
+    pk, ids = a.pack_since(None, sem_mode="include")
+    before = b.canonical_time
+    with pytest.raises(ValueError, match="semantics tag mismatch"):
+        b.merge_packed(pk, ids)
+    # rejected BEFORE any clock mutation: the replica is untouched
+    assert b.canonical_time == before
+    assert b.counter_value(0) == 0
+
+
+# --------------------------------------- conformance suite instantiations
+
+
+class TestDenseSemanticsConformance(SemanticsConformance):
+    def make_dense(self, node_id):
+        return DenseCrdt(node_id, self.n_slots,
+                         wall_clock=FakeClock(start=BASE))
+
+
+@pytest.mark.skipif(len(jax.devices()) < 8,
+                    reason="needs 8 (virtual) devices")
+class TestShardedSemanticsConformance(SemanticsConformance):
+    def make_dense(self, node_id):
+        return ShardedDenseCrdt(node_id, self.n_slots,
+                                make_fanin_mesh(2, 4),
+                                wall_clock=FakeClock(start=BASE))
+
+
+# ------------------------------------------------------- keyed delegation
+
+
+def test_keyed_typed_ops_delegate_through_interning():
+    kc = KeyedDenseCrdt(_dense("a"))
+    kc.set_semantics(["hits", "balance"], "pncounter")
+    kc.set_semantics(["tags"], "orset")
+    kc.set_semantics(["owner"], "mvreg")
+    assert kc.semantics_of("hits") is PNCOUNTER
+    assert kc.semantics_of("never-seen") is LWW
+    assert kc.counter_add("hits", 3) == 3
+    assert kc.counter_add("balance", -2) == -2
+    assert kc.counter_value("hits") == 3
+    assert kc.orset_add("tags", 1) == frozenset({1})
+    assert kc.orset_remove("tags", 1) == frozenset()
+    assert kc.orset_members("tags") == frozenset()
+    kc.mvreg_put("owner", 77)
+    assert kc.mvreg_get("owner") == (77,)
+    # plain lww keys keep working beside typed ones
+    kc.put("plain", 5)
+    assert kc.get("plain") == 5
+
+
+# -------------------------------------------------- mixed-semantics gossip
+
+
+@pytest.mark.net
+def test_mixed_semantics_three_replica_gossip_under_faults():
+    """Three DenseCrdt replicas (packed wire, semantics negotiated)
+    gossiping through fault proxies: after a faulty phase and a
+    passthrough settle phase, every replica agrees on every typed AND
+    untyped slot."""
+    from crdt_tpu import BreakerPolicy, GossipNode, RetryPolicy
+    from crdt_tpu.testing import FaultProxy, FaultSchedule
+
+    retry = RetryPolicy(max_attempts=4, base_delay=0.001,
+                        max_delay=0.01)
+    breaker = BreakerPolicy(failure_threshold=4, reset_timeout=0.02)
+    nodes = {}
+    for name in ("a", "b", "c"):
+        crdt = DenseCrdt(name, N, wall_clock=FakeClock(start=BASE))
+        crdt.set_semantics([0], "gcounter")
+        crdt.set_semantics([1], "pncounter")
+        crdt.set_semantics([2], "orset")
+        crdt.set_semantics([3], "mvreg")
+        nodes[name] = GossipNode(crdt, retry=retry, breaker=breaker,
+                                 rng=random.Random(11))
+    proxies = {}
+    try:
+        for i, (name, node) in enumerate(sorted(nodes.items())):
+            node.start()
+            proxies[name] = FaultProxy(
+                node.host, node.port,
+                FaultSchedule(seed=i, rate=0.3,
+                              max_delay=0.005)).start()
+        for name, node in nodes.items():
+            for other, proxy in proxies.items():
+                if other != name:
+                    node.add_peer(other, proxy.host, proxy.port)
+        # one writer per counter slot pair would need 6 slots; the
+        # shared counter slots instead get a SINGLE writer ("a") —
+        # the dense counter contract — while every replica writes the
+        # multi-writer types
+        with nodes["a"].lock:
+            nodes["a"].crdt.counter_add(0, 5)
+            nodes["a"].crdt.counter_add(1, -3)
+        for i, (name, node) in enumerate(sorted(nodes.items())):
+            with node.lock:
+                node.crdt.orset_add(2, i)
+                node.crdt.mvreg_put(3, 10 + i)
+                node.crdt.put_batch([8 + i], [100 + i])
+        # faulty phase: best effort
+        for _ in range(6):
+            for node in nodes.values():
+                node.run_round()
+        # settle phase: passthrough, loop until all-ok sweeps
+        for proxy in proxies.values():
+            proxy.passthrough = True
+        deadline = time.monotonic() + 30
+        while True:
+            ok = all(v in ("ok",)
+                     for node in nodes.values()
+                     for v in node.run_round().values())
+            if ok:
+                # one more full sweep so late writes propagate through
+                # the relay replica as well
+                done = all(v == "ok"
+                           for node in nodes.values()
+                           for v in node.run_round().values())
+                if done:
+                    break
+            assert time.monotonic() < deadline, "mesh did not settle"
+        crdts = [n.crdt for n in nodes.values()]
+        base = crdts[0]
+        for other in crdts[1:]:
+            assert other.counter_value(0) == base.counter_value(0) == 5
+            assert other.counter_value(1) == base.counter_value(1) == -3
+            assert (other.orset_members(2) == base.orset_members(2)
+                    == frozenset({0, 1, 2}))
+            assert other.mvreg_get(3) == base.mvreg_get(3)
+            for slot in (8, 9, 10):
+                assert other.get(slot) == base.get(slot)
+        assert base.mvreg_get(3) != ()
+        for i, slot in enumerate((8, 9, 10)):
+            assert base.get(slot) == 100 + i
+    finally:
+        for proxy in proxies.values():
+            proxy.stop()
+        for node in nodes.values():
+            node.stop()
+
+
+# --------------------------------------------- wire downgrade (LWW peers)
+
+
+def test_pack_withhold_keeps_typed_rows_home_and_counts_them():
+    a = _dense("a")
+    a.set_semantics([0], "gcounter")
+    a.counter_add(0, 4)
+    a.put_batch([5], [50])
+    counter = default_registry().counter(
+        "crdt_tpu_sync_semantics_downgrade_total")
+    before = counter.value(direction="outbound", node="a")
+
+    pk, ids = a.pack_since(None)   # auto => withhold on a typed store
+    assert pk.sem is None
+    assert list(pk.slots) == [5]   # typed row withheld, lww row ships
+    assert counter.value(direction="outbound", node="a") == before + 1
+
+    # include mode ships the tag lane for negotiated peers
+    pk2, _ = a.pack_since(None, sem_mode="include")
+    assert pk2.sem is not None and set(pk2.slots) == {0, 5}
+
+
+def test_inbound_sem_less_frame_withholds_typed_slots():
+    # a pre-semantics peer's 5-lane frame may still name typed slots;
+    # the receiver must withhold those rows (not corrupt the lattice)
+    # and land the rest
+    a = _dense("a")
+    b = _dense("b")
+    b.set_semantics([0], "pncounter")
+    a.put_batch([0, 5], [123, 50])   # slot 0 is typed ONLY at b
+    pk, ids = a.pack_since(None)     # a is untyped: plain 5-lane pack
+    assert pk.sem is None
+    b.merge_packed(pk, ids)
+    assert b.counter_value(0) == 0   # withheld, not reinterpreted
+    assert b.get(5) == 50            # untyped row landed
