@@ -475,6 +475,52 @@ def bench_e2e_generator_only(n_keys: int, rows_per_pass: int = 128,
     return out
 
 
+def _bytes_to_wire(crdt, write, rounds: int):
+    """Median device→wire latency of one real delta: a fresh write
+    invalidates the pack cache, so every timed round pays the honest
+    full path — device delta mask + `device_get` gather into the pack
+    arena (`pack_since`), arena framing (`pack_rows`), and the
+    vectored frame send handing the arena views to the kernel.
+    Also returns the `crdt_tpu_pack_copy_bytes_total{stage=
+    "pack_rows"}` delta across all rounds — 0 means the zero-copy
+    invariant held for every frame (docs/FASTPATH.md)."""
+    import socket as _sk
+    import statistics
+    import threading
+    from crdt_tpu.net import recv_bytes_frame, send_bytes_frame
+    from crdt_tpu.obs.registry import default_registry
+    from crdt_tpu.ops.packing import pack_rows
+
+    ctr = default_registry().counter("crdt_tpu_pack_copy_bytes_total",
+                                     "")
+    tx, rx = _sk.socketpair()
+
+    def drain():
+        while recv_bytes_frame(rx) is not None:
+            pass
+
+    th = threading.Thread(target=drain, daemon=True)
+    th.start()
+    write(0)
+    crdt.pack_since(None)          # compile the mask program, fenced
+    c0 = ctr.value(stage="pack_rows")
+    times = []
+    try:
+        for i in range(rounds):
+            write(i + 1)
+            t0 = time.perf_counter()
+            packed, _ = crdt.pack_since(None)
+            _, bufs = pack_rows(packed)
+            send_bytes_frame(tx, bufs)
+            times.append(time.perf_counter() - t0)
+    finally:
+        tx.close()
+        th.join(5)
+        rx.close()
+    copies = ctr.value(stage="pack_rows") - c0
+    return round(statistics.median(times) * 1e3, 3), int(copies)
+
+
 def bench_sync(n_slots: int = 1 << 14, k: int = 256,
                rounds: int = 32) -> dict:
     """End-to-end two-replica sync over the pooled packed fast path.
@@ -572,6 +618,17 @@ def bench_sync(n_slots: int = 1 << 14, k: int = 256,
             "nochange_pack_hits": int(hit_delta),
             "pooled_connects": peer.conn.connects,
         })
+
+    # --- device→wire: zero-copy pack + vectored frame, k fresh rows ---
+    w = DenseCrdt("w", n_slots=n_slots)
+
+    def fresh_write(i):
+        slots = rng.choice(n_slots, size=k, replace=False)
+        w.put_batch(slots.tolist(), [int(s) % 1000 for s in slots])
+
+    btw_ms, copies = _bytes_to_wire(w, fresh_write, rounds)
+    out["bytes_to_wire_ms"] = btw_ms
+    out["copies"] = copies
     return out
 
 
@@ -681,6 +738,13 @@ def bench_ingest(n_slots: int = 1 << 14, rows: int = 1024,
                 "p90": round(xs[int(0.9 * (len(xs) - 1))] * 1e3, 3),
                 "max": round(xs[-1] * 1e3, 3)}
 
+    # --- device→wire for a freshly flushed delta off the same store ---
+    def fresh_write(i):
+        single.put_batch(data[i % batches], vals[i % batches])
+
+    btw_ms, copies = _bytes_to_wire(single, fresh_write,
+                                    max(4, repeats // 2))
+
     sh_min_ms = min(sh_hist) * 1e3
     return {
         "metric": "ingest_fast_lane", "unit": "puts/s",
@@ -691,6 +755,8 @@ def bench_ingest(n_slots: int = 1 << 14, rows: int = 1024,
         "staged_speedup": round(unbatched_s / staged_s, 3),
         "staged_flushes": flushes,
         "flush_ms": ms(hist),
+        "bytes_to_wire_ms": btw_ms,
+        "copies": copies,
         "single_dispatch_floor_ms": round(single_floor, 3),
         "sharded": {
             "mesh": f"(replica={mesh.shape['replica']}, "

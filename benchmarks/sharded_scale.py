@@ -33,7 +33,16 @@ import jax
 # plugin via sitecustomize, so the env var alone cannot switch to the
 # virtual CPU mesh (tests/conftest.py does the same).
 jax.config.update("jax_platforms", "cpu")
-jax.config.update("jax_num_cpu_devices", 8)
+try:
+    jax.config.update("jax_num_cpu_devices", 8)
+except AttributeError:
+    # Older jax spells the same pre-init knob as an XLA flag; we are
+    # still before backend init, so the env route works here too.
+    import os
+    _flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in _flags:
+        os.environ["XLA_FLAGS"] = (
+            _flags + " --xla_force_host_platform_device_count=8").strip()
 
 import numpy as np  # noqa: E402
 import jax.numpy as jnp  # noqa: E402

@@ -72,6 +72,35 @@ def _registry_completeness() -> List:
     return out
 
 
+# Fast-path kernels the default run must find in the jaxpr-audit
+# registry: shipping either without audit coverage would let an
+# order-sensitivity hazard into the hottest dispatch (docs/FASTPATH.md).
+_FASTPATH_REQUIRED = (
+    "dense.merge_repack_step",
+    "pallas.ingest_scatter_tiles[interpret]",
+)
+
+
+def _fastpath_completeness(target_names) -> List:
+    """The fast-path CI gate: the fused merge+repack program and the
+    touched-tile ingest scatter must be registered audit targets — an
+    unregistered fast-path kernel fails the default run."""
+    from .findings import Finding
+    names = set(target_names)
+    out = []
+    for req in _FASTPATH_REQUIRED:
+        if req not in names:
+            out.append(Finding(
+                rule="fastpath-kernel-unregistered",
+                path="crdt_tpu/analysis/jaxpr_audit.py", line=0,
+                message=f"fast-path kernel {req!r} is not a "
+                        "registered jaxpr-audit target",
+                detail="add it to builtin_targets() so the audit "
+                       "covers the fused/zero-copy dispatch path "
+                       "(docs/FASTPATH.md)"))
+    return out
+
+
 def main(argv=None) -> int:
     parser = argparse.ArgumentParser(
         prog="python -m crdt_tpu.analysis",
@@ -136,7 +165,10 @@ def main(argv=None) -> int:
         if not args.skip_jaxpr:
             from .jaxpr_audit import audit_all, builtin_targets as \
                 audit_targets
-            reports, audit_findings = audit_all(audit_targets())
+            targets = audit_targets()
+            findings.extend(_fastpath_completeness(
+                t.name for t in targets))
+            reports, audit_findings = audit_all(targets)
             findings.extend(audit_findings)
 
     findings.sort(key=lambda f: (f.path, f.line, f.rule))

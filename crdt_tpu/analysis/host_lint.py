@@ -27,11 +27,20 @@ reproduced bugs):
   aliased and its contents are undefined after the call.
 - ``scatter-combiner-bypass`` — calling a store scatter wrapper
   (``put_scatter``/``record_scatter``/``delete_scatter``/
-  ``ingest_scatter``) in a function with no visible ingest gate (no
-  ``drain_ingest`` call and no ``_ingest`` check before the call); a
-  staged ``ingest()`` window would commit its backlog AFTER such a
-  write, stamping over it out of HLC order. The combiner's own flush
-  is the one sanctioned direct writer (reasoned suppression).
+  ``ingest_scatter``/``ingest_scatter_tiles``) in a function with no
+  visible ingest gate (no ``drain_ingest`` call and no ``_ingest``
+  check before the call); a staged ``ingest()`` window would commit
+  its backlog AFTER such a write, stamping over it out of HLC order.
+  The combiner's own flush is the one sanctioned direct writer
+  (reasoned suppression).
+- ``pack-path-extra-copy`` — a materializing call (``bytes(...)``,
+  ``.tobytes()``, ``np.asarray``/``np.ascontiguousarray``/
+  ``np.array``) inside a pack→frame function; the zero-copy fast path
+  frames memoryviews over the pack arena directly, and every stray
+  copy silently re-inflates bytes-to-wire latency. Legitimate copies
+  (a device_get, normalizing a foreign lane) carry reasoned
+  suppressions and are counted in
+  ``crdt_tpu_pack_copy_bytes_total`` (docs/FASTPATH.md).
 
 The linter is purely lexical/AST — no imports of the linted code — so
 it runs on broken or unimportable files (the self-test fixtures).
@@ -61,6 +70,7 @@ RULES = (
     "add-batch-unique-keys",
     "donated-buffer-reuse",
     "scatter-combiner-bypass",
+    "pack-path-extra-copy",
     "suppression-without-reason",
 )
 
@@ -72,11 +82,18 @@ _WALL_CALLS = {
 }
 _HLC_ATTRS = {"hlc", "canonical_time", "_canonical_time", "logical_time"}
 _DONATING_WRAPPERS = {"put_scatter", "record_scatter", "delete_scatter",
-                      "ingest_scatter"}
+                      "ingest_scatter", "ingest_scatter_tiles"}
 _COMBINER_SCATTERS = _DONATING_WRAPPERS
 # Lexical evidence that a function respects the write-combiner barrier:
 # it drains the window, or it branches on the staging handle.
 _COMBINER_GATES = {"drain_ingest", "_ingest"}
+# pack-path-extra-copy fires only inside functions on the pack→frame
+# path: names containing "pack" (but not the unpack/decode direction
+# or the merge ingest surface, whose np.asarray lane normalization is
+# the WIRE-IN side), plus the framing entry points by exact name.
+_PACK_PATH_EXACT = {"encode", "send_bytes_frame"}
+_PACK_COPY_CALLS = {"np.asarray", "np.ascontiguousarray",
+                    "numpy.asarray", "numpy.ascontiguousarray"}
 
 
 def _dotted(node: ast.AST) -> Optional[str]:
@@ -433,6 +450,51 @@ def _check_combiner_bypass(tree: ast.AST, path: str) -> List[Finding]:
     return out
 
 
+# --- rule: pack-path-extra-copy ---
+
+def _on_pack_path(name: str) -> bool:
+    """Pack→frame functions only: the OUTBOUND direction. ``unpack``
+    (wire-in decode) and ``merge`` (ingest surface — its np.asarray
+    calls normalize PEER lanes, not the local pack) are the two name
+    families that legitimately materialize."""
+    low = name.lower()
+    if low in _PACK_PATH_EXACT:
+        return True
+    return "pack" in low and "unpack" not in low and "merge" not in low
+
+
+def _check_pack_path_copies(tree: ast.AST, path: str) -> List[Finding]:
+    out: List[Finding] = []
+    for fn in _functions(tree):
+        if not _on_pack_path(fn.name):
+            continue
+        for node in ast.walk(fn):
+            if not isinstance(node, ast.Call):
+                continue
+            d = _dotted(node.func)
+            what = None
+            if d == "bytes":
+                what = "bytes(...)"
+            elif d in _PACK_COPY_CALLS:
+                what = f"{d}(...)"
+            elif isinstance(node.func, ast.Attribute) \
+                    and node.func.attr == "tobytes":
+                what = ".tobytes()"
+            if what is None:
+                continue
+            out.append(Finding(
+                rule="pack-path-extra-copy", path=path,
+                line=node.lineno,
+                message=f"{what} in pack-path function {fn.name}() "
+                        "materializes a copy between pack and frame; "
+                        "the fast path frames memoryviews over the "
+                        "pack arena directly — if this copy is "
+                        "required (device_get, foreign-lane "
+                        "normalization), suppress with a reason and "
+                        "count it in crdt_tpu_pack_copy_bytes_total"))
+    return out
+
+
 _ALL_CHECKS = (
     _check_sockets,
     _check_lock_discipline,
@@ -441,6 +503,7 @@ _ALL_CHECKS = (
     _check_add_batch,
     _check_donated_reuse,
     _check_combiner_bypass,
+    _check_pack_path_copies,
 )
 
 

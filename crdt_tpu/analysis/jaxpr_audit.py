@@ -302,6 +302,14 @@ def builtin_targets(include_sharded: bool = True) -> List[AuditTarget]:
         build=lambda: jax.make_jaxpr(dense_ops._delete_scatter(False))(
             dense_store(), i64(_M), np.int64(0), np.int32(0))))
 
+    targets.append(AuditTarget(
+        name="dense.merge_repack_step", unique_slots=True,
+        notes="fused gossip-relay join + next-pack delta mask in one "
+              "program; dict-keyed delta cannot repeat a slot",
+        build=lambda: jax.make_jaxpr(dense_ops._merge_repack_jit(False))(
+            dense_store(), i64(_M), i64(_M), i32(_M), i64(_M), b8(_M),
+            b8(_M), np.int64(0), np.int32(0), np.int64(0))))
+
     # Typed lane kernels (crdt_tpu/semantics): the shared sparse
     # scatter and fan-in shapes here, plus one per-tag elementwise
     # wire-join target per registered semantics from the registry
@@ -334,6 +342,14 @@ def builtin_targets(include_sharded: bool = True) -> List[AuditTarget]:
         notes="Mosaic fan-in kernel at N=TILE, traced in interpret "
               "mode; walked into the pallas_call jaxpr",
         build=_build_pallas_step))
+
+    targets.append(AuditTarget(
+        name="pallas.ingest_scatter_tiles[interpret]",
+        unique_slots=True,
+        notes="touched-tile ingest commit kernel (ops/pallas_scatter); "
+              "combiner dedups slots before host prep; interpret "
+              "mode, trace-only",
+        build=_build_pallas_ingest_scatter))
 
     # The per-shard body of parallel/fanin.py's _pallas_fanin_block
     # (split -> pallas_fanin_batch -> join) audited at the per-device
@@ -390,6 +406,24 @@ def _build_pallas_step():
 
     return jax.make_jaxpr(step)(store, cs, np.int64(0), np.int32(0),
                                 np.int64(0))
+
+
+def _build_pallas_ingest_scatter():
+    import jax
+    import numpy as np
+    from ..ops import pallas_scatter as ps
+    from ..ops.dense import empty_dense_store
+
+    n = ps.TILE  # one touched tile
+    store = empty_dense_store(n)
+    tile_ids = np.zeros((1,), np.int32)
+    valid = np.zeros((ps._SB, ps._LANE), np.int32)
+    lt_d = np.zeros((ps._SB, ps._LANE), np.int64)
+    val_d = np.zeros((ps._SB, ps._LANE), np.int64)
+    tomb_d = np.zeros((ps._SB, ps._LANE), np.int32)
+    me = np.zeros((1,), np.int32)
+    return jax.make_jaxpr(ps._scatter_jit(False, True))(
+        store, tile_ids, valid, lt_d, val_d, tomb_d, me)
 
 
 def _build_pallas_block_per_shard():

@@ -569,10 +569,13 @@ class GossipNode:
                         else "json")
             peer.last_attempt = mode
             if mode == "packed":
+                # Gossip relays take the fused merge+repack dispatch:
+                # the pulled delta's join also seeds the next round's
+                # pack under this round's watermark.
                 return sync_packed_over_conn(
                     self.crdt, conn, since=peer.watermark,
                     lock=self.server.lock, tally=tally,
-                    _prepacked=prepacked)
+                    _prepacked=prepacked, fused_repack=True)
             if mode == "dense":
                 return sync_dense_over_conn(
                     self.crdt, conn, since=peer.watermark,

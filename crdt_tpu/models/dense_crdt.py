@@ -43,10 +43,10 @@ from ..ops.dense import (DenseChangeset, DenseStore, FaninResult, _NEG,
                          delete_scatter, dense_delta_mask,
                          dense_max_logical_time,
                          empty_dense_store, fanin_step, fanin_stream,
-                         pad_replica_rows, put_scatter, sparse_fanin_step,
-                         store_to_changeset)
+                         merge_repack_step, pad_replica_rows, put_scatter,
+                         sparse_fanin_step, store_to_changeset)
 from ..ops.merge import recv_guards
-from ..ops.packing import NodeTable, PackedDelta
+from ..ops.packing import NodeTable, PackedDelta, pack_into_arena
 from ..record import (KeyDecoder, KeyEncoder, Record, ValueDecoder,
                       ValueEncoder)
 from ..utils.stats import MergeStats, merge_annotation
@@ -1331,7 +1331,9 @@ class DenseCrdt:
 
     def _merge_validated(self, slots: np.ndarray, lt: np.ndarray,
                          node: np.ndarray, val: np.ndarray,
-                         tomb: np.ndarray, sem_ok: bool = False) -> None:
+                         tomb: np.ndarray, sem_ok: bool = False,
+                         repack_since_lt: Optional[int] = None
+                         ) -> Optional[jax.Array]:
         """Columnar merge tail on fully validated int lanes: recv fold,
         store join, watch emission, final send bump. ``node`` already
         holds LOCAL ordinals; stats counters are the caller's job up to
@@ -1343,7 +1345,13 @@ class DenseCrdt:
         an LWW-framed wire (record dicts, JSON, pre-semantics packed
         frames) cannot prove it joins under the right lattice, and
         joining a counter lane by LWW would corrupt it. Withheld rows
-        count in ``crdt_tpu_sync_semantics_downgrade_total``."""
+        count in ``crdt_tpu_sync_semantics_downgrade_total``.
+
+        ``repack_since_lt`` asks the join to ALSO emit the next pack's
+        delta mask (``mod_lt >= since``) from the same fused program
+        (`merge_and_repack`); returns that device mask when the sparse
+        fused path ran, None otherwise (wide/typed/withheld-empty join,
+        where the caller falls back to a separate `pack_since`)."""
         if not sem_ok and self._sem is not None:
             typed = self._sem[slots] != 0
             if typed.any():
@@ -1367,7 +1375,7 @@ class DenseCrdt:
                     self._canonical_time = Hlc.send(
                         self._canonical_time,
                         millis=self._wall_clock())
-                    return
+                    return None
         k = len(slots)
         my_ord = self._table.ordinal(self._node_id)
         wall = self._wall_clock()
@@ -1392,8 +1400,10 @@ class DenseCrdt:
 
         with merge_annotation("crdt_tpu.dense_merge",
                               hlc=lambda: self._canonical_time):
-            new_store, win, slot_aligned = self._dispatch_columns(
-                slots, lt, node, val, tomb, new_canonical, my_ord)
+            new_store, win, slot_aligned, repack_mask = \
+                self._dispatch_columns(slots, lt, node, val, tomb,
+                                       new_canonical, my_ord,
+                                       repack_since_lt=repack_since_lt)
         self._store = self._postprocess_store(new_store)
         # The join produced fresh buffers (the old lanes were consumed
         # — donated when eligible); the next columnar merge may donate
@@ -1441,6 +1451,7 @@ class DenseCrdt:
         self._canonical_time = Hlc.send(
             Hlc.from_logical_time(new_canonical, self._node_id),
             millis=self._wall_clock())
+        return repack_mask
 
     # Above this fraction of the slot space a columnar delta executes
     # as the elementwise N-wide join instead of the k-index scatter:
@@ -1452,13 +1463,18 @@ class DenseCrdt:
     WIDE_JOIN_FRACTION = 4
 
     def _dispatch_columns(self, slots, lt, node, val, tomb,
-                          new_canonical: int, my_ord: int):
+                          new_canonical: int, my_ord: int,
+                          repack_since_lt: Optional[int] = None):
         """Run a validated columnar delta through the store join.
-        Returns ``(new_store, win, slot_aligned)`` — ``win`` is per
-        SLOT (N-wide) when ``slot_aligned``, else per payload entry."""
+        Returns ``(new_store, win, slot_aligned, repack_mask)`` —
+        ``win`` is per SLOT (N-wide) when ``slot_aligned``, else per
+        payload entry. ``repack_mask`` is the fused next-pack delta
+        mask when ``repack_since_lt`` was requested AND the sparse
+        fused kernel ran; None on every other route."""
         if self._sem is not None:
             return self._dispatch_columns_typed(
-                slots, lt, node, val, tomb, new_canonical, my_ord)
+                slots, lt, node, val, tomb, new_canonical,
+                my_ord) + (None,)
         k = len(slots)
         n = self.n_slots
         if k * self.WIDE_JOIN_FRACTION >= n:
@@ -1488,7 +1504,7 @@ class DenseCrdt:
                 jnp.asarray(valid_n), jnp.int64(new_canonical),
                 jnp.int32(my_ord), donate=self._donate_writes(),
                 sharding=self._write_sharding())
-            return new_store, win, True
+            return new_store, win, True, None
         # Pad k to a power of two (invalid rows scatter to the n_slots
         # sentinel, mode="drop") so the jitted step compiles O(log k)
         # distinct shapes, not one per delta size.
@@ -1507,13 +1523,26 @@ class DenseCrdt:
         node_p[:k] = node
         val_p[:k] = val
         tomb_p[:k] = tomb
+        if repack_since_lt is not None:
+            # Fused relay: the join AND the next pack's delta mask come
+            # out of ONE jitted program — no second dispatch between a
+            # gossip merge and the reply pack (docs/FASTPATH.md).
+            new_store, win, mask = merge_repack_step(
+                self._store, jnp.asarray(slot_arr), jnp.asarray(lt_p),
+                jnp.asarray(node_p), jnp.asarray(val_p),
+                jnp.asarray(tomb_p), jnp.asarray(valid),
+                jnp.int64(new_canonical), jnp.int32(my_ord),
+                jnp.int64(repack_since_lt),
+                donate=self._donate_writes(),
+                sharding=self._write_sharding())
+            return new_store, win, False, mask
         new_store, win = sparse_fanin_step(
             self._store, jnp.asarray(slot_arr), jnp.asarray(lt_p),
             jnp.asarray(node_p), jnp.asarray(val_p),
             jnp.asarray(tomb_p), jnp.asarray(valid),
             jnp.int64(new_canonical), jnp.int32(my_ord),
             donate=self._donate_writes(), sharding=self._write_sharding())
-        return new_store, win, False
+        return new_store, win, False, None
 
     def _dispatch_columns_typed(self, slots, lt, node, val, tomb,
                                 new_canonical: int, my_ord: int):
@@ -1813,6 +1842,64 @@ class DenseCrdt:
         """Hook for subclasses to re-annotate a freshly written store
         (the sharded model re-applies its NamedSharding here)."""
         return store
+
+    def _use_pallas_scatter(self) -> bool:
+        """Route the ingest commit through the touched-tile Mosaic
+        kernel? Stamp-blind overwrites don't care about the store's
+        semantics tags or table width, so the gates are only tile
+        alignment and a backend Mosaic can lower on (interpret mode
+        stands in off-TPU when forced)."""
+        from ..ops.pallas_merge import TILE
+        if self.n_slots % TILE:
+            return False
+        if self._executor == "xla":
+            return False
+        if self._executor in ("pallas", "pallas-interpret"):
+            return True
+        return jax.devices()[0].platform == "tpu"
+
+    def _commit_scatter(self, slots: np.ndarray, lt: np.ndarray,
+                        vals: np.ndarray, tombs: np.ndarray
+                        ) -> DenseStore:
+        """ONE device dispatch committing a deduped ingest batch
+        (`WriteCombiner.flush`'s scatter tail). Picks the touched-tile
+        Mosaic kernel when it engages, else the lax scatter with
+        power-of-two padded lanes; the sharded model overrides this
+        with one `shard_map` program (docs/FASTPATH.md)."""
+        me = self._table.ordinal(self.node_id)
+        if self._use_pallas_scatter():
+            from ..ops.pallas_scatter import ingest_scatter_tiles
+            # crdtlint: disable=scatter-combiner-bypass -- only reached from the combiner's own flush, which IS the barrier
+            return ingest_scatter_tiles(
+                self._store, slots, lt, vals, tombs, me,
+                donate=self._donate_writes(),
+                interpret=self._executor == "pallas-interpret")
+        # Fresh padded commit lanes every flush (power-of-two + slot ==
+        # n_slots sentinel rows, mode="drop"): the dispatch owns them
+        # outright, so the combiner's stage-side buffers are
+        # immediately reusable — the double-buffer that lets the host
+        # stage flush N+1 while N executes.
+        d = len(slots)
+        padded = 1 << max(d - 1, 1).bit_length()
+        slot_l = np.full(padded, self.n_slots, np.int32)
+        lt_l = np.zeros(padded, np.int64)
+        val_l = np.zeros(padded, np.int64)
+        tomb_l = np.zeros(padded, bool)
+        slot_l[:d] = slots
+        lt_l[:d] = lt
+        val_l[:d] = vals
+        tomb_l[:d] = tombs
+        from ..ops.dense import ingest_scatter
+        sharding = self._write_sharding()
+        # crdtlint: disable=scatter-combiner-bypass -- only reached from the combiner's own flush, which IS the barrier
+        new_store = ingest_scatter(
+            self._store, jnp.asarray(slot_l), jnp.asarray(lt_l),
+            jnp.asarray(val_l), jnp.asarray(tomb_l), jnp.int32(me),
+            donate=self._donate_writes(), sharding=sharding)
+        # The in-jit constraint already pinned the layout; skip the
+        # subclass re-shard round-trip in that case.
+        return new_store if sharding is not None \
+            else self._postprocess_store(new_store)
 
     def _raise_guard(self, cs: DenseChangeset, res, wall: int) -> None:
         # Store untouched; canonical rolled to the pre-failure value
@@ -2188,8 +2275,61 @@ class DenseCrdt:
 
     # pack_since cache depth: a replica gossips a handful of peers with
     # (usually) one shared watermark frontier per store state; slots
-    # beyond that are churn, not reuse.
+    # beyond that are churn, not reuse. Depth is enforced by LRU
+    # eviction (`_pack_cache_store`), so a peer churn storm — 100
+    # distinct watermarks against one store state — cannot grow the
+    # cache past this bound; evictions are counted in
+    # ``crdt_tpu_pack_cache_evictions_total``.
     PACK_CACHE_SLOTS = 4
+
+    def _resolve_sem_mode(self, sem_mode: str) -> str:
+        if sem_mode not in ("auto", "include", "withhold"):
+            raise ValueError(f"unknown sem_mode {sem_mode!r}")
+        # "plain": untyped store — no lane to attach, nothing to
+        # withhold (the seed wire form, whatever the caller asked).
+        return "plain" if self._sem is None else (
+            "withhold" if sem_mode == "auto" else sem_mode)
+
+    def _pack_host_columns(self, mask: np.ndarray, lt: np.ndarray,
+                           node: np.ndarray, val: np.ndarray,
+                           tomb: np.ndarray,
+                           resolved: str) -> PackedDelta:
+        """Select the masked rows and land them in ONE arena
+        (`ops.packing.pack_into_arena`) — the zero-copy pack tail
+        shared by `pack_since` and `merge_and_repack`. The arena's
+        views are the exact buffers `pack_rows` frames for the wire."""
+        idx = np.nonzero(mask)[0]
+        sem_src = None
+        if resolved == "withhold":
+            typed = self._sem[idx] != 0
+            withheld = int(typed.sum())
+            if withheld:
+                from ..obs.registry import default_registry
+                default_registry().counter(
+                    "crdt_tpu_sync_semantics_downgrade_total",
+                    "typed rows withheld from LWW-only wire forms "
+                    "by direction").inc(withheld,
+                                        direction="outbound",
+                                        node=str(self._node_id))
+                idx = idx[~typed]
+        elif resolved == "include":
+            sem_src = self._sem
+        return pack_into_arena(idx, lt, node, val, tomb, sem=sem_src)
+
+    def _pack_cache_store(self, key, out) -> None:
+        """Insert a finished pack, LRU-evicting past PACK_CACHE_SLOTS
+        with the eviction counter — churn storms stay bounded AND
+        visible."""
+        self._pack_cache[key] = out
+        if len(self._pack_cache) > self.PACK_CACHE_SLOTS:
+            from ..obs.registry import default_registry
+            ev = default_registry().counter(
+                "crdt_tpu_pack_cache_evictions_total",
+                "pack_since cache entries LRU-evicted at the "
+                "PACK_CACHE_SLOTS depth bound")
+            while len(self._pack_cache) > self.PACK_CACHE_SLOTS:
+                self._pack_cache.popitem(last=False)
+                ev.inc(node=str(self._node_id))
 
     def pack_since(self, since: Optional[Hlc] = None,
                    sem_mode: str = "auto"
@@ -2222,16 +2362,11 @@ class DenseCrdt:
         (later merges may still donate)."""
         from ..obs.registry import default_registry
         from ..obs.trace import span
-        if sem_mode not in ("auto", "include", "withhold"):
-            raise ValueError(f"unknown sem_mode {sem_mode!r}")
+        resolved = self._resolve_sem_mode(sem_mode)
         # Drain BEFORE the cache key reads the canonical: a flush
         # advances the clock AND replaces the store, so a key built
         # first would alias a pre-flush pack under a stale watermark.
         self.drain_ingest()
-        # "plain": untyped store — no lane to attach, nothing to
-        # withhold (the seed wire form, whatever the caller asked).
-        resolved = "plain" if self._sem is None else (
-            "withhold" if sem_mode == "auto" else sem_mode)
         key = (None if since is None else since.logical_time,
                self._canonical_time.logical_time,
                self._sem_version, resolved)
@@ -2253,32 +2388,10 @@ class DenseCrdt:
             mask, lt, node, val, tomb = jax.device_get(
                 (mask, self._store.lt, self._store.node,
                  self._store.val, self._store.tomb))
-            idx = np.nonzero(mask)[0]
-            sem_lane = None
-            if resolved == "withhold":
-                typed = self._sem[idx] != 0
-                withheld = int(typed.sum())
-                if withheld:
-                    default_registry().counter(
-                        "crdt_tpu_sync_semantics_downgrade_total",
-                        "typed rows withheld from LWW-only wire forms "
-                        "by direction").inc(withheld,
-                                            direction="outbound",
-                                            node=str(self._node_id))
-                    idx = idx[~typed]
-            elif resolved == "include":
-                sem_lane = self._sem[idx].astype(np.uint8)
-            packed = PackedDelta(
-                slots=idx.astype(np.int32, copy=False),
-                lt=np.ascontiguousarray(lt[idx], np.int64),
-                node=node[idx].astype(np.int32, copy=False),
-                val=np.ascontiguousarray(val[idx], np.int64),
-                tomb=tomb[idx].astype(np.uint8, copy=False),
-                sem=sem_lane)
+            packed = self._pack_host_columns(mask, lt, node, val, tomb,
+                                             resolved)
         out = (packed, self._table.ids())
-        self._pack_cache[key] = out
-        while len(self._pack_cache) > self.PACK_CACHE_SLOTS:
-            self._pack_cache.popitem(last=False)
+        self._pack_cache_store(key, out)
         return out
 
     def merge_packed(self, packed: PackedDelta,
@@ -2289,6 +2402,53 @@ class DenseCrdt:
         BEFORE the first clock mutation, and duplicate slots collapse
         last-wins (`_last_wins_keep`), the same contract every other
         columnar ingest path honors. Cost is O(k) in the delta."""
+        self._merge_packed_impl(packed, node_ids, None)
+
+    def merge_and_repack(self, packed: PackedDelta,
+                         node_ids: Sequence[Any],
+                         since: Optional[Hlc] = None,
+                         sem_mode: str = "auto"
+                         ) -> Tuple[PackedDelta, List[Any]]:
+        """`merge_packed` + `pack_since` fused into ONE device
+        dispatch — the gossip relay op. The sparse join emits the next
+        pack's delta mask from the same jitted program
+        (`ops.dense.merge_repack_step`, donated store), so a relay
+        round costs one dispatch instead of merge + cache-missed
+        repack. Returns exactly what ``pack_since(since, sem_mode)``
+        would return right after the merge, and seeds the pack cache
+        under that key, so the NEXT watermark-aligned `pack_since`
+        hits. Falls back to the two-step path whenever the fused
+        kernel can't run (empty delta, wide join cutover, typed
+        store)."""
+        from ..obs.registry import default_registry
+        resolved = self._resolve_sem_mode(sem_mode)
+        since_lt = 0 if since is None else int(since.logical_time)
+        mask = self._merge_packed_impl(packed, node_ids, since_lt)
+        if mask is None:
+            return self.pack_since(since, sem_mode)
+        default_registry().counter(
+            "crdt_tpu_fused_repack_total",
+            "gossip relays served by the fused merge+repack "
+            "dispatch").inc(node=str(self._node_id))
+        key = (None if since is None else since.logical_time,
+               self._canonical_time.logical_time,
+               self._sem_version, resolved)
+        mask, lt, node, val, tomb = jax.device_get(
+            (mask, self._store.lt, self._store.node,
+             self._store.val, self._store.tomb))
+        packed_out = self._pack_host_columns(mask, lt, node, val, tomb,
+                                             resolved)
+        out = (packed_out, self._table.ids())
+        # Seed AFTER the merge assigned `_store` (the setter cleared
+        # the cache), so the entry survives until the next store
+        # replacement — exactly pack_since's lifetime rules.
+        self._pack_cache_store(key, out)
+        return out
+
+    def _merge_packed_impl(self, packed: PackedDelta,
+                           node_ids: Sequence[Any],
+                           repack_since_lt: Optional[int]
+                           ) -> Optional[jax.Array]:
         self._refuse_in_pipeline("merge_packed")  # host recv fold
         self.drain_ingest()
         slots = np.asarray(packed.slots)
@@ -2304,7 +2464,7 @@ class DenseCrdt:
             raise ValueError("packed delta lanes are ragged")
         if k == 0:
             self.merge_many([])
-            return
+            return None
         if int(ni.min()) < 0 or int(ni.max()) >= len(node_ids):
             raise ValueError(
                 f"packed node ordinal out of range for {len(node_ids)} "
@@ -2336,8 +2496,9 @@ class DenseCrdt:
         self._check_value_width(val)
         self._intern_ids(node_ids)
         node = self._table.encode(node_ids)[ni]
-        self._merge_validated(slots, lt, node, val, tomb,
-                              sem_ok=sem is not None)
+        return self._merge_validated(slots, lt, node, val, tomb,
+                                     sem_ok=sem is not None,
+                                     repack_since_lt=repack_since_lt)
 
     def _pipe_send_bump(self, wall: int) -> None:
         """The final crdt.dart:93 send bump, on device, flags
@@ -2485,28 +2646,54 @@ class ShardedDenseCrdt(DenseCrdt):
 
     def _postprocess_store(self, store):
         # Sparse scatters land with XLA-chosen output sharding; pin the
-        # key-axis NamedSharding back on (no copy when it already
-        # matches).
+        # key-axis NamedSharding back on. When every lane already
+        # carries it (the in-jit with_sharding_constraint and the
+        # shard_map programs both produce exactly this layout), skip
+        # the 7-lane device_put round-trip outright — the sub-ms
+        # dispatch path never pays for an identity re-shard.
+        from ..parallel import store_sharding
+        want = store_sharding(self._mesh)
+        try:
+            if all(getattr(lane, "sharding", None) == want
+                   for lane in store):
+                return store
+        except Exception:  # non-addressable / tracer lanes: re-pin
+            pass
         return self._shard(store)
 
     def _write_sharding(self):
         from ..parallel import store_sharding
         return store_sharding(self._mesh)
 
-    def put_batch(self, slots, values, tombs=None) -> None:
-        # The scatter's output is constrained to the store sharding
-        # inside the jit (_write_sharding); the _shard() call is then
-        # a no-copy identity device_put kept as a safety net. A staged
-        # call touched no device state at all — the combiner's flush
-        # re-shards through _postprocess_store instead.
-        super().put_batch(slots, values, tombs=tombs)
-        if self._ingest is None:
-            self._store = self._shard(self._store)
+    def _commit_scatter(self, slots, lt, vals, tombs):
+        # ONE shard_map program: every device takes its shard-local
+        # rows of the (replicated) batch — no unsharded scatter, no
+        # per-lane re-shard afterwards (the output is born on the
+        # key-axis NamedSharding).
+        from ..parallel import make_sharded_ingest
+        d = len(slots)
+        padded = 1 << max(d - 1, 1).bit_length()
+        slot_l = np.full(padded, self.n_slots,
+                         np.int32 if self.n_slots < 2 ** 31 - 1
+                         else np.int64)
+        lt_l = np.zeros(padded, np.int64)
+        val_l = np.zeros(padded, np.int64)
+        tomb_l = np.zeros(padded, bool)
+        slot_l[:d] = slots
+        lt_l[:d] = lt
+        val_l[:d] = vals
+        tomb_l[:d] = tombs
+        step = make_sharded_ingest(self._mesh, self._donate_writes())
+        return step(self._store, jnp.asarray(slot_l),
+                    jnp.asarray(lt_l), jnp.asarray(val_l),
+                    jnp.asarray(tomb_l),
+                    jnp.int32(self._table.ordinal(self.node_id)))
 
-    def delete_batch(self, slots) -> None:
-        super().delete_batch(slots)
-        if self._ingest is None:
-            self._store = self._shard(self._store)
+    # put_batch/delete_batch need no override: the unstaged scatter
+    # pins the key-axis sharding inside the jit (_write_sharding), and
+    # _postprocess_store now recognizes that layout without a re-shard
+    # dispatch; staged calls touch no device state until the
+    # combiner's flush routes through _commit_scatter.
 
     def purge(self) -> None:
         super().purge()

@@ -218,30 +218,12 @@ class WriteCombiner:
                     slots, lt, vals, tombs = (slots[keep], lt[keep],
                                               vals[keep], tombs[keep])
                 d = len(slots)
-                # Fresh padded commit lanes every flush (power-of-two
-                # + slot == n_slots sentinel rows, mode="drop"): the
-                # dispatch owns them outright, so the stage-side
-                # buffers above are immediately reusable — the
-                # double-buffer that lets the host stage flush N+1
-                # while N executes.
-                padded = 1 << max(d - 1, 1).bit_length()
-                slot_l = np.full(padded, owner.n_slots, np.int32)
-                lt_l = np.zeros(padded, np.int64)
-                val_l = np.zeros(padded, np.int64)
-                tomb_l = np.zeros(padded, bool)
-                slot_l[:d] = slots
-                lt_l[:d] = lt
-                val_l[:d] = vals
-                tomb_l[:d] = tombs
-                from ..ops.dense import ingest_scatter
-                # crdtlint: disable=scatter-combiner-bypass -- the combiner's own flush IS the barrier: it commits the staged rows this rule exists to protect
-                owner._store = owner._postprocess_store(ingest_scatter(
-                    owner._store, jnp.asarray(slot_l),
-                    jnp.asarray(lt_l), jnp.asarray(val_l),
-                    jnp.asarray(tomb_l),
-                    jnp.int32(owner._table.ordinal(owner.node_id)),
-                    donate=owner._donate_writes(),
-                    sharding=owner._write_sharding()))
+                # ONE dispatch, routed per backend: the owner picks
+                # the touched-tile Mosaic kernel, the lax scatter, or
+                # (sharded) a single shard_map program. Padding and
+                # sentinel rows live with the route that needs them.
+                owner._store = owner._commit_scatter(slots, lt, vals,
+                                                     tombs)
                 owner._store_escaped = False
             owner._canonical_time = new_canonical
             owner.stats.puts += self._groups

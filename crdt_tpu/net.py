@@ -197,17 +197,27 @@ class FrameCodec:
                ) -> list:
         """Tag (and maybe compress) a body given as buffer pieces;
         returns the pieces to ship. Incompressible bodies ship raw —
-        the tag byte means the receiver never guesses."""
-        total = sum(len(b) for b in bufs)
+        the tag byte means the receiver never guesses. Raw pieces pass
+        through untouched (zero-copy); zlib consumes each piece via
+        the buffer protocol (no ``bytes()`` staging copy) and the
+        compressed bytes it does materialize are counted in
+        ``crdt_tpu_pack_copy_bytes_total{stage="encode_zlib"}``."""
+        total = sum(_buf_nbytes(b) for b in bufs)
         if self.compress and total >= self.min_compress_bytes:
             co = zlib.compressobj(self.level)
-            pieces = [co.compress(bytes(b)) for b in bufs]
+            pieces = [co.compress(b) for b in bufs]
             pieces.append(co.flush())
             z_total = sum(len(p) for p in pieces)
             if z_total < total:
                 if tally is not None:
                     tally.z_raw += total
                     tally.z_wire += z_total
+                from .obs.registry import default_registry
+                default_registry().counter(
+                    "crdt_tpu_pack_copy_bytes_total",
+                    "bytes copied between pack and frame (zero on the "
+                    "arena fast path)").inc(z_total,
+                                            stage="encode_zlib")
                 return [self.TAG_ZLIB] + pieces
         return [self.TAG_RAW] + list(bufs)
 
@@ -276,21 +286,69 @@ def _recv_exact(sock: socket.socket, n: int,
     return bytes(buf)
 
 
+def _buf_nbytes(b) -> int:
+    """Byte length of any buffer piece. ``len()`` of a
+    multi-dimensional memoryview counts FIRST-DIMENSION elements, not
+    bytes (the `_pack_split` flat-cast trap) — ``nbytes`` never
+    lies, whatever the shape or item size."""
+    if isinstance(b, (bytes, bytearray)):
+        return len(b)
+    return b.nbytes if isinstance(b, memoryview) else memoryview(b).nbytes
+
+
+def _flat_views(bufs) -> list:
+    """Normalize buffer pieces to flat byte memoryviews — what both
+    the length prefix and the vectored send below need. Flattening a
+    C-contiguous view is a cast, not a copy."""
+    views = []
+    for b in bufs:
+        v = b if isinstance(b, memoryview) else memoryview(b)
+        if v.ndim != 1 or v.format != "B":
+            v = v.cast("B")
+        views.append(v)
+    return views
+
+
+def _sendmsg_all(sock: socket.socket, views: list) -> None:
+    """Vectored gather-send of every view with partial-send advance —
+    ONE syscall per full frame in the common case, against N
+    ``sendall`` calls (and zero concatenation copies either way)."""
+    views = [v for v in views if v.nbytes]
+    i = 0
+    while i < len(views):
+        sent = sock.sendmsg(views[i:])
+        while sent > 0:
+            n = views[i].nbytes
+            if sent >= n:
+                sent -= n
+                i += 1
+            else:
+                views[i] = views[i][sent:]
+                sent = 0
+
+
 def send_bytes_frame(sock: socket.socket, bufs,
                      tally: Optional[WireTally] = None,
                      codec: Optional[FrameCodec] = None) -> None:
     """One length-prefixed RAW frame from a list of buffers — sent
     piecewise, never concatenated (a 100 MB delta must not allocate a
-    second copy)."""
+    second copy). The header and every body piece go out in one
+    vectored ``socket.sendmsg`` where the platform has it, so a
+    zero-copy pack's arena views reach the kernel directly."""
     if codec is not None:
         bufs = codec.encode(bufs, tally)
-    total = sum(len(b) for b in bufs)
+    views = _flat_views(bufs)
+    total = sum(v.nbytes for v in views)
     if total > MAX_FRAME_BYTES:
         raise ValueError(f"frame of {total} bytes exceeds "
                          f"MAX_FRAME_BYTES ({MAX_FRAME_BYTES})")
-    sock.sendall(struct.pack(">I", total))
-    for b in bufs:
-        sock.sendall(b)
+    header = struct.pack(">I", total)
+    if hasattr(sock, "sendmsg"):
+        _sendmsg_all(sock, [memoryview(header)] + views)
+    else:                                   # pragma: no cover
+        sock.sendall(header)
+        for v in views:
+            sock.sendall(v)
     if tally is not None:
         tally.sent += 4 + total
 
@@ -332,6 +390,9 @@ def _pack_split(scs):
     import numpy as np
 
     from .ops.pallas_merge import NarrowSplitChangeset
+    # Device lanes must land on host before framing — this copy is the
+    # unavoidable device_get, not a pack-path regression.
+    # crdtlint: disable=pack-path-extra-copy -- split lanes arrive as device arrays; materializing them on host is the one required copy of this wire form
     arrs = [np.ascontiguousarray(np.asarray(lane)) for lane in scs]
     meta = {
         "form": ("narrow" if isinstance(scs, NarrowSplitChangeset)
@@ -339,9 +400,9 @@ def _pack_split(scs):
         "lanes": [[f, str(a.dtype), list(a.shape)]
                   for f, a in zip(scs._fields, arrs)],
     }
-    # Flat byte casts: len(memoryview) counts FIRST-DIMENSION elements,
-    # not bytes — a 2-D view would make send_bytes_frame's length
-    # prefix lie about the frame.
+    # Flat byte casts kept for tidiness; the framing itself now sizes
+    # buffers by nbytes (`_buf_nbytes`), so even a multi-dimensional
+    # view could no longer make the length prefix lie.
     return meta, [a.data.cast("B") for a in arrs]
 
 
@@ -1093,7 +1154,8 @@ def sync_packed_over_conn(crdt, conn: PeerConnection,
                           since: Optional[Hlc] = None,
                           lock: Optional[threading.Lock] = None,
                           tally: Optional[WireTally] = None,
-                          _prepacked: Optional[Tuple] = None) -> Hlc:
+                          _prepacked: Optional[Tuple] = None,
+                          fused_repack: bool = False) -> Hlc:
     """One INCREMENTAL round over a pooled session: both directions
     ship the O(k) packed columnar form (`DenseCrdt.pack_since` /
     `merge_packed`), so bytes are proportional to the rows modified
@@ -1112,7 +1174,15 @@ def sync_packed_over_conn(crdt, conn: PeerConnection,
     ``_prepacked`` is the pipelined gossip hook: a
     ``(watermark, packed, ids)`` triple packed earlier (overlapped
     with another peer's network phase) to use instead of packing
-    here."""
+    here.
+
+    ``fused_repack=True`` merges the pulled delta through
+    `DenseCrdt.merge_and_repack`: the join and the NEXT round's pack
+    mask run as one device dispatch, and the post-merge pack is seeded
+    into the cache under this round's outgoing watermark — which is
+    exactly the ``since`` the next round asks for, so a
+    steady-state relay alternates merge+pack, merge+pack with zero
+    standalone pack dispatches (docs/FASTPATH.md)."""
     if lock is None:
         lock = threading.Lock()   # uncontended no-op
     from .ops.packing import pack_rows, unpack_rows
@@ -1174,7 +1244,17 @@ def sync_packed_over_conn(crdt, conn: PeerConnection,
             if not ids_in:
                 raise SyncTransportError("delta reply without node_ids")
             with lock:
-                crdt.merge_packed(peer_packed, ids_in)
+                if fused_repack and hasattr(crdt, "merge_and_repack"):
+                    # Seed the next round's pack while the join is on
+                    # device anyway; `watermark` (this round's
+                    # pre-push canonical) is the `since` the next
+                    # round's pack_for_peer will present.
+                    crdt.merge_and_repack(
+                        peer_packed, ids_in, since=watermark,
+                        sem_mode=("include" if "semantics" in conn.caps
+                                  else "auto"))
+                else:
+                    crdt.merge_packed(peer_packed, ids_in)
     except SyncError:
         conn.reset()
         raise

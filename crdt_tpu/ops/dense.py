@@ -398,6 +398,41 @@ def wire_join_step(store: DenseStore, lt: jax.Array, node: jax.Array,
         store, lt, node, val, tomb, valid, stamp_lt, local_node)
 
 
+@_ft.lru_cache(maxsize=None)
+def _merge_repack_jit(donate: bool, sharding=None):
+    def step(store, slot, lt, node, val, tomb, valid, stamp_lt,
+             local_node, since_lt):
+        new_store, win = _sparse_fanin_body(
+            store, slot, lt, node, val, tomb, valid, stamp_lt,
+            local_node)
+        if sharding is not None:
+            new_store = jax.lax.with_sharding_constraint(new_store,
+                                                         sharding)
+        mask = new_store.occupied & (new_store.mod_lt >= since_lt)
+        return new_store, win, mask
+    return jax.jit(step, donate_argnums=(0,) if donate else ())
+
+
+def merge_repack_step(store: DenseStore, slot: jax.Array, lt: jax.Array,
+                      node: jax.Array, val: jax.Array, tomb: jax.Array,
+                      valid: jax.Array, stamp_lt: jax.Array,
+                      local_node: jax.Array, since_lt: jax.Array, *,
+                      donate: bool = False, sharding=None
+                      ) -> Tuple[DenseStore, jax.Array, jax.Array]:
+    """`sparse_fanin_step` fused with the NEXT pack's delta mask — the
+    gossip relay op: merging a peer's delta and computing
+    ``occupied & (mod_lt >= since_lt)`` over the post-merge store in
+    ONE program replaces the two dispatches (merge, then
+    `dense_delta_mask` on the following `pack_since` miss) a relay
+    round otherwise pays. Same caller contract as `sparse_fanin_step`;
+    ``since_lt`` is the watermark the next outbound pack will be
+    bounded by (inclusive, map_crdt.dart:44-45). Returns
+    ``(new_store, win, mask)`` with ``mask`` over the N slots."""
+    return _merge_repack_jit(donate, sharding)(
+        store, slot, lt, node, val, tomb, valid, stamp_lt, local_node,
+        since_lt)
+
+
 @jax.jit
 def dense_delta_mask(store: DenseStore, since_lt: jax.Array) -> jax.Array:
     """modifiedSince filter — INCLUSIVE bound on the modified lane

@@ -151,11 +151,80 @@ class PackedDelta(NamedTuple):
         return sum(lane.nbytes for lane in self if lane is not None)
 
 
+def arena_of(lane: np.ndarray):
+    """Walk a lane view's base chain to its owning allocation — the
+    single uint8 arena for lanes `pack_into_arena` produced. Lets
+    tests prove buffer identity across pack → frame (the zero-copy
+    acceptance check): every lane of one delta roots at one arena,
+    and `pack_rows`' memoryviews expose that same storage."""
+    a = lane
+    while getattr(a, "base", None) is not None:
+        a = a.base
+    return a
+
+
+def pack_into_arena(idx: np.ndarray, lt: np.ndarray, node: np.ndarray,
+                    val: np.ndarray, tomb: np.ndarray,
+                    sem: Optional[np.ndarray] = None) -> "PackedDelta":
+    """Gather the rows selected by ``idx`` out of host store columns
+    straight into ONE preallocated arena; the returned delta's lanes
+    are aligned views into it, already in the exact wire dtypes
+    (`PACKED_LANE_DTYPES`). `pack_rows` then frames those views as-is
+    and `net.send_bytes_frame` vectors them to the socket — the bytes
+    written by the gathers here are the bytes `sendmsg` ships, with
+    zero intermediate ``bytes()``/``np.asarray`` copies in between.
+
+    Column dtype contract (the host fetch of store lanes): ``lt``/
+    ``val`` int64, ``node`` int32, ``tomb`` bool or (u)int8, ``sem``
+    int8/uint8 — 1-byte lanes reinterpret via ``.view`` so even the
+    bool→uint8 conversion is part of the gather, not an extra pass.
+
+    Ownership: the arena belongs to the returned delta and is NEVER
+    reused or resized — an evicted pack-cache entry may still be
+    referenced by an in-flight send, so recycling arenas would
+    corrupt frames already on the wire (docs/FASTPATH.md)."""
+    specs = [("slots", np.dtype(np.int32)),
+             ("lt", np.dtype(np.int64)),
+             ("node", np.dtype(np.int32)),
+             ("val", np.dtype(np.int64)),
+             ("tomb", np.dtype(np.uint8))]
+    if sem is not None:
+        specs.append(("sem", np.dtype(np.uint8)))
+    k = int(len(idx))
+    offs = []
+    total = 0
+    for _, dt in specs:
+        total = -(-total // 8) * 8      # 8-byte-align every lane
+        offs.append(total)
+        total += k * dt.itemsize
+    arena = np.empty(total, np.uint8)
+    views = {name: arena[off:off + k * dt.itemsize].view(dt)
+             for (name, dt), off in zip(specs, offs)}
+    views["slots"][:] = idx             # intp → int32 cast-assign
+    np.take(lt, idx, out=views["lt"])
+    np.take(node, idx, out=views["node"])
+    np.take(val, idx, out=views["val"])
+    np.take(tomb if tomb.dtype == np.uint8 else tomb.view(np.uint8),
+            idx, out=views["tomb"])
+    if sem is not None:
+        np.take(sem if sem.dtype == np.uint8 else sem.view(np.uint8),
+                idx, out=views["sem"])
+    return PackedDelta(**views)
+
+
 def pack_rows(delta: "PackedDelta") -> Tuple[dict, List[memoryview]]:
     """(meta, bufs) for a packed delta: lane descriptors plus host
     buffers in field order — the shape `net.send_bytes_frame` ships as
     one raw binary frame. The ``sem`` lane is appended only when
-    present (capability-gated by the caller)."""
+    present (capability-gated by the caller).
+
+    Zero-copy: a lane already holding its exact wire dtype contiguously
+    (every `pack_into_arena` lane) is framed as a flat memoryview over
+    its OWN storage — no intermediate buffer. Foreign lanes (wrong
+    dtype or layout, e.g. hand-built test deltas) are normalized with
+    one counted copy, reported in
+    ``crdt_tpu_pack_copy_bytes_total{stage="pack_rows"}`` — the
+    counter a zero-copy regression trips."""
     lanes = list(delta[:5])
     fields = list(PackedDelta._fields[:5])
     dtypes = list(PACKED_LANE_DTYPES)
@@ -163,8 +232,24 @@ def pack_rows(delta: "PackedDelta") -> Tuple[dict, List[memoryview]]:
         lanes.append(delta.sem)
         fields.append("sem")
         dtypes.append(PACKED_SEM_DTYPE)
-    arrs = [np.ascontiguousarray(np.asarray(lane, dtype))
-            for lane, dtype in zip(lanes, dtypes)]
+    arrs = []
+    copied = 0
+    for lane, dtype in zip(lanes, dtypes):
+        want = np.dtype(dtype)
+        if (isinstance(lane, np.ndarray) and lane.dtype == want
+                and lane.ndim == 1 and lane.flags.c_contiguous):
+            arrs.append(lane)
+            continue
+        # crdtlint: disable=pack-path-extra-copy -- normalizing a foreign lane (wrong dtype/layout) is the one legitimate pack-path copy; counted below so regressions still surface
+        a = np.ascontiguousarray(np.asarray(lane), want)
+        copied += a.nbytes
+        arrs.append(a)
+    if copied:
+        from ..obs.registry import default_registry
+        default_registry().counter(
+            "crdt_tpu_pack_copy_bytes_total",
+            "bytes copied between pack and frame (zero on the "
+            "arena fast path)").inc(copied, stage="pack_rows")
     meta = {"form": "packed",
             "lanes": [[f, str(a.dtype), [len(a)]]
                       for f, a in zip(fields, arrs)]}

@@ -5,7 +5,8 @@ slices). See `crdt_tpu.parallel.fanin` for the design."""
 from .fanin import (KEY_AXIS, REPLICA_AXIS, SLICE_AXIS,
                     ShardedFaninResult, changeset_sharding,
                     make_fanin_mesh, make_multislice_fanin_mesh,
-                    make_sharded_fanin, make_sharded_pallas_fanin,
+                    make_sharded_fanin, make_sharded_ingest,
+                    make_sharded_pallas_fanin,
                     replica_extent, shard_changeset,
                     shard_store, sharded_delta_mask,
                     sharded_max_logical_time, store_sharding)
@@ -14,6 +15,7 @@ __all__ = [
     "KEY_AXIS", "REPLICA_AXIS", "SLICE_AXIS", "ShardedFaninResult",
     "changeset_sharding", "make_fanin_mesh",
     "make_multislice_fanin_mesh", "make_sharded_fanin",
-    "make_sharded_pallas_fanin", "replica_extent", "shard_changeset", "shard_store",
+    "make_sharded_ingest", "make_sharded_pallas_fanin",
+    "replica_extent", "shard_changeset", "shard_store",
     "sharded_delta_mask", "sharded_max_logical_time", "store_sharding",
 ]

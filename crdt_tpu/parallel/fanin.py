@@ -413,6 +413,49 @@ def make_sharded_fanin(mesh: Mesh):
     return jax.jit(step)
 
 
+@functools.lru_cache(maxsize=None)
+def make_sharded_ingest(mesh: Mesh, donate: bool = False):
+    """ONE shard_map program for the write combiner's commit scatter:
+    every device translates the (replicated) global slot batch to its
+    key-shard's local rows and applies the blind ingest overwrite
+    (`ops.dense.ingest_scatter` semantics) — out-of-shard and sentinel
+    rows drop. Replaces the unsharded scatter + per-lane re-shard
+    round-trip (`shard_store`) that used to cost a dispatch per lane.
+
+    Returns ``step(store, slot, lt, val, tomb, me) -> new_store`` with
+    the store sharded by ``store_sharding(mesh)`` and the batch lanes
+    replicated. ``donate=True`` consumes the store buffers in place
+    (the model layer gates donation exactly as for merges)."""
+
+    def _ingest_block(store: DenseStore, slot, lt, val, tomb, me
+                      ) -> DenseStore:
+        n_local = store.lt.shape[0]
+        loc = slot - jax.lax.axis_index(KEY_AXIS) * n_local
+        # Rows outside this shard (and the caller's n_slots pad
+        # sentinel) land out of range and drop.
+        loc = jnp.where((loc < 0) | (loc >= n_local), n_local,
+                        loc).astype(jnp.int32)
+        return DenseStore(
+            lt=store.lt.at[loc].set(lt, mode="drop"),
+            node=store.node.at[loc].set(me, mode="drop"),
+            val=store.val.at[loc].set(val, mode="drop"),
+            mod_lt=store.mod_lt.at[loc].set(lt, mode="drop"),
+            mod_node=store.mod_node.at[loc].set(me, mode="drop"),
+            occupied=store.occupied.at[loc].set(True, mode="drop"),
+            tomb=store.tomb.at[loc].set(tomb, mode="drop"))
+
+    step = _shard_map(
+        _ingest_block, mesh=mesh,
+        in_specs=(
+            DenseStore(*([P(KEY_AXIS)] * len(DenseStore._fields))),
+            P(), P(), P(), P(), P(),
+        ),
+        out_specs=DenseStore(*([P(KEY_AXIS)] * len(DenseStore._fields))),
+        check_vma=False,
+    )
+    return jax.jit(step, donate_argnums=(0,) if donate else ())
+
+
 def sharded_delta_mask(mesh: Mesh):
     """modifiedSince filter over the sharded store — INCLUSIVE bound
     (map_crdt.dart:44-45), computed shard-local (no collectives)."""

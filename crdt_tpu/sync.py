@@ -113,5 +113,14 @@ def sync_packed(local, remote, since=_SAME_ROUND) -> Hlc:
         remote.merge_packed(packed, ids)
     pulled, pulled_ids = _pack_for_peer(remote, pull_bound, sem_ok)
     if pulled.k:
-        local.merge_packed(pulled, pulled_ids)
+        if hasattr(local, "merge_and_repack"):
+            # Fused merge+repack: the pull's join also computes (and
+            # caches) the NEXT round's push pack under this round's
+            # watermark — the exact `since` a resumed delta round
+            # presents (docs/FASTPATH.md).
+            local.merge_and_repack(
+                pulled, pulled_ids, since=watermark,
+                sem_mode="include" if sem_ok else "auto")
+        else:
+            local.merge_packed(pulled, pulled_ids)
     return watermark

@@ -170,6 +170,58 @@ def test_combiner_bypass_suppressible_with_reason():
     assert "suppression-without-reason" not in rules
 
 
+def test_pack_path_copy_flagged():
+    # All three copy shapes the zero-copy refactor removed: a bytes()
+    # staging copy, an np.asarray re-materialization, a .tobytes().
+    src = (
+        "def pack_rows(delta):\n"
+        "    blob = bytes(delta.lt)\n"
+        "    a = np.asarray(delta.slots, np.int32)\n"
+        "    return blob + a.tobytes()\n")
+    findings = [f for f in lint_source(src, "snippet.py")
+                if f.rule == "pack-path-extra-copy"]
+    assert len(findings) == 3
+    assert all("crdt_tpu_pack_copy_bytes_total" in f.message
+               for f in findings)
+
+
+def test_pack_path_rule_skips_unpack_and_merge():
+    # The wire-IN side legitimately materializes host arrays — the
+    # rule covers only the device→wire direction.
+    src = (
+        "def unpack_rows(meta, blob):\n"
+        "    return bytes(blob)\n"
+        "def merge_packed(self, packed, ids):\n"
+        "    lanes = np.asarray(packed.lt)\n"
+        "    return lanes.tobytes()\n"
+        "def scatter_rows(x):\n"
+        "    return bytes(x)\n")
+    rules = {f.rule for f in lint_source(src, "snippet.py")}
+    assert "pack-path-extra-copy" not in rules
+
+
+def test_pack_path_rule_covers_frame_layer_names():
+    # `encode` / `send_bytes_frame` don't contain "pack" but ARE the
+    # pack path's last hop — covered by exact name.
+    src = (
+        "def send_bytes_frame(sock, bufs):\n"
+        "    sock.sendall(bytes(bufs[0]))\n")
+    rules = {f.rule for f in lint_source(src, "snippet.py")}
+    assert "pack-path-extra-copy" in rules
+
+
+def test_pack_path_copy_suppressible_with_reason():
+    src = (
+        "def pack_rows(delta):\n"
+        "    # crdtlint: disable=pack-path-extra-copy -- foreign-lane"
+        " normalization, counted in the copy-bytes counter\n"
+        "    a = np.ascontiguousarray(delta.slots, np.int32)\n"
+        "    return a\n")
+    rules = {f.rule for f in lint_source(src, "snippet.py")}
+    assert "pack-path-extra-copy" not in rules
+    assert "suppression-without-reason" not in rules
+
+
 def test_shipped_tree_lints_clean():
     from crdt_tpu.analysis.host_lint import lint_package
     import crdt_tpu
@@ -293,6 +345,20 @@ def test_cli_json_clean_on_shipped_tree():
     assert payload["findings"] == []
     names = {r["target"] for r in payload["jaxpr_reports"]}
     assert "parallel.pallas_fanin_block[per-shard]" in names
+    # The fast-path completeness gate's required kernels are present
+    # (their absence would have failed the run above).
+    assert "dense.merge_repack_step" in names
+    assert "pallas.ingest_scatter_tiles[interpret]" in names
+
+
+def test_fastpath_completeness_gate_fails_on_missing_kernel():
+    from crdt_tpu.analysis.cli import _fastpath_completeness
+    findings = _fastpath_completeness(["dense.merge_repack_step"])
+    assert [f.rule for f in findings] == ["fastpath-kernel-unregistered"]
+    assert "ingest_scatter_tiles" in findings[0].message
+    assert _fastpath_completeness(
+        ["dense.merge_repack_step",
+         "pallas.ingest_scatter_tiles[interpret]"]) == []
 
 
 def test_cli_nonzero_with_counterexample_on_broken_fixture():
