@@ -619,6 +619,24 @@ def bench_sync(n_slots: int = 1 << 14, k: int = 256,
             "pooled_connects": peer.conn.connects,
         })
 
+    # --- cold peer: empty watermark, merkle walk vs full-scan pack ---
+    # The anti-entropy acceptance shape (docs/ANTIENTROPY.md): a
+    # 4096-slot pair that converged once, lost the watermark, and
+    # diverged in <= 1% of slots. The full-scan reference is a real
+    # packed round with since=None over its own socket, so both
+    # numbers are post-compression wire bytes. "clustered" is the
+    # headline (slots are handed out in interning order, so real
+    # divergence is contiguous); "scattered" is the honest worst case
+    # (every divergent slot in its own leaf).
+    cold_n = min(n_slots, 4096)
+    out["cold_peer"] = {
+        "n_slots": cold_n,
+        "divergent_slots": max(1, cold_n // 100),
+        "round_trip_budget": _cold_round_budget(cold_n),
+        "clustered": _cold_peer_scenario(cold_n, "clustered"),
+        "scattered": _cold_peer_scenario(cold_n, "scattered"),
+    }
+
     # --- device→wire: zero-copy pack + vectored frame, k fresh rows ---
     w = DenseCrdt("w", n_slots=n_slots)
 
@@ -629,6 +647,158 @@ def bench_sync(n_slots: int = 1 << 14, k: int = 256,
     btw_ms, copies = _bytes_to_wire(w, fresh_write, rounds)
     out["bytes_to_wire_ms"] = btw_ms
     out["copies"] = copies
+    return out
+
+
+def _cold_round_budget(n_slots: int) -> int:
+    """The ISSUE's digest round-trip acceptance bound:
+    log2(n_slots) + 2."""
+    import math
+    return int(math.log2(n_slots)) + 2
+
+
+def _cold_peer_scenario(n_slots: int, pattern: str) -> dict:
+    """One cold-peer (empty-watermark) sync: two replicas converge,
+    then diverge in ~1% of slots, then re-sync twice over real sockets
+    — once through the merkle walk, once through the full-scan packed
+    round a watermark-less peer otherwise pays. Both byte counts are
+    wire bytes off a `WireTally` (same compression, same framing)."""
+    import numpy as np
+    from crdt_tpu.models.dense_crdt import DenseCrdt
+    from crdt_tpu.net import (PeerConnection, SyncServer, WireTally,
+                              sync_merkle_over_conn,
+                              sync_packed_over_conn)
+
+    k_div = max(1, n_slots // 100)
+    src = DenseCrdt("cold_src", n_slots=n_slots)
+    ids = list(range(n_slots))
+    src.put_batch(ids, [i % 1000 for i in ids])
+    packed, pids = src.pack_since(None)
+    # two identical stale twins: one re-syncs by walk, one by full scan
+    merkle_dst = DenseCrdt("cold_m", n_slots=n_slots)
+    scan_dst = DenseCrdt("cold_f", n_slots=n_slots)
+    merkle_dst.merge_packed(packed, pids)
+    scan_dst.merge_packed(packed, pids)
+    if pattern == "clustered":
+        div = list(range(n_slots // 2, n_slots // 2 + k_div))
+    else:
+        div = np.random.default_rng(23).choice(
+            n_slots, size=k_div, replace=False).tolist()
+    src.put_batch(div, [7] * k_div)
+
+    stats = {}
+    m_tally, f_tally = WireTally(), WireTally()
+    with SyncServer(src) as server:
+        with PeerConnection(server.host, server.port,
+                            timeout=10.0) as conn:
+            sync_merkle_over_conn(merkle_dst, conn, tally=m_tally,
+                                  _stats=stats)
+        with PeerConnection(server.host, server.port,
+                            timeout=10.0) as conn:
+            sync_packed_over_conn(scan_dst, conn, since=None,
+                                  tally=f_tally)
+    assert merkle_dst.digest_tree().root == src.digest_tree().root
+    merkle_bytes = m_tally.sent + m_tally.received
+    full_bytes = f_tally.sent + f_tally.received
+    return {
+        "pattern": pattern,
+        "merkle_bytes": int(merkle_bytes),
+        "full_scan_bytes": int(full_bytes),
+        "bytes_ratio": round(merkle_bytes / full_bytes, 4),
+        "digest_round_trips": stats["rounds"],
+        "digests_fetched": stats["digests"],
+        "divergent_ranges": len(stats["ranges"]),
+        "rows_reshipped": stats["pulled_rows"],
+    }
+
+
+def bench_antientropy(replicas: int = 64, divergent: int = 8,
+                      store_sizes=(1 << 10, 1 << 12, 1 << 14),
+                      max_ring_sweeps: int = 8) -> dict:
+    """Topology soak for the merkle anti-entropy path: ``replicas``
+    in-process `DenseCrdt`s (no sockets — `sync.sync_merkle` keeps the
+    same walk/range accounting the wire path reports) converge from a
+    common seed, each writes ``divergent`` slots of its own, and the
+    mesh heals through star and ring sweeps. The scaling table re-runs
+    the star soak at growing store sizes with the SAME divergence —
+    the acceptance claim is that total anti-entropy traffic tracks the
+    divergence column, not the store-size column (full-scan traffic,
+    shown alongside, tracks store size)."""
+    from crdt_tpu.models.dense_crdt import DenseCrdt
+    from crdt_tpu.sync import _packed_nbytes, sync_merkle
+
+    def build_mesh(n_slots):
+        nodes = [DenseCrdt(f"r{i}", n_slots=n_slots)
+                 for i in range(replicas)]
+        seed_ids = list(range(0, n_slots, 2))
+        nodes[0].put_batch(seed_ids, [i % 997 for i in seed_ids])
+        packed, pids = nodes[0].pack_since(None)
+        for node in nodes[1:]:
+            node.merge_packed(packed, pids)
+        # partition-era writes: every replica touches its own window
+        for i, node in enumerate(nodes):
+            lo = (i * divergent) % (n_slots - divergent)
+            node.put_batch(list(range(lo, lo + divergent)),
+                           [i * 1000 + j for j in range(divergent)])
+        return nodes
+
+    def converged(nodes):
+        root = nodes[0].digest_tree().root
+        return all(n.digest_tree().root == root for n in nodes[1:])
+
+    def soak(nodes, edges_per_sweep, max_sweeps):
+        acc = {"sweeps": 0, "syncs": 0, "total_bytes": 0,
+               "digest_bytes": 0, "payload_bytes": 0,
+               "max_walk_rounds": 0}
+        for _ in range(max_sweeps):
+            acc["sweeps"] += 1
+            for a, b in edges_per_sweep(nodes):
+                rep = sync_merkle(a, b)
+                acc["syncs"] += 1
+                acc["total_bytes"] += rep.total_bytes
+                acc["digest_bytes"] += rep.digest_bytes
+                acc["payload_bytes"] += rep.payload_bytes
+                acc["max_walk_rounds"] = max(acc["max_walk_rounds"],
+                                             rep.rounds)
+            if converged(nodes):
+                break
+        acc["converged"] = converged(nodes)
+        return acc
+
+    def star_edges(nodes):
+        return [(nodes[0], s) for s in nodes[1:]]
+
+    def ring_edges(nodes):
+        return [(nodes[i], nodes[(i + 1) % len(nodes)])
+                for i in range(len(nodes))]
+
+    base_n = store_sizes[len(store_sizes) // 2]
+    out = {"metric": "merkle_antientropy_soak", "unit": "bytes",
+           "replicas": replicas,
+           "divergent_slots_per_replica": divergent,
+           "platform": jax.devices()[0].platform,
+           "star": soak(build_mesh(base_n), star_edges, 3),
+           "ring": soak(build_mesh(base_n), ring_edges,
+                        max_ring_sweeps)}
+    out["star"]["n_slots"] = out["ring"]["n_slots"] = base_n
+
+    scaling = []
+    for n_slots in store_sizes:
+        nodes = build_mesh(n_slots)
+        full_scan = _packed_nbytes(nodes[0].pack_since(None)[0])
+        row = soak(nodes, star_edges, 3)
+        scaling.append({"n_slots": n_slots,
+                        "star_total_bytes": row["total_bytes"],
+                        "star_payload_bytes": row["payload_bytes"],
+                        "one_full_scan_bytes": int(full_scan),
+                        "converged": row["converged"]})
+    out["scaling"] = scaling
+    lo, hi = scaling[0], scaling[-1]
+    out["store_growth"] = round(hi["n_slots"] / lo["n_slots"], 1)
+    out["traffic_growth"] = round(
+        hi["star_total_bytes"] / lo["star_total_bytes"], 3)
+    out["full_scan_growth"] = round(
+        hi["one_full_scan_bytes"] / lo["one_full_scan_bytes"], 3)
     return out
 
 
@@ -885,7 +1055,7 @@ def main() -> None:
                     help="chained timed runs (one readback at the end)")
     ap.add_argument("--mode",
                     choices=("stream", "distinct", "e2e", "e2e-kernel",
-                             "sync", "ingest", "types"),
+                             "sync", "ingest", "types", "antientropy"),
                     default="stream",
                     help="stream: write-stream replay (chunk replayed "
                          "with +1ms offsets); distinct: HBM-resident "
@@ -901,7 +1071,10 @@ def main() -> None:
                          "sharded flush vs the pre-combiner put_batch "
                          "baseline; types: per-semantics merge_packed "
                          "replay at 1024 slots, single-device and "
-                         "sharded — the type-zoo baseline")
+                         "sharded — the type-zoo baseline; "
+                         "antientropy: merkle star/ring topology soak "
+                         "over 64 in-process replicas — anti-entropy "
+                         "traffic vs divergence vs store size")
     ap.add_argument("--rows", type=int, default=128,
                     help="distinct mode: replica rows resident in HBM")
     ap.add_argument("--loops", type=int, default=48,
@@ -919,7 +1092,13 @@ def main() -> None:
     n_replicas = args.replicas or n_replicas
     chunk = args.chunk or chunk
 
-    if args.mode == "types":
+    if args.mode == "antientropy":
+        result = bench_antientropy(
+            replicas=8 if args.smoke else 64,
+            divergent=4 if args.smoke else 8,
+            store_sizes=((1 << 8, 1 << 9, 1 << 10) if args.smoke
+                         else (1 << 10, 1 << 12, 1 << 14)))
+    elif args.mode == "types":
         result = bench_types(n_slots=1 << 10,
                              loops=4 if args.smoke else 16,
                              rounds=1 if args.smoke else 3)

@@ -32,11 +32,12 @@ from .models.dense_crdt import (DenseCrdt, PipelinedGuardError,
                                 ShardedDenseCrdt, sync_dense)
 from .models.keyed_dense import KeyedDenseCrdt
 from .models.sqlite_crdt import SqliteCrdt
-from .sync import sync, sync_json, sync_packed
+from .sync import sync, sync_json, sync_merkle, sync_packed
 from .net import (FrameCodec, PeerConnection, SyncError,
                   SyncProtocolError, SyncServer, SyncTransportError,
                   WireTally, fetch_metrics, sync_dense_over_conn,
-                  sync_dense_over_tcp, sync_over_conn, sync_over_tcp,
+                  sync_dense_over_tcp, sync_merkle_over_conn,
+                  sync_over_conn, sync_over_tcp,
                   sync_packed_over_conn)
 from .ops.packing import PackedDelta
 from .obs import (MetricsRegistry, TraceRing, default_registry,
@@ -56,10 +57,11 @@ __all__ = [
     "ChangeStream", "MapCrdt", "TpuMapCrdt", "DenseCrdt",
     "ShardedDenseCrdt", "KeyedDenseCrdt", "PipelinedGuardError",
     "sync_dense", "SqliteCrdt",
-    "sync", "sync_json", "sync_packed", "SyncServer",
+    "sync", "sync_json", "sync_packed", "sync_merkle", "SyncServer",
     "sync_dense_over_tcp", "sync_over_tcp",
     "PeerConnection", "FrameCodec", "PackedDelta",
     "sync_over_conn", "sync_dense_over_conn", "sync_packed_over_conn",
+    "sync_merkle_over_conn",
     "SyncError", "SyncTransportError", "SyncProtocolError", "WireTally",
     "fetch_metrics",
     "GossipNode", "Peer", "RetryPolicy", "BreakerPolicy", "CircuitBreaker",

@@ -101,6 +101,35 @@ def _fastpath_completeness(target_names) -> List:
     return out
 
 
+# Merkle anti-entropy kernels the default run must find in the
+# jaxpr-audit registry: the digest reduction and the range-pack mask
+# drive the cold-peer sync path (docs/ANTIENTROPY.md).
+_MERKLE_REQUIRED = (
+    "digest.digest_tree_levels",
+    "dense.range_delta_mask",
+)
+
+
+def _merkle_completeness(target_names) -> List:
+    """The merkle CI gate: the on-device digest-tree reduction and the
+    slot-range delta mask must be registered audit targets — an
+    unregistered anti-entropy kernel fails the default run."""
+    from .findings import Finding
+    names = set(target_names)
+    out = []
+    for req in _MERKLE_REQUIRED:
+        if req not in names:
+            out.append(Finding(
+                rule="merkle-kernel-unregistered",
+                path="crdt_tpu/analysis/jaxpr_audit.py", line=0,
+                message=f"merkle anti-entropy kernel {req!r} is not a "
+                        "registered jaxpr-audit target",
+                detail="add it to builtin_targets() so the audit "
+                       "covers the digest-reduction/range-pack "
+                       "dispatch path (docs/ANTIENTROPY.md)"))
+    return out
+
+
 def main(argv=None) -> int:
     parser = argparse.ArgumentParser(
         prog="python -m crdt_tpu.analysis",
@@ -166,8 +195,9 @@ def main(argv=None) -> int:
             from .jaxpr_audit import audit_all, builtin_targets as \
                 audit_targets
             targets = audit_targets()
-            findings.extend(_fastpath_completeness(
-                t.name for t in targets))
+            names = tuple(t.name for t in targets)
+            findings.extend(_fastpath_completeness(names))
+            findings.extend(_merkle_completeness(names))
             reports, audit_findings = audit_all(targets)
             findings.extend(audit_findings)
 

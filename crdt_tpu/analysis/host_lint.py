@@ -41,6 +41,13 @@ reproduced bugs):
   (a device_get, normalizing a foreign lane) carry reasoned
   suppressions and are counted in
   ``crdt_tpu_pack_copy_bytes_total`` (docs/FASTPATH.md).
+- ``merkle-digest-host-hash`` — a host-side hash call (``hashlib.*``,
+  builtin ``hash(...)``, ``zlib.crc32``/``zlib.adler32``) inside a
+  digest/merkle-path function; the anti-entropy digest is the
+  device's job (one jit-cached reduction in ``ops/digest.py``), and a
+  host re-hash both drags store lanes off device and — for builtin
+  ``hash`` — is salted per process, so equal stores digest unequal
+  (docs/ANTIENTROPY.md).
 
 The linter is purely lexical/AST — no imports of the linted code — so
 it runs on broken or unimportable files (the self-test fixtures).
@@ -71,6 +78,7 @@ RULES = (
     "donated-buffer-reuse",
     "scatter-combiner-bypass",
     "pack-path-extra-copy",
+    "merkle-digest-host-hash",
     "suppression-without-reason",
 )
 
@@ -94,6 +102,12 @@ _COMBINER_GATES = {"drain_ingest", "_ingest"}
 _PACK_PATH_EXACT = {"encode", "send_bytes_frame"}
 _PACK_COPY_CALLS = {"np.asarray", "np.ascontiguousarray",
                     "numpy.asarray", "numpy.ascontiguousarray"}
+# merkle-digest-host-hash: host hash calls that must never appear on
+# the digest path — the digest is the device's job, and builtin hash()
+# is salted per process (PYTHONHASHSEED), so equal stores would digest
+# unequal across replicas.
+_HOST_HASH_CALLS = {"zlib.crc32", "zlib.adler32",
+                    "_zlib.crc32", "_zlib.adler32"}
 
 
 def _dotted(node: ast.AST) -> Optional[str]:
@@ -495,6 +509,46 @@ def _check_pack_path_copies(tree: ast.AST, path: str) -> List[Finding]:
     return out
 
 
+# --- rule: merkle-digest-host-hash ---
+
+def _on_digest_path(name: str) -> bool:
+    """Digest/merkle-path functions by name — the same lexical scoping
+    the pack-path rule uses."""
+    low = name.lower()
+    return "digest" in low or "merkle" in low
+
+
+def _check_digest_host_hash(tree: ast.AST, path: str) -> List[Finding]:
+    out: List[Finding] = []
+    for fn in _functions(tree):
+        if not _on_digest_path(fn.name):
+            continue
+        for node in ast.walk(fn):
+            if not isinstance(node, ast.Call):
+                continue
+            d = _dotted(node.func)
+            what = None
+            if d == "hash":
+                what = "builtin hash(...)"
+            elif d in _HOST_HASH_CALLS:
+                what = f"{d}(...)"
+            elif d is not None and (d.startswith("hashlib.")
+                                    or d.startswith("_hashlib.")):
+                what = f"{d}(...)"
+            if what is None:
+                continue
+            out.append(Finding(
+                rule="merkle-digest-host-hash", path=path,
+                line=node.lineno,
+                message=f"{what} in digest-path function {fn.name}() "
+                        "re-hashes on host; the anti-entropy digest "
+                        "is computed on device (ops/digest.py) and "
+                        "builtin hash() is salted per process, so a "
+                        "host hash diverges across replicas — use the "
+                        "device digest tree (docs/ANTIENTROPY.md)"))
+    return out
+
+
 _ALL_CHECKS = (
     _check_sockets,
     _check_lock_discipline,
@@ -504,6 +558,7 @@ _ALL_CHECKS = (
     _check_donated_reuse,
     _check_combiner_bypass,
     _check_pack_path_copies,
+    _check_digest_host_hash,
 )
 
 

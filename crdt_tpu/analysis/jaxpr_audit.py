@@ -310,6 +310,27 @@ def builtin_targets(include_sharded: bool = True) -> List[AuditTarget]:
             dense_store(), i64(_M), i64(_M), i32(_M), i64(_M), b8(_M),
             b8(_M), np.int64(0), np.int32(0), np.int64(0))))
 
+    # Merkle anti-entropy kernels (ops/digest.py, docs/ANTIENTROPY.md):
+    # read-only reductions/masks over the store — no scatter, no lane
+    # mutation — but registered so the CLI completeness gate proves
+    # the hot anti-entropy path stays on device.
+    from ..ops import digest as digest_ops
+
+    targets.append(AuditTarget(
+        name="digest.digest_tree_levels",
+        notes="on-device segment-tree digest: per-slot mix + leaf "
+              "fold + every interior combine in one program; "
+              "read-only over the lanes",
+        build=lambda: jax.make_jaxpr(digest_ops._digest_tree_jit(
+            8, False))(i64(_N), i64(_N), b8(_N), b8(_N))))
+
+    targets.append(AuditTarget(
+        name="dense.range_delta_mask",
+        notes="slot-span-restricted delta mask feeding "
+              "pack_since(ranges=...); elementwise, no scatter",
+        build=lambda: jax.make_jaxpr(dense_ops._range_mask_jit())(
+            dense_store(), np.int64(0), i64(2), i64(2))))
+
     # Typed lane kernels (crdt_tpu/semantics): the shared sparse
     # scatter and fan-in shapes here, plus one per-tag elementwise
     # wire-join target per registered semantics from the registry

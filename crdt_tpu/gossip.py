@@ -60,8 +60,8 @@ from .crdt import Crdt
 from .hlc import Hlc
 from .net import (PeerConnection, SyncProtocolError, SyncServer,
                   SyncTransportError, WireTally, _pack_for_peer,
-                  sync_dense_over_conn, sync_over_conn,
-                  sync_packed_over_conn)
+                  sync_dense_over_conn, sync_merkle_over_conn,
+                  sync_over_conn, sync_packed_over_conn)
 from .obs.lag import health_status, lag_entry
 from .obs.registry import default_registry
 from .obs.trace import tracer
@@ -157,8 +157,11 @@ class CircuitBreaker:
 
 
 # Wire modes a peer can aim at, fastest first. Downgrades are sticky
-# and one-way: packed -> dense -> json.
-_MODES = ("packed", "dense", "json")
+# and one-way: merkle -> packed -> dense -> json. "merkle" is packed
+# sync plus digest-tree anti-entropy for rounds with no usable
+# watermark (docs/ANTIENTROPY.md) — a cold or long-partitioned peer
+# walks divergence in O(log n) probes instead of full-scanning.
+_MODES = ("merkle", "packed", "dense", "json")
 
 
 class Peer:
@@ -194,7 +197,16 @@ class Peer:
 
     @dense.setter
     def dense(self, value: bool) -> None:
-        self.mode = "dense" if value else "json"
+        # Mode-preserving: `dense = True` only UPGRADES a json peer to
+        # the dense floor of the binary ladder — a peer already at
+        # dense/packed/merkle keeps its (faster) mode, where the old
+        # `mode = "dense"` collapse would silently downgrade it.
+        # `dense = False` still forces json, the legacy escape hatch.
+        if value:
+            if self.mode == "json":
+                self.mode = "dense"
+        else:
+            self.mode = "json"
 
     def __repr__(self) -> str:
         return (f"Peer({self.name!r}, {self.host}:{self.port}, "
@@ -219,6 +231,14 @@ _DENSE_FALLBACK_CODES = frozenset(
 # fallback counted, no wasted round-trip).
 _PACKED_FALLBACK_CODES = frozenset(
     {"packed_rejected", "unknown_op", "rejected"})
+
+# Codes that mean "this peer will not walk digest trees" — geometry
+# mismatch, a digest surface the peer's replica lacks, or a
+# pre-merkle server. Drop one step, to packed, and rerun: a full
+# packed round is always a correct (just wider) substitute for an
+# anti-entropy walk.
+_MERKLE_FALLBACK_CODES = frozenset(
+    {"merkle_rejected", "unknown_op", "rejected"})
 
 
 class GossipNode:
@@ -317,6 +337,11 @@ class GossipNode:
             return "json"
         if hasattr(self.crdt, "pack_since") \
                 and hasattr(self.crdt, "merge_packed"):
+            # "merkle" = packed plus digest-tree anti-entropy for
+            # watermark-less rounds; steady-state behavior (and the
+            # pipelined fast lane) is identical to "packed".
+            if callable(getattr(self.crdt, "digest_tree", None)):
+                return "merkle"
             return "packed"
         return "dense"
 
@@ -325,7 +350,8 @@ class GossipNode:
                  mode: Optional[str] = None) -> Peer:
         """Register (or re-address) a peer. A persisted watermark for
         ``name`` is resumed. ``mode`` pins the starting wire form
-        ('packed' | 'dense' | 'json'); the older ``dense`` flag keeps
+        ('merkle' | 'packed' | 'dense' | 'json'); the older ``dense``
+        flag keeps
         meaning "binary if True, JSON if False", with binary resolving
         to the fastest form the local replica speaks."""
         if mode is None:
@@ -410,7 +436,12 @@ class GossipNode:
         results: Dict[str, str] = {}
         for name in names:
             p = peers[name]
-            if (p.mode == "packed" and p.conn.connected
+            # A merkle peer WITH a watermark runs the same packed
+            # incremental round (the digest walk is only for
+            # watermark-less rounds), so it pipelines identically.
+            if ((p.mode == "packed"
+                 or (p.mode == "merkle" and p.watermark is not None))
+                    and p.conn.connected
                     and "packed" in p.conn.caps
                     and p.breaker.state == CircuitBreaker.CLOSED):
                 fast.append(name)
@@ -503,6 +534,15 @@ class GossipNode:
                 # rerun re-packs fresh.
                 _prepacked = None
                 tried = peer.last_attempt
+                if tried == "merkle" \
+                        and e.code in _MERKLE_FALLBACK_CODES:
+                    # The peer advertised merkle but won't walk
+                    # (geometry mismatch, digest surface missing):
+                    # downgrade (sticky) one step — a full packed
+                    # round is a correct, wider substitute.
+                    peer.stats.fallbacks += 1
+                    peer.mode = "packed"
+                    continue
                 if tried == "packed" \
                         and e.code in _PACKED_FALLBACK_CODES:
                     # The peer advertised packed but won't take it:
@@ -563,11 +603,29 @@ class GossipNode:
                 conn.host, conn.port = peer.host, peer.port
             conn.ensure(tally)
             mode = peer.mode
+            if mode == "merkle":
+                if "merkle" not in conn.caps:
+                    # Capability selection, like packed below: a
+                    # session that never advertised merkle is never
+                    # offered the walk — no fallback counted.
+                    mode = "packed"
+                elif peer.watermark is not None or prepacked is not None:
+                    # Warm session: the watermark-bounded incremental
+                    # round is strictly cheaper than a digest walk.
+                    # Merkle is the cold/partitioned-join half; the
+                    # mode keeps aiming at it so a dropped watermark
+                    # (restart without state, explicit reset) walks
+                    # again.
+                    mode = "packed"
             if mode == "packed" and "packed" not in conn.caps:
                 mode = ("dense"
                         if hasattr(self.crdt, "export_split_delta")
                         else "json")
             peer.last_attempt = mode
+            if mode == "merkle":
+                return sync_merkle_over_conn(
+                    self.crdt, conn, lock=self.server.lock,
+                    tally=tally, fused_repack=True)
             if mode == "packed":
                 # Gossip relays take the fused merge+repack dispatch:
                 # the pulled delta's join also seeds the next round's

@@ -163,6 +163,9 @@ class DenseCrdt:
         # before the first store assignment — the _store setter clears
         # it on every replacement.
         self._pack_cache: "OrderedDict[Any, Any]" = OrderedDict()
+        # digest_tree cache: one (key, DigestTree) pair, same
+        # invalidation discipline as the pack cache (docs/ANTIENTROPY.md).
+        self._digest_cache: Optional[Tuple[Any, Any]] = None
         self._store = store if store is not None else empty_dense_store(
             n_slots)
         if self._store.n_slots != n_slots:  # must survive `python -O`
@@ -216,6 +219,8 @@ class DenseCrdt:
         cache = self.__dict__.get("_pack_cache")
         if cache:
             cache.clear()
+        if self.__dict__.get("_digest_cache") is not None:
+            self._digest_cache = None
 
     @property
     def store(self) -> DenseStore:
@@ -746,8 +751,10 @@ class DenseCrdt:
         self._sem_version += 1
         # Cached packs may hold rows under the old tags (or withhold
         # rows that are now LWW) — the version key alone would let an
-        # in-flight entry at the same watermark survive.
+        # in-flight entry at the same watermark survive. Digests mix
+        # the tag lane, so the cached tree goes with them.
         self._pack_cache.clear()
+        self._digest_cache = None
 
     def semantics_of(self, slot: int):
         """The registered `SemanticsSpec` governing a slot."""
@@ -2331,8 +2338,101 @@ class DenseCrdt:
                 self._pack_cache.popitem(last=False)
                 ev.inc(node=str(self._node_id))
 
+    #: Slots per digest leaf (ops/digest.py, docs/ANTIENTROPY.md):
+    #: the granularity at which the Merkle walk localizes divergence
+    #: and the range pack re-ships rows. Mirrors
+    #: `ops.digest.DEFAULT_LEAF_WIDTH`; both peers must agree (the
+    #: walk checks geometry) — override in lockstep only.
+    DIGEST_LEAF_WIDTH = 8
+
+    def _digest_levels(self):
+        """Device digest-tree levels (root-first) over the current
+        store — overridden by the sharded model to fan per-shard
+        subtrees in through `parallel/fanin.py`."""
+        from ..ops.digest import digest_tree_device
+        sem = self._sem_device() if self._sem is not None else None
+        return digest_tree_device(self._store, sem,
+                                  self.DIGEST_LEAF_WIDTH)
+
+    def digest_tree(self):
+        """Merkle anti-entropy digest tree (docs/ANTIENTROPY.md): a
+        segment-tree of 64-bit digests over the replicated lanes,
+        computed ON DEVICE in one jit-cached reduction and fetched with
+        a single ``device_get``. Two replicas compare roots, walk only
+        differing subtrees (O(log n) round trips over the ``digest``
+        wire op), and re-ship just the divergent leaf ranges through
+        ``pack_since(ranges=...)`` — cold-join traffic scales with
+        divergence, not store size.
+
+        Cached exactly like the pack cache, keyed on ``(clock,
+        sem_version)``: every store replacement clears it through the
+        ``_store`` setter and `set_semantics` migrations drop it, so
+        an unchanged store recomputes (and dispatches) nothing.
+        Lookups are counted in ``crdt_tpu_digest_cache_total``."""
+        from ..obs.registry import default_registry
+        from ..obs.trace import span
+        from ..ops.digest import build_digest_tree
+        # Drain BEFORE the key reads the canonical clock — same
+        # aliasing hazard as pack_since.
+        self.drain_ingest()
+        key = (self._canonical_time.logical_time, self._sem_version)
+        counter = default_registry().counter(
+            "crdt_tpu_digest_cache_total",
+            "digest_tree cache lookups by outcome")
+        cached = self._digest_cache
+        if cached is not None and cached[0] == key:
+            counter.inc(outcome="hit", node=str(self._node_id))
+            return cached[1]
+        counter.inc(outcome="miss", node=str(self._node_id))
+        with span("digest_tree", kind="digest",
+                  hlc=lambda: self._canonical_time,
+                  node=str(self._node_id)):
+            tree = build_digest_tree(self.n_slots,
+                                     self.DIGEST_LEAF_WIDTH,
+                                     self._digest_levels())
+        self._digest_cache = (key, tree)
+        return tree
+
+    def _normalize_ranges(self, ranges):
+        """Validate/canonicalize a ``pack_since`` range mask: a
+        sequence of half-open ``(lo, hi)`` slot spans -> sorted tuple
+        with empty spans dropped. None means unrestricted."""
+        if ranges is None:
+            return None
+        out = []
+        for pair in ranges:
+            lo, hi = pair
+            lo, hi = int(lo), int(hi)
+            if not 0 <= lo <= hi <= self.n_slots:
+                raise ValueError(
+                    f"pack range ({lo}, {hi}) out of bounds for "
+                    f"{self.n_slots} slots")
+            if lo < hi:
+                out.append((lo, hi))
+        return tuple(sorted(out))
+
+    def _range_delta_mask(self, since: Optional[Hlc], ranges):
+        """Device mask for `pack_since(ranges=...)`: the delta mask
+        AND a union of slot spans. Span arrays pad to a power of two
+        with empty ``(0, 0)`` spans so the jit cache sees O(log)
+        distinct shapes across walks."""
+        from ..ops.dense import dense_range_delta_mask
+        k = max(1, len(ranges))
+        pad = 1
+        while pad < k:
+            pad *= 2
+        los = np.zeros(pad, np.int64)
+        his = np.zeros(pad, np.int64)
+        for i, (lo, hi) in enumerate(ranges):
+            los[i] = lo
+            his[i] = hi
+        since_lt = 0 if since is None else since.logical_time
+        return dense_range_delta_mask(self._store, jnp.int64(since_lt),
+                                      jnp.asarray(los),
+                                      jnp.asarray(his))
+
     def pack_since(self, since: Optional[Hlc] = None,
-                   sem_mode: str = "auto"
+                   sem_mode: str = "auto", ranges=None
                    ) -> Tuple[PackedDelta, List[Any]]:
         """Outbound O(k) columnar delta: host lanes for the rows with
         ``modified >= since`` (inclusive, the `export_delta` bound) —
@@ -2351,8 +2451,15 @@ class DenseCrdt:
         holds typed slots. An all-LWW replica omits the lane under
         every mode — the legacy 5-lane frame stays byte-identical.
 
+        ``ranges`` restricts the pack to a union of half-open
+        ``(lo, hi)`` slot spans — the anti-entropy tail
+        (docs/ANTIENTROPY.md): after a Merkle walk localizes
+        divergence, only the divergent leaf ranges re-ship.
+        ``ranges=((0, n_slots),)`` is bit-identical to the
+        unrestricted pack.
+
         Results are cached keyed on ``(since, canonical, semantics
-        version, mode)``; every store replacement — puts, deletes,
+        version, mode, ranges)``; every store replacement — puts, deletes,
         merges, grow, ordinal remaps — clears the cache through the
         ``_store`` setter, and a `set_semantics` migration bumps the
         version (and clears outright), so a cached pack can never leak
@@ -2363,13 +2470,14 @@ class DenseCrdt:
         from ..obs.registry import default_registry
         from ..obs.trace import span
         resolved = self._resolve_sem_mode(sem_mode)
+        ranges = self._normalize_ranges(ranges)
         # Drain BEFORE the cache key reads the canonical: a flush
         # advances the clock AND replaces the store, so a key built
         # first would alias a pre-flush pack under a stale watermark.
         self.drain_ingest()
         key = (None if since is None else since.logical_time,
                self._canonical_time.logical_time,
-               self._sem_version, resolved)
+               self._sem_version, resolved, ranges)
         counter = default_registry().counter(
             "crdt_tpu_pack_cache_total",
             "pack_since cache lookups by outcome")
@@ -2382,7 +2490,8 @@ class DenseCrdt:
         with span("pack_since", kind="pack",
                   hlc=lambda: self._canonical_time,
                   node=str(self._node_id)):
-            mask = self._delta_mask(since)
+            mask = (self._delta_mask(since) if ranges is None
+                    else self._range_delta_mask(since, ranges))
             # One batched device->host fetch; `modified` lanes are
             # local-only and never serialized (record.dart:28-31).
             mask, lt, node, val, tomb = jax.device_get(
@@ -2432,7 +2541,7 @@ class DenseCrdt:
             "dispatch").inc(node=str(self._node_id))
         key = (None if since is None else since.logical_time,
                self._canonical_time.logical_time,
-               self._sem_version, resolved)
+               self._sem_version, resolved, None)
         mask, lt, node, val, tomb = jax.device_get(
             (mask, self._store.lt, self._store.node,
              self._store.val, self._store.tomb))
@@ -2643,6 +2752,22 @@ class ShardedDenseCrdt(DenseCrdt):
     # _exact_guards: inherited — ShardedFaninResult carries no
     # first_bad field, so the base recompute path handles the sharded
     # collectives' superset flags (see `crdt_tpu.parallel.fanin`).
+
+    def _digest_levels(self):
+        # Per-shard subtree leaves fan in along the key axis
+        # (`parallel.make_sharded_digest`); falls back to the base
+        # single-program reduction (still on device, GSPMD-sharded)
+        # when leaf boundaries would straddle shards.
+        from ..parallel import KEY_AXIS, make_sharded_digest
+        k = self._mesh.shape[KEY_AXIS]
+        if self.n_slots % k or (self.n_slots // k) % self.DIGEST_LEAF_WIDTH:
+            return super()._digest_levels()
+        has_sem = self._sem is not None
+        fn = make_sharded_digest(self._mesh, self.DIGEST_LEAF_WIDTH,
+                                 has_sem)
+        if has_sem:
+            return fn(self._store, self._sem_device())
+        return fn(self._store)
 
     def _postprocess_store(self, store):
         # Sparse scatters land with XLA-chosen output sharding; pin the

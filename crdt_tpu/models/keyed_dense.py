@@ -98,6 +98,15 @@ class KeyedDenseCrdt(Crdt[K, int]):
         """Barrier passthrough (`DenseCrdt.drain_ingest`)."""
         return self._dense.drain_ingest()
 
+    def digest_tree(self):
+        """Merkle anti-entropy digest passthrough
+        (`DenseCrdt.digest_tree`, docs/ANTIENTROPY.md) — keyed
+        replicas walk and range-pack over the underlying slot space,
+        so two keyed peers must share the same key→slot interning
+        order (the same contract every packed sync already relies
+        on)."""
+        return self._dense.digest_tree()
+
     # --- key interning ---
 
     def _intern(self, key: K) -> int:

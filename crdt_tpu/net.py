@@ -637,10 +637,17 @@ class SyncServer:
             # may receive them, so the cap requires the typed surface,
             # not just packed framing.
             semantics = packed and hasattr(self.crdt, "set_semantics")
+            # "merkle" gates the digest/digest_resp walk ops
+            # (docs/ANTIENTROPY.md): it implies the range pack, so it
+            # requires the full packed surface too.
+            merkle = packed and callable(
+                getattr(self.crdt, "digest_tree", None))
         if packed:
             caps.add("packed")
         if semantics:
             caps.add("semantics")
+        if merkle:
+            caps.add("merkle")
         return caps
 
     def _handle(self, conn: socket.socket) -> None:
@@ -809,14 +816,59 @@ class SyncServer:
                 if not self._reply(conn, {"ok": True}, self.tally,
                                    codec):
                     return
+            elif op == "digest":
+                # Merkle walk probe (docs/ANTIENTROPY.md): one level's
+                # digest values at the requested node indices, plus the
+                # tree geometry so the peer can abort an incompatible
+                # walk before any payload bytes move. The tree itself
+                # is the replica's (clock, sem_version)-keyed cache —
+                # a quiet store serves every probe of the walk from
+                # one reduction.
+                try:
+                    level = msg.get("level")
+                    idxs = msg.get("idx")
+                    if not isinstance(level, int) or not isinstance(
+                            idxs, list):
+                        raise ValueError(
+                            "digest needs int 'level' + list 'idx'")
+                    with self.lock:
+                        tree = self.crdt.digest_tree()
+                        values = tree.values(level, idxs)
+                    # Values ride the BINARY continuation frame (8
+                    # bytes/digest, big-endian u64) — decimal JSON
+                    # would triple the walk's dominant byte term.
+                    import numpy as _np
+                    buf = _np.asarray(values,
+                                      _np.uint64).astype(">u8").tobytes()
+                    reply = {"op": "digest_resp", "ok": True,
+                             "k": len(values),
+                             "n_slots": tree.n_slots,
+                             "leaf_width": tree.leaf_width,
+                             "depth": tree.depth}
+                except Exception as e:
+                    self._reply(conn, {"code": "merkle_rejected",
+                                       "error": type(e).__name__,
+                                       "detail": str(e)},
+                                self.tally, codec)
+                    return
+                if not self._reply(conn, reply, self.tally, codec):
+                    return
+                try:
+                    send_bytes_frame(conn, [buf], self.tally, codec)
+                except (OSError, ValueError):
+                    return
             elif op == "delta_packed":
                 try:
                     since = msg.get("since")
+                    ranges = msg.get("ranges")
+                    if ranges is not None:
+                        ranges = tuple(
+                            (int(lo), int(hi)) for lo, hi in ranges)
                     with self.lock:
                         packed, ids = _pack_for_peer(
                             self.crdt,
                             None if since is None else Hlc.parse(since),
-                            sem_ok)
+                            sem_ok, ranges=ranges)
                     from .ops.packing import pack_rows
                     meta, bufs = pack_rows(packed)
                     meta_msg = {"meta": meta, "node_ids": list(ids),
@@ -928,7 +980,7 @@ class PeerConnection:
                  idle_timeout: Optional[float] = 20.0,
                  negotiate: bool = True,
                  want_caps: Iterable[str] = ("zlib", "packed",
-                                             "semantics")):
+                                             "semantics", "merkle")):
         self.host = host
         self.port = port
         self.timeout = timeout
@@ -1137,16 +1189,25 @@ def sync_dense_over_conn(crdt, conn: PeerConnection,
 
 
 def _pack_for_peer(crdt, since: Optional[Hlc],
-                   sem_include: bool) -> Tuple:
+                   sem_include: bool, ranges=None) -> Tuple:
     """`pack_since` with the semantics tag lane included only when the
     session negotiated the "semantics" capability. Crdts predating the
     ``sem_mode`` kwarg (no typed surface) get the plain call — their
     packs are 5-lane regardless. An un-negotiated session against a
     typed store gets ``sem_mode="auto"``, i.e. typed rows WITHHELD
-    (never silently stripped of their tags — docs/TYPES.md)."""
+    (never silently stripped of their tags — docs/TYPES.md).
+    ``ranges`` is the anti-entropy slot-span mask; a crdt advertising
+    the "merkle" cap always supports it, and passing it to one that
+    doesn't raises TypeError, which the wire surfaces as a
+    rejection."""
     if hasattr(crdt, "set_semantics"):
-        return crdt.pack_since(
-            since, sem_mode="include" if sem_include else "auto")
+        sem_mode = "include" if sem_include else "auto"
+        if ranges is not None:
+            return crdt.pack_since(since, sem_mode=sem_mode,
+                                   ranges=ranges)
+        return crdt.pack_since(since, sem_mode=sem_mode)
+    if ranges is not None:
+        return crdt.pack_since(since, ranges=ranges)
     return crdt.pack_since(since)
 
 
@@ -1255,6 +1316,165 @@ def sync_packed_over_conn(crdt, conn: PeerConnection,
                                   else "auto"))
                 else:
                     crdt.merge_packed(peer_packed, ids_in)
+    except SyncError:
+        conn.reset()
+        raise
+    except (OSError, ValueError) as e:
+        conn.reset()
+        raise SyncTransportError(f"sync round failed: {e!r}") from e
+    return watermark
+
+
+def sync_merkle_over_conn(crdt, conn: PeerConnection,
+                          lock: Optional[threading.Lock] = None,
+                          tally: Optional[WireTally] = None,
+                          fused_repack: bool = False,
+                          _stats: Optional[dict] = None) -> Hlc:
+    """One Merkle ANTI-ENTROPY round over a pooled session
+    (docs/ANTIENTROPY.md) — the cold/partitioned-peer complement to
+    `sync_packed_over_conn`: instead of a watermark (which a fresh
+    peer doesn't have) the two replicas compare digest trees, walking
+    only differing subtrees via the ``digest`` op — one round trip per
+    level, <= log2(n_leaves)+1 total — and then re-ship JUST the
+    divergent leaf ranges through ``pack_since(ranges=...)`` in both
+    directions. Matching roots end the round after ONE probe with
+    zero payload bytes; traffic scales with divergence, not store
+    size.
+
+    Requires the "merkle" cap (:class:`SyncProtocolError` code
+    ``merkle_rejected`` before any payload bytes otherwise — the
+    sticky-downgrade signal), and aborts the same way on tree
+    geometry (n_slots/leaf_width) mismatch, where a full packed round
+    is the correct fallback. The walk probes a live peer: if the peer
+    mutates mid-walk the ranges are computed against mixed snapshots,
+    which is safe (the range pack + lattice join are idempotent; the
+    next round converges the residue). Returns the local pre-walk
+    canonical time — the watermark incremental rounds resume from.
+    ``_stats`` (bench/test hook) receives rounds / digests / ranges /
+    row counts."""
+    if lock is None:
+        lock = threading.Lock()   # uncontended no-op
+    from .obs.registry import default_registry
+    from .obs.trace import span
+    from .ops.digest import coalesce_leaf_ranges, walk_divergent_leaves
+    from .ops.packing import pack_rows, unpack_rows
+    import time as _time
+    sock = conn.ensure(tally)
+    if "merkle" not in conn.caps:
+        raise SyncProtocolError(
+            "peer did not advertise the 'merkle' capability",
+            code="merkle_rejected")
+    with lock:
+        drain = getattr(crdt, "drain_ingest", None)
+        if drain is not None:
+            drain()
+        watermark = crdt.canonical_time
+        tree = crdt.digest_tree()
+    codec = conn.codec
+    node = str(getattr(crdt, "node_id", "?"))
+
+    def fetch(level, idxs):
+        import numpy as _np
+        send_frame(sock, {"op": "digest", "level": level,
+                          "idx": list(idxs)}, tally, codec)
+        reply = recv_frame(
+            sock, deadline=_time.monotonic() + conn.timeout,
+            tally=tally, codec=codec)
+        _check_reply("digest failed", reply, "k")
+        if level == 0 and not tree.same_geometry(
+                reply.get("n_slots"), reply.get("leaf_width"),
+                reply.get("depth")):
+            # The probe exchange completed, so the session is still
+            # framed-in-sync; the reset in the outer handler is the
+            # conservative price of the shared error path.
+            raise SyncProtocolError(
+                f"merkle geometry mismatch: local "
+                f"({tree.n_slots}, {tree.leaf_width}, {tree.depth}) "
+                f"vs peer ({reply.get('n_slots')}, "
+                f"{reply.get('leaf_width')}, {reply.get('depth')})",
+                code="merkle_rejected")
+        blob = recv_bytes_frame(
+            sock, deadline=_time.monotonic() + conn.timeout,
+            tally=tally, codec=codec)
+        if blob is None or len(blob) != 8 * reply["k"] \
+                or reply["k"] != len(idxs):
+            raise SyncTransportError("digest binary frame mismatch")
+        return _np.frombuffer(blob, ">u8").tolist()
+
+    try:
+        with span("sync_merkle", kind="sync",
+                  hlc=lambda: watermark, node=node):
+            leaves, rounds, fetched = walk_divergent_leaves(tree, fetch)
+            reg = default_registry()
+            reg.counter(
+                "crdt_tpu_merkle_digest_rounds_total",
+                "digest round trips spent walking peer trees"
+            ).inc(rounds, node=node)
+            reg.counter(
+                "crdt_tpu_merkle_sync_total",
+                "merkle anti-entropy rounds by outcome"
+            ).inc(outcome="diverged" if leaves else "clean", node=node)
+            if _stats is not None:
+                _stats.update(rounds=rounds, digests=fetched,
+                              ranges=(), pushed_rows=0, pulled_rows=0)
+            if not leaves:
+                return watermark
+            ranges = coalesce_leaf_ranges(leaves, tree.leaf_width,
+                                          tree.n_slots)
+            reg.counter(
+                "crdt_tpu_merkle_ranges_shipped_total",
+                "divergent slot ranges re-shipped after walks"
+            ).inc(len(ranges), node=node)
+            # Both halves are clock-unbounded WITHIN the ranges: the
+            # divergence may predate any watermark either side holds.
+            with lock:
+                packed, ids = _pack_for_peer(
+                    crdt, None, "semantics" in conn.caps,
+                    ranges=ranges)
+            if packed.k:
+                meta, bufs = pack_rows(packed)
+                send_frame(sock, {"op": "push_packed", "meta": meta,
+                                  "node_ids": list(ids)}, tally, codec)
+                send_bytes_frame(sock, bufs, tally, codec)
+                reply = recv_frame(
+                    sock, deadline=_time.monotonic() + conn.timeout,
+                    tally=tally, codec=codec)
+                _check_reply("push rejected", reply, "ok")
+            send_frame(sock, {"op": "delta_packed", "since": None,
+                              "ranges": [list(r) for r in ranges]},
+                       tally, codec)
+            reply = recv_frame(
+                sock, deadline=_time.monotonic() + conn.timeout,
+                tally=tally, codec=codec)
+            _check_reply("delta failed", reply, "meta")
+            blob = recv_bytes_frame(
+                sock, deadline=_time.monotonic() + conn.timeout,
+                tally=tally, codec=codec)
+            if blob is None:
+                raise SyncTransportError("delta binary frame missing")
+            peer_packed = unpack_rows(reply["meta"], blob)
+            ids_in = reply.get("node_ids")
+            if not isinstance(ids_in, list):
+                raise SyncTransportError("delta reply without node_ids")
+            if peer_packed.k:
+                if not ids_in:
+                    raise SyncTransportError(
+                        "delta reply without node_ids")
+                with lock:
+                    if fused_repack and hasattr(crdt,
+                                                "merge_and_repack"):
+                        # Seed the FOLLOW-UP incremental round's pack
+                        # (same contract as the packed path).
+                        crdt.merge_and_repack(
+                            peer_packed, ids_in, since=watermark,
+                            sem_mode=("include"
+                                      if "semantics" in conn.caps
+                                      else "auto"))
+                    else:
+                        crdt.merge_packed(peer_packed, ids_in)
+            if _stats is not None:
+                _stats.update(ranges=ranges, pushed_rows=packed.k,
+                              pulled_rows=peer_packed.k)
     except SyncError:
         conn.reset()
         raise

@@ -32,8 +32,11 @@ from .merge import (Store, Changeset, MergeResult, merge_step,
                     delta_mask)
 from .dense import (DenseStore, DenseChangeset, FaninResult,
                     empty_dense_store, fanin_step, fanin_stream,
-                    dense_delta_mask, dense_max_logical_time,
-                    store_to_changeset)
+                    dense_delta_mask, dense_range_delta_mask,
+                    dense_max_logical_time, store_to_changeset)
+from .digest import (DigestTree, DEFAULT_LEAF_WIDTH, digest_tree_device,
+                     build_digest_tree, walk_divergent_leaves,
+                     coalesce_leaf_ranges)
 from .pallas_merge import (SplitStore, SplitChangeset, PallasFaninResult,
                            pallas_fanin_batch, pallas_fanin_step,
                            pallas_fanin_stream, split_store,
@@ -47,7 +50,11 @@ __all__ = [
     "grow_store", "max_logical_time", "delta_mask",
     "DenseStore", "DenseChangeset", "FaninResult", "empty_dense_store",
     "fanin_step", "fanin_stream", "dense_delta_mask",
-    "dense_max_logical_time", "store_to_changeset",
+    "dense_range_delta_mask", "dense_max_logical_time",
+    "store_to_changeset",
+    "DigestTree", "DEFAULT_LEAF_WIDTH", "digest_tree_device",
+    "build_digest_tree", "walk_divergent_leaves",
+    "coalesce_leaf_ranges",
     "SplitStore", "SplitChangeset", "PallasFaninResult",
     "pallas_fanin_batch", "pallas_fanin_step", "pallas_fanin_stream",
     "split_store", "split_changeset", "join_store", "tile_changeset",

@@ -440,6 +440,32 @@ def dense_delta_mask(store: DenseStore, since_lt: jax.Array) -> jax.Array:
     return store.occupied & (store.mod_lt >= since_lt)
 
 
+@_ft.lru_cache(maxsize=None)
+def _range_mask_jit():
+    def step(store: DenseStore, since_lt: jax.Array, los: jax.Array,
+             his: jax.Array) -> jax.Array:
+        base = store.occupied & (store.mod_lt >= since_lt)
+        idx = jnp.arange(store.lt.shape[0], dtype=jnp.int64)
+        in_range = jnp.any((idx[None, :] >= los[:, None])
+                           & (idx[None, :] < his[:, None]), axis=0)
+        return base & in_range
+
+    return jax.jit(step)
+
+
+def dense_range_delta_mask(store: DenseStore, since_lt: jax.Array,
+                           los: jax.Array, his: jax.Array) -> jax.Array:
+    """`dense_delta_mask` restricted to a union of half-open slot
+    spans ``[los[i], his[i])`` — the anti-entropy range pack
+    (docs/ANTIENTROPY.md): after a Merkle walk localizes divergence to
+    a few leaf ranges, only those slots feed the pack. Callers pad the
+    span arrays to a power-of-two length with empty ``lo == hi == 0``
+    spans so the jit cache sees O(log) distinct shapes. Pass
+    ``since_lt = 0`` for a clock-unbounded range scan (every occupied
+    slot has ``mod_lt > 0``, so 0 never filters)."""
+    return _range_mask_jit()(store, since_lt, los, his)
+
+
 @jax.jit
 def dense_max_logical_time(store: DenseStore) -> jax.Array:
     """refreshCanonicalTime's reduction (crdt.dart:114-121)."""

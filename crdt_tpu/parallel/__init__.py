@@ -8,8 +8,9 @@ from .fanin import (KEY_AXIS, REPLICA_AXIS, SLICE_AXIS,
                     make_sharded_fanin, make_sharded_ingest,
                     make_sharded_pallas_fanin,
                     replica_extent, shard_changeset,
-                    shard_store, sharded_delta_mask,
-                    sharded_max_logical_time, store_sharding)
+                    make_sharded_digest, shard_store,
+                    sharded_delta_mask, sharded_max_logical_time,
+                    store_sharding)
 
 __all__ = [
     "KEY_AXIS", "REPLICA_AXIS", "SLICE_AXIS", "ShardedFaninResult",
@@ -17,5 +18,6 @@ __all__ = [
     "make_multislice_fanin_mesh", "make_sharded_fanin",
     "make_sharded_ingest", "make_sharded_pallas_fanin",
     "replica_extent", "shard_changeset", "shard_store",
-    "sharded_delta_mask", "sharded_max_logical_time", "store_sharding",
+    "make_sharded_digest", "sharded_delta_mask",
+    "sharded_max_logical_time", "store_sharding",
 ]

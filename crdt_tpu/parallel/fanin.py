@@ -472,6 +472,47 @@ def sharded_delta_mask(mesh: Mesh):
     ))
 
 
+@functools.lru_cache(maxsize=None)
+def make_sharded_digest(mesh: Mesh, leaf_width: int, has_sem: bool):
+    """Merkle digest-tree levels over the sharded store
+    (docs/ANTIENTROPY.md): per-shard subtree leaves computed
+    shard-local — slot digests mixed against GLOBAL positions via the
+    key-axis offset — fan in along the key axis, then the interior
+    combines fold in the SAME jitted program (GSPMD inserts the
+    gather; the leaf row is tiny next to the lanes). Requires the
+    shard width to be a multiple of ``leaf_width`` so leaf boundaries
+    never straddle shards; `ShardedDenseCrdt._digest_levels` falls
+    back to the single-program reduction otherwise. Levels are
+    bit-identical to the unsharded `ops.digest.digest_tree_device`."""
+    from ..ops.digest import (fold_leaves, slot_digests,
+                              tree_levels_from_leaves)
+
+    def _leaves(store: DenseStore, *sem):
+        shard = store.lt.shape[0]
+        if shard % leaf_width:
+            raise ValueError(
+                f"shard width {shard} not a multiple of leaf_width "
+                f"{leaf_width}")
+        off = (jax.lax.axis_index(KEY_AXIS).astype(jnp.uint64)
+               * jnp.uint64(shard))
+        h = slot_digests(store.lt, store.val, store.tomb,
+                         store.occupied,
+                         sem=sem[0] if has_sem else None,
+                         idx_offset=off)
+        return fold_leaves(h, leaf_width)
+
+    store_spec = DenseStore(*([P(KEY_AXIS)] * len(DenseStore._fields)))
+    in_specs = ((store_spec, P(KEY_AXIS)) if has_sem
+                else (store_spec,))
+    leaves = _shard_map(_leaves, mesh=mesh, in_specs=in_specs,
+                        out_specs=P(KEY_AXIS), check_vma=False)
+
+    def step(store: DenseStore, *sem):
+        return tree_levels_from_leaves(leaves(store, *sem))
+
+    return jax.jit(step)
+
+
 def sharded_max_logical_time(mesh: Mesh):
     """refreshCanonicalTime's reduction over the sharded store
     (crdt.dart:114-121): shard-local max, then one pmax over the mesh."""

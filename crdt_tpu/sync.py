@@ -124,3 +124,95 @@ def sync_packed(local, remote, since=_SAME_ROUND) -> Hlc:
         else:
             local.merge_packed(pulled, pulled_ids)
     return watermark
+
+
+class MerkleSyncReport:
+    """What one in-process anti-entropy round cost
+    (:func:`sync_merkle`) — the accounting the socket path keeps in
+    metrics/WireTally, exposed as a plain object so topology benches
+    (bench.py --mode antientropy) can sum traffic without sockets.
+    ``digest_bytes`` models the walk's wire cost (8 bytes per digest
+    value, both directions); ``payload_bytes`` is the packed arenas'
+    exact size. An empty ``ranges`` means the trees matched and no
+    payload moved."""
+
+    __slots__ = ("watermark", "rounds", "digests", "ranges",
+                 "pushed_rows", "pulled_rows", "payload_bytes")
+
+    def __init__(self, watermark, rounds, digests, ranges,
+                 pushed_rows, pulled_rows, payload_bytes):
+        self.watermark = watermark
+        self.rounds = rounds
+        self.digests = digests
+        self.ranges = ranges
+        self.pushed_rows = pushed_rows
+        self.pulled_rows = pulled_rows
+        self.payload_bytes = payload_bytes
+
+    @property
+    def digest_bytes(self) -> int:
+        return 16 * self.digests   # 8B value out + 8B value back
+
+    @property
+    def total_bytes(self) -> int:
+        return self.digest_bytes + self.payload_bytes
+
+
+def _packed_nbytes(packed) -> int:
+    total = 0
+    for lane in packed:
+        nbytes = getattr(lane, "nbytes", None)
+        if nbytes is not None:
+            total += int(nbytes)
+    return total
+
+
+def sync_merkle(local, remote) -> MerkleSyncReport:
+    """In-process twin of `net.sync_merkle_over_conn`
+    (docs/ANTIENTROPY.md): compare digest trees, walk only differing
+    subtrees (one `walk_divergent_leaves` level per simulated round
+    trip), then exchange JUST the divergent leaf ranges through
+    ``pack_since(ranges=...)`` both ways. Matching roots cost one
+    probe and zero payload. Raises ValueError on tree geometry
+    mismatch — the socket path's ``merkle_rejected``, where a full
+    packed round is the right fallback."""
+    from .ops.digest import coalesce_leaf_ranges, walk_divergent_leaves
+    drain = getattr(local, "drain_ingest", None)
+    if drain is not None:
+        drain()
+    watermark = local.canonical_time
+    tree = local.digest_tree()
+    remote_tree = remote.digest_tree()
+    if not tree.same_geometry(remote_tree.n_slots,
+                              remote_tree.leaf_width,
+                              remote_tree.depth):
+        raise ValueError(
+            f"merkle geometry mismatch: local ({tree.n_slots}, "
+            f"{tree.leaf_width}) vs remote ({remote_tree.n_slots}, "
+            f"{remote_tree.leaf_width})")
+    leaves, rounds, fetched = walk_divergent_leaves(
+        tree, remote_tree.values)
+    if not leaves:
+        return MerkleSyncReport(watermark, rounds, fetched, (),
+                                0, 0, 0)
+    ranges = coalesce_leaf_ranges(leaves, tree.leaf_width,
+                                  tree.n_slots)
+    from .net import _pack_for_peer
+    sem_ok = (hasattr(local, "set_semantics")
+              and hasattr(remote, "set_semantics"))
+    packed, ids = _pack_for_peer(local, None, sem_ok, ranges=ranges)
+    payload = _packed_nbytes(packed) if packed.k else 0
+    if packed.k:
+        remote.merge_packed(packed, ids)
+    pulled, pulled_ids = _pack_for_peer(remote, None, sem_ok,
+                                        ranges=ranges)
+    payload += _packed_nbytes(pulled) if pulled.k else 0
+    if pulled.k:
+        if hasattr(local, "merge_and_repack"):
+            local.merge_and_repack(
+                pulled, pulled_ids, since=watermark,
+                sem_mode="include" if sem_ok else "auto")
+        else:
+            local.merge_packed(pulled, pulled_ids)
+    return MerkleSyncReport(watermark, rounds, fetched, ranges,
+                            int(packed.k), int(pulled.k), payload)

@@ -83,7 +83,7 @@ def test_hello_negotiates_cap_intersection():
                             timeout=5.0) as conn:
             conn.ensure()
             assert conn.caps == frozenset({"zlib", "packed",
-                                           "semantics"})
+                                           "semantics", "merkle"})
             assert not conn.legacy
         with PeerConnection(server.host, server.port, timeout=5.0,
                             want_caps=("zlib",)) as conn:
