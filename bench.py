@@ -802,6 +802,215 @@ def bench_antientropy(replicas: int = 64, divergent: int = 8,
     return out
 
 
+def bench_serve(sessions: int = 10000, rate_hz: float = 1.0,
+                duration: float = 10.0, warmup: float = 3.0,
+                n_slots: int = 1 << 14,
+                flush_interval: float = 0.002,
+                connect_batch: int = 500) -> dict:
+    """Open-loop serving-tier load: ``sessions`` concurrent client
+    sessions multiplexed onto ONE `ServeTier` (docs/SERVING.md), each
+    issuing framed ``put`` ops on its own fixed schedule of
+    ``rate_hz`` ops/s. The schedule is ABSOLUTE (open loop): a slow
+    ack does not delay the next send's timestamp, and every latency is
+    measured from the op's scheduled time — so queueing delay shows up
+    in the percentiles instead of being coordinated-omission'd away.
+
+    The fleet runs on its own asyncio loop in the bench thread while
+    the tier serves from its loop thread; both are in-process, so the
+    number includes both sides' Python framing cost (conservative).
+    Reports p50/p99 write-ack latency, aggregate acked ops/s, writes
+    per combiner flush (the tentpole ratio: N clients -> one batched
+    stamp + one scatter per tick), and the shed/dropped counters —
+    the acceptance gate is p99 within 5x the PR 5 single-client flush
+    p50 (0.85 ms -> 4.25 ms budget) with zero sessions dropped below
+    the admission watermark."""
+    import asyncio
+    import resource
+    import struct as _struct
+    from crdt_tpu import DenseCrdt, ServeTier
+    from crdt_tpu.obs.registry import default_registry
+    from crdt_tpu.serve import read_frame_async
+
+    # fd budget: the tier process holds ONE server-side fd per
+    # session; the fleet runs in a forked child whose client-side fds
+    # count against a SEPARATE limit — that split is what seats 10k
+    # sessions under a 20k per-process fd cap that an in-process
+    # fleet (2 fds/session) would blow through.
+    need = sessions + 512
+    soft, hard = resource.getrlimit(resource.RLIMIT_NOFILE)
+    if soft < need:
+        try:
+            resource.setrlimit(resource.RLIMIT_NOFILE,
+                               (min(need, hard), hard))
+        except (ValueError, OSError):
+            pass
+    soft, _ = resource.getrlimit(resource.RLIMIT_NOFILE)
+    requested = sessions
+    if soft < need:
+        sessions = max(1, soft - 512)
+
+    head = _struct.Struct(">I")
+
+    async def session(reader, writer, k, start, warm_end, end,
+                      lats, counters, interval, n_sess):
+        loop = asyncio.get_running_loop()
+        slot = k % n_slots
+        # Sessions phase uniformly across one interval so the offered
+        # load is flat, not a thundering herd at each schedule edge.
+        t0 = start + (k / max(1, n_sess)) * interval
+        i = 0
+        try:
+            while True:
+                sched = t0 + i * interval
+                if sched >= end:
+                    return
+                now = loop.time()
+                if sched > now:
+                    await asyncio.sleep(sched - now)
+                body = json.dumps({"op": "put", "slot": slot,
+                                   "value": i}).encode()
+                writer.write(head.pack(len(body)) + body)
+                await writer.drain()
+                reply = await read_frame_async(reader)
+                if not (isinstance(reply, dict) and reply.get("ok")):
+                    counters["errors"] += 1
+                    return
+                counters["acked"] += 1
+                if sched >= warm_end:
+                    lats.append(loop.time() - sched)
+                i += 1
+        except (ConnectionError, OSError, asyncio.IncompleteReadError):
+            counters["errors"] += 1
+        finally:
+            try:
+                writer.close()
+            except Exception:
+                pass
+
+    async def fleet(host, port, n_sess, rate, warm, dur):
+        loop = asyncio.get_running_loop()
+        interval = 1.0 / rate
+        lats: list = []
+        counters = {"acked": 0, "errors": 0, "connect_failures": 0}
+        conns = []
+        for base in range(0, n_sess, connect_batch):
+            n = min(connect_batch, n_sess - base)
+            res = await asyncio.gather(
+                *(asyncio.open_connection(host, port)
+                  for _ in range(n)),
+                return_exceptions=True)
+            for r in res:
+                if isinstance(r, BaseException):
+                    counters["connect_failures"] += 1
+                else:
+                    conns.append(r)
+        start = loop.time() + 1.0
+        warm_end = start + warm
+        end = warm_end + dur
+        await asyncio.gather(*(
+            session(r, w, k, start, warm_end, end, lats, counters,
+                    interval, n_sess)
+            for k, (r, w) in enumerate(conns)))
+        return lats, counters, len(conns)
+
+    def pct_ms(xs, p):
+        if not xs:
+            return None
+        return round(xs[min(len(xs) - 1,
+                            int(p * (len(xs) - 1)))] * 1e3, 3)
+
+    crdt = DenseCrdt("srv", n_slots=n_slots)
+    ticks_c = default_registry().counter(
+        "crdt_tpu_ingest_flush_total",
+        "write-combiner flushes by trigger")
+    with ServeTier(crdt, max_sessions=sessions + 64,
+                   flush_interval=flush_interval) as tier:
+        # Warm the padded-commit jit buckets first: a tick batch pads
+        # to the next power of two, and a first-contact bucket compile
+        # (~200 ms on CPU) inside the measured window would read as a
+        # fake p99 spike that no steady-state server ever pays.
+        with tier.lock:
+            for sz in (1, 2, 4, 8, 16, 32, 64, 128, 256, 512, 1024,
+                       2048, 4096):
+                sz = min(sz, n_slots)
+                crdt.put_batch(list(range(sz)), [0] * sz)
+                crdt.drain_ingest()
+        # Same-platform yardstick: ONE session through the same tier
+        # (tick wait + commit, nothing queued behind anyone). The PR 5
+        # 0.85 ms flush p50 was measured on the driver's accelerator;
+        # this run's honest 5x comparison is against THIS host.
+        base_lats, _, _ = asyncio.run(
+            fleet(tier.host, tier.port, 1, 50.0, 0.5, 2.0))
+        base_lats.sort()
+        single_p50 = pct_ms(base_lats, 0.50)
+        ticks0 = ticks_c.value(trigger="tick", node="srv")
+        # The fleet forks: client fds land in the child's own limit.
+        # Fork start method, so the closures need no pickling; only
+        # the result crosses back (the child never touches jax or the
+        # replica — pure asyncio socket work).
+        import multiprocessing
+        ctx = multiprocessing.get_context("fork")
+        rq = ctx.SimpleQueue()
+
+        def _fleet_child():
+            try:
+                # The forked heap (jax, the tier, ...) is dead weight
+                # to the fleet; freeze it so the child's GC never
+                # stalls every in-flight op scanning it.
+                import gc
+                gc.freeze()
+                rq.put(asyncio.run(fleet(
+                    tier.host, tier.port, sessions, rate_hz, warmup,
+                    duration)))
+            except BaseException as e:  # surfaced in the parent
+                rq.put({"error": f"{type(e).__name__}: {e}"})
+
+        proc = ctx.Process(target=_fleet_child, daemon=True)
+        proc.start()
+        res = rq.get()
+        proc.join(timeout=60)
+        if isinstance(res, dict):
+            raise RuntimeError(f"serve fleet failed: {res['error']}")
+        lats, counters, connected = res
+        shed, dropped = tier.shed_count, tier.dropped_sessions
+    ticks = int(ticks_c.value(trigger="tick", node="srv") - ticks0)
+
+    lats.sort()
+    n = len(lats)
+    p99 = pct_ms(lats, 0.99)
+    return {
+        "metric": "serve_open_loop", "unit": "ops/s",
+        "platform": jax.devices()[0].platform,
+        "sessions": requested, "sessions_connected": connected,
+        "rate_per_session_hz": rate_hz,
+        "flush_interval_ms": flush_interval * 1e3,
+        "n_slots": n_slots,
+        "warmup_s": warmup, "duration_s": duration,
+        "ops_s": round(n / duration, 1),
+        "ops_measured": n,
+        "ops_acked_total": counters["acked"],
+        "p50_ms": pct_ms(lats, 0.50), "p90_ms": pct_ms(lats, 0.90),
+        "p99_ms": p99, "max_ms": pct_ms(lats, 1.0),
+        "combiner_ticks": ticks,
+        "writes_per_flush": (round(counters["acked"] / ticks, 2)
+                             if ticks else None),
+        "shed_count": shed,
+        "dropped_sessions": dropped,
+        "session_errors": counters["errors"],
+        "connect_failures": counters["connect_failures"],
+        "baseline_single_client_flush_p50_ms": 0.85,
+        "write_ack_p99_budget_ms": 4.25,
+        "within_budget": (p99 is not None and p99 <= 4.25),
+        "single_session_p50_ms": single_p50,
+        "p99_vs_single_session_p50": (
+            round(p99 / single_p50, 3)
+            if p99 is not None and single_p50 else None),
+        "within_5x_single_session": (
+            p99 is not None and bool(single_p50)
+            and p99 <= 5 * single_p50),
+    }
+
+
 def bench_ingest(n_slots: int = 1 << 14, rows: int = 1024,
                  batches: int = 64, repeats: int = 24) -> dict:
     """Write-path fast lane: staged ingest() vs unbatched put_batch.
@@ -1055,7 +1264,8 @@ def main() -> None:
                     help="chained timed runs (one readback at the end)")
     ap.add_argument("--mode",
                     choices=("stream", "distinct", "e2e", "e2e-kernel",
-                             "sync", "ingest", "types", "antientropy"),
+                             "sync", "ingest", "types", "antientropy",
+                             "serve"),
                     default="stream",
                     help="stream: write-stream replay (chunk replayed "
                          "with +1ms offsets); distinct: HBM-resident "
@@ -1073,8 +1283,16 @@ def main() -> None:
                          "replay at 1024 slots, single-device and "
                          "sharded — the type-zoo baseline; "
                          "antientropy: merkle star/ring topology soak "
-                         "over 64 in-process replicas — anti-entropy "
-                         "traffic vs divergence vs store size")
+                         "over in-process replicas (--replicas, "
+                         "default 64) — anti-entropy traffic vs "
+                         "divergence vs store size; serve: open-loop "
+                         "serving-tier load — --sessions concurrent "
+                         "client sessions multiplexed onto one "
+                         "ServeTier, p50/p99 write-ack latency and "
+                         "acked ops/s")
+    ap.add_argument("--sessions", type=int, default=None,
+                    help="serve mode: concurrent client sessions "
+                         "(default 10000, smoke 200)")
     ap.add_argument("--rows", type=int, default=128,
                     help="distinct mode: replica rows resident in HBM")
     ap.add_argument("--loops", type=int, default=48,
@@ -1094,10 +1312,20 @@ def main() -> None:
 
     if args.mode == "antientropy":
         result = bench_antientropy(
-            replicas=8 if args.smoke else 64,
+            replicas=args.replicas or (8 if args.smoke else 64),
             divergent=4 if args.smoke else 8,
             store_sizes=((1 << 8, 1 << 9, 1 << 10) if args.smoke
                          else (1 << 10, 1 << 12, 1 << 14)))
+    elif args.mode == "serve":
+        # Full shape: 10k concurrent sessions at 0.25 op/s each —
+        # 2.5k ops/s offered load, sized so a single-core host is
+        # measuring the tier's multiplexing, not its own saturation.
+        result = bench_serve(
+            sessions=args.sessions or (200 if args.smoke else 10000),
+            rate_hz=2.0 if args.smoke else 0.25,
+            duration=2.0 if args.smoke else 10.0,
+            warmup=1.0 if args.smoke else 3.0,
+            n_slots=1 << 10 if args.smoke else 1 << 14)
     elif args.mode == "types":
         result = bench_types(n_slots=1 << 10,
                              loops=4 if args.smoke else 16,

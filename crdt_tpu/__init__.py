@@ -39,6 +39,7 @@ from .net import (FrameCodec, PeerConnection, SyncError,
                   sync_dense_over_tcp, sync_merkle_over_conn,
                   sync_over_conn, sync_over_tcp,
                   sync_packed_over_conn)
+from .serve import ServeTier
 from .ops.packing import PackedDelta
 from .obs import (MetricsRegistry, TraceRing, default_registry,
                   metrics_snapshot, tracer)
@@ -63,7 +64,7 @@ __all__ = [
     "sync_over_conn", "sync_dense_over_conn", "sync_packed_over_conn",
     "sync_merkle_over_conn",
     "SyncError", "SyncTransportError", "SyncProtocolError", "WireTally",
-    "fetch_metrics",
+    "fetch_metrics", "ServeTier",
     "GossipNode", "Peer", "RetryPolicy", "BreakerPolicy", "CircuitBreaker",
     "load_dense", "load_json", "save_dense", "save_json",
     "load_gossip_state", "save_gossip_state",

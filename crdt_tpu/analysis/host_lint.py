@@ -48,6 +48,16 @@ reproduced bugs):
   host re-hash both drags store lanes off device and — for builtin
   ``hash`` — is salted per process, so equal stores digest unequal
   (docs/ANTIENTROPY.md).
+- ``async-blocking-call`` — a blocking call (``time.sleep``, a
+  ``socket.*`` constructor, a blocking socket method, or one of the
+  sync frame helpers ``send_frame``/``recv_frame``/
+  ``send_bytes_frame``/``recv_bytes_frame``) lexically inside an
+  ``async def``; one blocked coroutine stalls the serving tier's
+  entire event loop and every multiplexed session on it
+  (docs/SERVING.md). Route device/file work through
+  ``loop.run_in_executor`` and sleep with ``asyncio.sleep``. Passing
+  a sync helper BY REFERENCE to an executor is fine — only the
+  direct call blocks.
 
 The linter is purely lexical/AST — no imports of the linted code — so
 it runs on broken or unimportable files (the self-test fixtures).
@@ -79,6 +89,7 @@ RULES = (
     "scatter-combiner-bypass",
     "pack-path-extra-copy",
     "merkle-digest-host-hash",
+    "async-blocking-call",
     "suppression-without-reason",
 )
 
@@ -108,6 +119,15 @@ _PACK_COPY_CALLS = {"np.asarray", "np.ascontiguousarray",
 # unequal across replicas.
 _HOST_HASH_CALLS = {"zlib.crc32", "zlib.adler32",
                     "_zlib.crc32", "_zlib.adler32"}
+# async-blocking-call: calls that park the whole event loop when made
+# directly from a coroutine. The sync frame helpers (net.py) block on
+# sendall/recv under the hood; coroutines must use the async codec
+# path in serve.py instead.
+_ASYNC_BLOCKING_SLEEPS = {"time.sleep", "_time.sleep"}
+_ASYNC_FRAME_HELPERS = {"send_frame", "recv_frame",
+                        "send_bytes_frame", "recv_bytes_frame"}
+_ASYNC_BLOCKING_SOCK_METHODS = {"sendall", "recv", "accept", "connect",
+                                "makefile"}
 
 
 def _dotted(node: ast.AST) -> Optional[str]:
@@ -549,6 +569,67 @@ def _check_digest_host_hash(tree: ast.AST, path: str) -> List[Finding]:
     return out
 
 
+# --- rule: async-blocking-call ---
+
+def _own_nodes(fn: ast.AsyncFunctionDef):
+    """The coroutine's OWN statements: nested defs are excluded — a
+    nested sync helper is executor bait (called off-loop by design)
+    and a nested async def gets its own visit from the outer walk."""
+    def rec(node: ast.AST):
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            yield child
+            yield from rec(child)
+    yield from rec(fn)
+
+
+def _check_async_blocking(tree: ast.AST, path: str) -> List[Finding]:
+    out: List[Finding] = []
+    for fn in ast.walk(tree):
+        if not isinstance(fn, ast.AsyncFunctionDef):
+            continue
+        # a call that is directly awaited is an async API, whatever
+        # its name — only the un-awaited form blocks the loop
+        awaited = {id(n.value) for n in _own_nodes(fn)
+                   if isinstance(n, ast.Await)}
+        for node in _own_nodes(fn):
+            if not isinstance(node, ast.Call) or id(node) in awaited:
+                continue
+            d = _dotted(node.func)
+            what = None
+            if d in _ASYNC_BLOCKING_SLEEPS:
+                what = (f"{d}(...) parks the event loop; "
+                        "await asyncio.sleep(...) instead")
+            elif d is not None and (d == "socket.socket"
+                                    or (d.startswith("socket.")
+                                        and d.rsplit(".", 1)[-1]
+                                        in _SOCKET_CTORS)):
+                what = (f"{d}(...) creates a blocking socket; use "
+                        "asyncio streams (asyncio.start_server / "
+                        "open_connection)")
+            elif d is not None and d.rsplit(".", 1)[-1] \
+                    in _ASYNC_FRAME_HELPERS:
+                what = (f"{d}(...) is the SYNC frame helper "
+                        "(blocking sendall/recv under the hood); "
+                        "coroutines must use the async frame path or "
+                        "run_in_executor")
+            elif isinstance(node.func, ast.Attribute) \
+                    and node.func.attr in _ASYNC_BLOCKING_SOCK_METHODS:
+                what = (f".{node.func.attr}(...) is a blocking socket "
+                        "call; use asyncio transports or "
+                        "run_in_executor")
+            if what is None:
+                continue
+            out.append(Finding(
+                rule="async-blocking-call", path=path,
+                line=node.lineno,
+                message=f"{what} — inside coroutine {fn.name}() this "
+                        "stalls every session multiplexed on the "
+                        "serving tier's loop (docs/SERVING.md)"))
+    return out
+
+
 _ALL_CHECKS = (
     _check_sockets,
     _check_lock_discipline,
@@ -559,6 +640,7 @@ _ALL_CHECKS = (
     _check_combiner_bypass,
     _check_pack_path_copies,
     _check_digest_host_hash,
+    _check_async_blocking,
 )
 
 

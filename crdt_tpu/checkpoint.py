@@ -144,15 +144,37 @@ _DENSE_MAGIC = "crdt_tpu/dense-store@2"
 
 
 def save_dense(store: DenseStore, path: str,
-               node_ids: Optional[list] = None) -> None:
+               node_ids: Optional[list] = None,
+               digest: Optional[tuple] = None) -> None:
     """Columnar snapshot: one compressed npz of the seven lanes, plus
     the node-id interning table when given — the ``node``/``mod_node``
     ordinal lanes are meaningless without it, so model-level snapshots
-    (`DenseCrdt.save`) always include it."""
+    (`DenseCrdt.save`) always include it.
+
+    ``digest`` optionally persists the Merkle digest tree alongside
+    the lanes as ``(DigestTree, logical_time, sem_version)`` — the
+    tree plus the exact cache key it was computed under
+    (docs/ANTIENTROPY.md). A restart can then seed its digest cache
+    and answer the first anti-entropy walk with ZERO device
+    dispatches. Extra npz entries are invisible to older readers
+    (loads only touch known keys), so digest-bearing snapshots stay
+    backward readable."""
     start = time.perf_counter()
     tmp = path + ".tmp"
     extra = ({} if node_ids is None
              else {"node_ids": np.array(json.dumps(list(node_ids)))})
+    if digest is not None:
+        tree, logical_time, sem_version = digest
+        # Root-first levels have widths 1, 2, 4, ..., n_leaves — fully
+        # determined by depth — so one flat concatenation round-trips.
+        extra["digest_tree"] = np.concatenate(
+            [np.asarray(lvl, np.uint64) for lvl in tree.levels])
+        extra["digest_meta"] = np.array(json.dumps({
+            "n_slots": int(tree.n_slots),
+            "leaf_width": int(tree.leaf_width),
+            "depth": int(tree.depth),
+            "logical_time": int(logical_time),
+            "sem_version": int(sem_version)}))
     with open(tmp, "wb") as f:
         np.savez_compressed(
             f, magic=np.array(_DENSE_MAGIC), **extra,
@@ -185,6 +207,39 @@ def load_dense_with_node_ids(path: str):
 
 def load_dense(path: str) -> DenseStore:
     return load_dense_with_node_ids(path)[0]
+
+
+def load_dense_digest(path: str) -> Optional[tuple]:
+    """The persisted Merkle digest tree and its cache key:
+    ``(DigestTree, logical_time, sem_version)``, or None for
+    snapshots saved without one (including every pre-digest
+    snapshot). Malformed digest entries also answer None — the tree
+    is a pure cache, so the correct degradation is 'rebuild on first
+    walk', never a failed restore."""
+    from .ops.digest import DigestTree
+
+    with np.load(path) as z:
+        _validated_npz(z, path)
+        if "digest_tree" not in z or "digest_meta" not in z:
+            return None
+        try:
+            meta = json.loads(str(z["digest_meta"]))
+            depth = int(meta["depth"])
+            flat = np.asarray(z["digest_tree"], np.uint64)
+            widths = [1 << lvl for lvl in range(depth)]
+            if int(flat.shape[0]) != sum(widths):
+                return None
+            levels, off = [], 0
+            for w in widths:
+                levels.append(flat[off:off + w].copy())
+                off += w
+            tree = DigestTree(n_slots=int(meta["n_slots"]),
+                              leaf_width=int(meta["leaf_width"]),
+                              levels=tuple(levels))
+            return (tree, int(meta["logical_time"]),
+                    int(meta["sem_version"]))
+        except (KeyError, TypeError, ValueError):
+            return None
 
 
 def load_dense_node_ids(path: str) -> Optional[list]:

@@ -1609,11 +1609,19 @@ class DenseCrdt:
 
     def save(self, path: str) -> None:
         """Columnar snapshot INCLUDING the node-id table the ordinal
-        lanes index into (`crdt_tpu.checkpoint.save_dense`)."""
+        lanes index into (`crdt_tpu.checkpoint.save_dense`) AND the
+        Merkle digest tree under its cache key — a restarted replica
+        answers its first anti-entropy walk from the persisted tree
+        with zero digest dispatches (docs/ANTIENTROPY.md). The tree
+        comes from the digest cache when the store is quiet, so a
+        save after a walk adds no device work."""
         self.drain_ingest()
         from ..checkpoint import save_dense
+        tree = self.digest_tree()
         save_dense(self._store, path,
-                   node_ids=self._table.ids())
+                   node_ids=self._table.ids(),
+                   digest=(tree, self._canonical_time.logical_time,
+                           self._sem_version))
 
     @classmethod
     def load(cls, node_id: Any, path: str,
@@ -1621,8 +1629,13 @@ class DenseCrdt:
              **kwargs) -> "DenseCrdt":
         """Resume from a snapshot; the canonical clock rebuilds from the
         lanes (refreshCanonicalTime semantics, crdt.dart:31-33) and
-        writer attribution survives via the persisted node table."""
-        from ..checkpoint import load_dense_with_node_ids
+        writer attribution survives via the persisted node table. A
+        persisted digest tree re-seeds the digest cache when its key
+        still matches the rebuilt state — guarded on clock, semantics
+        version, and geometry, so a stale or foreign tree silently
+        falls back to rebuild-on-first-walk."""
+        from ..checkpoint import load_dense_digest, \
+            load_dense_with_node_ids
         store, ids = load_dense_with_node_ids(path)
         if ids is None:
             # A lane-only snapshot's ordinals are uninterpretable here;
@@ -1632,8 +1645,21 @@ class DenseCrdt:
                 f"{path} has no node-id table (store-level snapshot); "
                 "use DenseCrdt.save for resumable snapshots, or pass "
                 "store=load_dense(path) with the original node_ids")
-        return cls(node_id, store.n_slots, wall_clock=wall_clock,
+        crdt = cls(node_id, store.n_slots, wall_clock=wall_clock,
                    store=store, node_ids=ids, **kwargs)
+        restored = load_dense_digest(path)
+        if restored is not None:
+            tree, logical_time, sem_version = restored
+            # Seed AFTER construction: the _store setter in __init__
+            # cleared the cache, and the guards below are what make
+            # the seed sound (same clock head, same semantics column
+            # version, same tree geometry as this replica would build).
+            if (logical_time == crdt._canonical_time.logical_time
+                    and sem_version == crdt._sem_version
+                    and tree.n_slots == crdt.n_slots
+                    and tree.leaf_width == crdt.DIGEST_LEAF_WIDTH):
+                crdt._digest_cache = ((logical_time, sem_version), tree)
+        return crdt
 
     # --- replication (C9/C10) ---
 

@@ -85,7 +85,7 @@ class WriteCombiner:
 
     __slots__ = ("_owner", "_auto", "_slots", "_vals", "_tombs",
                  "_group", "_k", "_groups", "_pending", "flushes",
-                 "rows_committed")
+                 "rows_committed", "on_flush")
 
     def __init__(self, owner: "DenseCrdt",
                  auto_flush_rows: int = 1 << 16):
@@ -106,6 +106,13 @@ class WriteCombiner:
         self._pending: dict = {}
         self.flushes = 0
         self.rows_committed = 0
+        # Optional flush listener ``(trigger, rows, seconds)``, fired
+        # after EVERY successful commit whatever its trigger (tick,
+        # auto, barrier) — how the serving tier observes its true
+        # flush-latency distribution without wrapping every drain
+        # site. Listener errors are swallowed: observability must
+        # never fail a commit.
+        self.on_flush = None
 
     # --- staging ---
 
@@ -236,11 +243,18 @@ class WriteCombiner:
             self.rows_committed += d
             if d:
                 self._emit_commit(slots, vals, tombs)
+        dt = time.perf_counter() - t0
         flushes_c, rows_c, groups_c, seconds_h = _metrics()
         flushes_c.inc(trigger=trigger, node=node)
         rows_c.inc(d, node=node)
         groups_c.inc(groups, node=node)
-        seconds_h.observe(time.perf_counter() - t0, node=node)
+        seconds_h.observe(dt, node=node)
+        cb = self.on_flush
+        if cb is not None:
+            try:
+                cb(trigger, d, dt)
+            except Exception:
+                pass
         return True
 
     def _emit_commit(self, slots: np.ndarray, vals: np.ndarray,

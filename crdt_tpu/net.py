@@ -598,8 +598,32 @@ class SyncServer:
                 if not full:
                     self._conns.add(conn)
             if full or self._stop.is_set():
-                # over capacity (or stopping): hang up immediately —
-                # the peer sees EOF, a retryable transport fault
+                # Over capacity (or stopping): say WHY before hanging
+                # up. The refusal predates any hello, so it crosses in
+                # the untagged framing every client generation reads.
+                # "busy" is deliberately absent from the gossip
+                # fallback code sets — it is a retryable admission
+                # signal, not a capability verdict, so the client
+                # backs off and redials instead of downgrading modes
+                # or marking the session legacy.
+                try:
+                    conn.settimeout(self._io_timeout)
+                    if full and not self._stop.is_set():
+                        from .obs.registry import default_registry
+                        with self.lock:
+                            node = str(self.crdt.node_id)
+                        default_registry().counter(
+                            "crdt_tpu_net_busy_refusals_total",
+                            "connections refused at accept with the "
+                            "busy code (max_conns reached)"
+                        ).inc(node=node)
+                        send_frame(conn, {
+                            "ok": False, "code": "busy",
+                            "error": "server at capacity "
+                                     f"(max_conns={self._max_conns})"},
+                            self.tally)
+                except (OSError, ValueError):
+                    pass
                 try:
                     conn.close()
                 except OSError:
@@ -831,17 +855,43 @@ class SyncServer:
                             idxs, list):
                         raise ValueError(
                             "digest needs int 'level' + list 'idx'")
+                    # Frontier prefetch (docs/ANTIENTROPY.md): "more"
+                    # carries extra [level, idx-list] groups so a
+                    # walker can probe several tree levels in ONE
+                    # round trip. Optional and additive — a request
+                    # without it is answered exactly as before, so
+                    # pre-prefetch walkers interoperate unchanged.
+                    groups = [(level, idxs)]
+                    more = msg.get("more")
+                    if more is not None:
+                        if not isinstance(more, list):
+                            raise ValueError(
+                                "digest 'more' must be a list of "
+                                "[level, idx] pairs")
+                        for pair in more:
+                            lvl2, idx2 = pair
+                            if not isinstance(lvl2, int) \
+                                    or not isinstance(idx2, list):
+                                raise ValueError(
+                                    "digest 'more' entries need int "
+                                    "level + list idx")
+                            groups.append((lvl2, idx2))
                     with self.lock:
                         tree = self.crdt.digest_tree()
-                        values = tree.values(level, idxs)
+                        per_group = [tree.values(lvl, ix)
+                                     for lvl, ix in groups]
                     # Values ride the BINARY continuation frame (8
                     # bytes/digest, big-endian u64) — decimal JSON
                     # would triple the walk's dominant byte term.
+                    # Groups concatenate in request order; "ks" gives
+                    # the split points.
                     import numpy as _np
-                    buf = _np.asarray(values,
+                    flat = [v for vals in per_group for v in vals]
+                    buf = _np.asarray(flat,
                                       _np.uint64).astype(">u8").tobytes()
                     reply = {"op": "digest_resp", "ok": True,
-                             "k": len(values),
+                             "k": len(flat),
+                             "ks": [len(v) for v in per_group],
                              "n_slots": tree.n_slots,
                              "leaf_width": tree.leaf_width,
                              "depth": tree.depth}
@@ -944,6 +994,11 @@ def _check_reply(what: str, reply: Any, want_field: str) -> None:
     if isinstance(reply, dict) and want_field in reply \
             and "error" not in reply:
         return
+    if isinstance(reply, dict) and reply.get("code") == "busy":
+        # Admission refusal (the server is at max_conns): transport
+        # class, so retry/backoff machinery handles it — never a
+        # protocol rejection, never a mode downgrade.
+        raise SyncTransportError(f"{what}: peer busy ({reply!r})")
     if isinstance(reply, dict) and ("error" in reply
                                     or reply.get("ok") is False):
         raise SyncProtocolError.from_reply(what, reply)
@@ -1037,6 +1092,17 @@ class PeerConnection:
                     and isinstance(reply.get("caps"), list):
                 self.caps = frozenset(reply["caps"])
                 self.codec = FrameCodec(compress="zlib" in self.caps)
+            elif isinstance(reply, dict) \
+                    and reply.get("code") == "busy":
+                # Admission refusal at accept (max_conns): the server
+                # understood us perfectly well, it just has no slot.
+                # Retryable — and emphatically NOT the legacy signal:
+                # a busy modern server must not demote the session to
+                # the pre-hello framing forever.
+                sock.close()
+                raise SyncTransportError(
+                    f"peer {self.host}:{self.port} at capacity "
+                    f"(busy): {reply.get('error')!r}")
             elif isinstance(reply, dict) and ("error" in reply
                                               or reply.get("ok")
                                               is False):
@@ -1373,15 +1439,23 @@ def sync_merkle_over_conn(crdt, conn: PeerConnection,
     codec = conn.codec
     node = str(getattr(crdt, "node_id", "?"))
 
-    def fetch(level, idxs):
+    def fetch_levels(groups):
+        # One round trip for the whole multi-level probe: the first
+        # group rides the original level/idx fields (so the request
+        # degrades to the single-level op when there is only one) and
+        # the rest ride "more" — the frontier-prefetch extension
+        # (docs/ANTIENTROPY.md).
         import numpy as _np
-        send_frame(sock, {"op": "digest", "level": level,
-                          "idx": list(idxs)}, tally, codec)
+        (level0, idxs0) = groups[0]
+        msg = {"op": "digest", "level": level0, "idx": list(idxs0)}
+        if len(groups) > 1:
+            msg["more"] = [[lvl, list(ix)] for lvl, ix in groups[1:]]
+        send_frame(sock, msg, tally, codec)
         reply = recv_frame(
             sock, deadline=_time.monotonic() + conn.timeout,
             tally=tally, codec=codec)
         _check_reply("digest failed", reply, "k")
-        if level == 0 and not tree.same_geometry(
+        if level0 == 0 and not tree.same_geometry(
                 reply.get("n_slots"), reply.get("leaf_width"),
                 reply.get("depth")):
             # The probe exchange completed, so the session is still
@@ -1396,15 +1470,27 @@ def sync_merkle_over_conn(crdt, conn: PeerConnection,
         blob = recv_bytes_frame(
             sock, deadline=_time.monotonic() + conn.timeout,
             tally=tally, codec=codec)
-        if blob is None or len(blob) != 8 * reply["k"] \
-                or reply["k"] != len(idxs):
+        ks = reply.get("ks")
+        if ks is None:
+            ks = [reply["k"]]
+        if blob is None or not isinstance(ks, list) \
+                or len(ks) != len(groups) \
+                or ks != [len(ix) for _, ix in groups] \
+                or reply["k"] != sum(ks) \
+                or len(blob) != 8 * reply["k"]:
             raise SyncTransportError("digest binary frame mismatch")
-        return _np.frombuffer(blob, ">u8").tolist()
+        flat = _np.frombuffer(blob, ">u8").tolist()
+        out, off = [], 0
+        for k in ks:
+            out.append(flat[off:off + k])
+            off += k
+        return out
 
     try:
         with span("sync_merkle", kind="sync",
                   hlc=lambda: watermark, node=node):
-            leaves, rounds, fetched = walk_divergent_leaves(tree, fetch)
+            leaves, rounds, fetched = walk_divergent_leaves(
+                tree, None, fetch_levels=fetch_levels)
             reg = default_registry()
             reg.counter(
                 "crdt_tpu_merkle_digest_rounds_total",

@@ -49,6 +49,22 @@ from .dense import DenseStore
 #: costs little (measured in BENCH_r08).
 DEFAULT_LEAF_WIDTH = 8
 
+#: Tree levels probed per round trip when the fetch side supports
+#: batched (multi-level) probes. Each round speculatively requests the
+#: DESCENDANTS of the whole current frontier for the next
+#: ``PREFETCH_LEVELS - 1`` levels — at most ``(2^P - 1)`` digests per
+#: frontier node, 8 bytes each — so a walk costs
+#: ``ceil(depth / PREFETCH_LEVELS)`` round trips instead of ``depth``.
+#: 3 trades ~7x the (tiny) digest bytes for a 3x round-trip cut, the
+#: right direction on the high-RTT links cold joins cross.
+PREFETCH_LEVELS = 3
+
+#: Speculative expansion stops growing a batch past this many indices
+#: per level: a wide frontier (heavy divergence) already amortizes its
+#: round trips, and an unbounded 2^P fan-out on a big tree could make
+#: one probe frame rival the payload it is trying to localize.
+PREFETCH_MAX_BATCH = 512
+
 _GOLDEN = np.uint64(0x9E3779B97F4A7C15)
 _MIX_A = np.uint64(0xBF58476D1CE4E5B9)
 _MIX_B = np.uint64(0x94D049BB133111EB)
@@ -190,6 +206,14 @@ class DigestTree(NamedTuple):
             out.append(int(row[i]))
         return out
 
+    def values_levels(self, groups: Sequence[Tuple[int, Sequence[int]]]
+                      ) -> List[List[int]]:
+        """Batched :meth:`values`: one result row per ``(level, idxs)``
+        group — the in-process mirror of the wire's multi-level
+        ``digest`` probe (``more`` groups), so tests and local walks
+        exercise the same prefetch shape the socket path ships."""
+        return [self.values(level, idxs) for level, idxs in groups]
+
     def same_geometry(self, n_slots: int, leaf_width: int,
                       depth: int) -> bool:
         return (self.n_slots == n_slots
@@ -211,31 +235,91 @@ def build_digest_tree(n_slots: int, leaf_width: int,
 
 def walk_divergent_leaves(
         tree: DigestTree,
-        fetch: Callable[[int, List[int]], Sequence[int]],
+        fetch: Optional[Callable[[int, List[int]], Sequence[int]]],
+        fetch_levels: Optional[
+            Callable[[List[Tuple[int, List[int]]]],
+                     Sequence[Sequence[int]]]] = None,
+        prefetch: int = PREFETCH_LEVELS,
 ) -> Tuple[List[int], int, int]:
-    """Top-down walk against a remote tree reachable only through
-    ``fetch(level, idxs) -> values``. Each level costs exactly one
-    fetch (one wire round trip on the socket path), so the whole walk
-    is <= depth = log2(n_leaves)+1 rounds. Returns
-    ``(divergent_leaf_idxs, rounds, values_fetched)`` — empty leaf
-    list means the trees (and therefore the replicated lanes) agree.
+    """Top-down walk against a remote tree reachable only through a
+    fetch callback. Two fetch shapes:
+
+    - ``fetch(level, idxs) -> values`` — one level per call (one wire
+      round trip on the socket path), so the whole walk is <= depth =
+      log2(n_leaves)+1 rounds. The original shape; any peer speaking
+      the single-level ``digest`` op supports it.
+    - ``fetch_levels(groups) -> [values, ...]`` with ``groups`` a list
+      of ``(level, idxs)`` pairs — frontier PREFETCH: each call probes
+      the current frontier plus the speculative descendants of the
+      whole frontier for the next ``prefetch - 1`` levels (capped at
+      `PREFETCH_MAX_BATCH` indices per level), cutting the walk to
+      ``ceil(depth / prefetch)`` round trips. The walk then descends
+      through the prefetched levels locally: every next frontier is by
+      construction a subset of the speculative request, so no
+      mid-batch fetch is ever needed.
+
+    Returns ``(divergent_leaf_idxs, rounds, values_fetched)`` — an
+    empty leaf list means the trees (and therefore the replicated
+    lanes) agree. ``values_fetched`` counts every digest requested,
+    speculative ones included (8 bytes each on the wire).
     """
+    if fetch_levels is None:
+        if fetch is None:
+            raise ValueError("walk needs fetch or fetch_levels")
+        frontier = [0]
+        rounds = 0
+        fetched = 0
+        for level in range(tree.depth):
+            remote = fetch(level, frontier)
+            rounds += 1
+            fetched += len(frontier)
+            local = tree.levels[level]
+            diff = [i for i, v in zip(frontier, remote)
+                    if int(local[i]) != int(v)]
+            if not diff:
+                return [], rounds, fetched
+            if level == tree.depth - 1:
+                return diff, rounds, fetched
+            frontier = [c for i in diff for c in (2 * i, 2 * i + 1)]
+        return [], rounds, fetched  # pragma: no cover — loop returns
+
+    if prefetch < 1:
+        raise ValueError(f"prefetch must be >= 1; got {prefetch}")
     frontier = [0]
+    level = 0
     rounds = 0
     fetched = 0
-    for level in range(tree.depth):
-        remote = fetch(level, frontier)
+    while level < tree.depth:
+        groups: List[Tuple[int, List[int]]] = []
+        idxs = list(frontier)
+        for lvl in range(level, min(level + prefetch, tree.depth)):
+            if groups and len(idxs) > PREFETCH_MAX_BATCH:
+                break
+            groups.append((lvl, idxs))
+            if lvl + 1 < tree.depth:
+                idxs = [c for i in idxs for c in (2 * i, 2 * i + 1)]
+        results = fetch_levels(groups)
         rounds += 1
-        fetched += len(frontier)
-        local = tree.levels[level]
-        diff = [i for i, v in zip(frontier, remote)
-                if int(local[i]) != int(v)]
-        if not diff:
-            return [], rounds, fetched
-        if level == tree.depth - 1:
-            return diff, rounds, fetched
-        frontier = [c for i in diff for c in (2 * i, 2 * i + 1)]
-    return [], rounds, fetched  # pragma: no cover — loop always returns
+        fetched += sum(len(ix) for _, ix in groups)
+        if len(results) != len(groups):
+            raise ValueError(
+                f"fetch_levels returned {len(results)} groups for "
+                f"{len(groups)} requested")
+        for (lvl, g_idxs), vals in zip(groups, results):
+            if len(vals) != len(g_idxs):
+                raise ValueError(
+                    f"fetch_levels group {lvl} returned {len(vals)} "
+                    f"values for {len(g_idxs)} indices")
+            remote = {i: int(v) for i, v in zip(g_idxs, vals)}
+            local = tree.levels[lvl]
+            diff = [i for i in frontier if int(local[i]) != remote[i]]
+            if not diff:
+                return [], rounds, fetched
+            if lvl == tree.depth - 1:
+                return diff, rounds, fetched
+            frontier = [c for i in diff for c in (2 * i, 2 * i + 1)]
+        level = groups[-1][0] + 1
+    return [], rounds, fetched  # pragma: no cover — loop returns
 
 
 def coalesce_leaf_ranges(leaf_idxs: Sequence[int], leaf_width: int,

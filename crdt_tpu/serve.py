@@ -1,0 +1,812 @@
+"""Async serving tier: multiplex thousands of client sessions onto
+the ingest combiner and the packed read path (docs/SERVING.md).
+
+`SyncServer` (net.py) is a REPLICATION endpoint: a handful of pooled
+gossip peers, one handler thread per connection, every request a full
+replica-lock round trip. A serving workload is the opposite shape —
+10k mostly-idle client sessions each issuing small point writes and
+reads — and a thread per session or a scatter per write would sink
+it. :class:`ServeTier` multiplexes every session onto ONE asyncio
+event loop and funnels all writes through the replica's shared
+`DenseCrdt.ingest()` combiner window:
+
+- **Writes** (the serve-only ``put``/``delete`` ops) never touch the
+  replica from the event loop. Each session appends to a loop-local
+  queue; a flusher task ticks every ``flush_interval`` seconds and
+  commits the whole backlog as ONE ``put_batch`` + combiner flush on
+  the replica executor — one batched HLC stamp and one donated
+  scatter per tick, however many clients wrote. Acks resolve when
+  the tick's commit returns, so p99 write-ack latency is bounded by
+  (tick interval + one flush), not by client count.
+- **Reads** ride the existing fast paths: ``delta_packed`` answers
+  from the replica's clock-keyed pack cache (a quiet store serves
+  every session's pull from one pack) and the arena's memoryviews are
+  handed to the transport as a vectored ``writelines`` — zero copies
+  in this module. Point ``get`` reads answer from the combiner's
+  read-your-writes overlay.
+- **Cold joins** (the ``digest`` Merkle-walk op) are routed to a
+  bounded single-worker "slow lane" executor: a digest-tree build is
+  the most expensive lock hold in the tier, so at most
+  ``cold_lane_depth`` walks may be queued — the rest are shed with
+  the retryable ``busy`` code and counted, and warm sessions never
+  wait behind a herd of cold peers.
+
+The tier speaks the exact `SyncServer` frame protocol — hello
+negotiation, `FrameCodec` tagged framing, packed/dense/merkle ops,
+error codes — so existing `PeerConnection` clients (and pre-hello
+legacy peers, who simply never send hello) interoperate unchanged;
+the wire-compat tests in tests/test_serve.py prove both directions
+bit-compatible.
+
+Backpressure is explicit and measured (`MetricsRegistry`):
+``crdt_tpu_serve_sessions`` / ``_queue_depth`` gauges,
+``_flush_seconds`` / ``_ack_seconds`` histograms,
+``_shed_total{lane=admission|cold}``, and an admission watermark —
+sessions past ``max_sessions`` are refused at accept with the same
+pre-hello ``busy`` frame `SyncServer` uses, so clients back off
+instead of downgrading.
+
+Blocking discipline: nothing in a coroutine may block the loop — no
+sync frame helpers, no ``time.sleep``, no raw sockets. The crdtlint
+``async-blocking-call`` rule enforces this for the whole module.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import struct
+import threading
+import time
+from concurrent.futures import ThreadPoolExecutor
+from typing import Any, List, Optional, Tuple
+
+import numpy as np
+
+from .hlc import Hlc
+from .net import (MAX_FRAME_BYTES, FrameCodec, WireTally,
+                  _flat_views, _pack_for_peer, _pack_split,
+                  _unpack_split)
+
+
+# --- async framing (the length-prefixed wire of net.py, loop-side) ---
+
+async def read_bytes_frame_async(reader: asyncio.StreamReader,
+                                 codec: Optional[FrameCodec] = None,
+                                 tally: Optional[WireTally] = None
+                                 ) -> Optional[bytes]:
+    """One RAW frame from a stream reader; None on EOF/hangup —
+    exactly `recv_bytes_frame`'s contract, minus the blocking."""
+    try:
+        head = await reader.readexactly(4)
+    except (asyncio.IncompleteReadError, ConnectionError, OSError):
+        return None
+    (n,) = struct.unpack(">I", head)
+    if n > MAX_FRAME_BYTES:
+        raise ValueError(f"peer announced a {n}-byte frame (cap "
+                         f"{MAX_FRAME_BYTES}); corrupt stream?")
+    try:
+        body = await reader.readexactly(n)
+    except (asyncio.IncompleteReadError, ConnectionError, OSError):
+        return None
+    if tally is not None:
+        tally.received += 4 + n
+    if codec is not None:
+        body = codec.decode(body)
+    return body
+
+
+async def read_frame_async(reader: asyncio.StreamReader,
+                           codec: Optional[FrameCodec] = None,
+                           tally: Optional[WireTally] = None
+                           ) -> Optional[Any]:
+    body = await read_bytes_frame_async(reader, codec, tally)
+    return None if body is None else json.loads(body)
+
+
+def frame_pieces(bufs, codec: Optional[FrameCodec] = None,
+                 tally: Optional[WireTally] = None) -> list:
+    """Header + body pieces for one frame, ready for a vectored
+    ``writer.writelines`` — the async twin of `send_bytes_frame`,
+    sharing its codec/tally/size-cap semantics. Pieces pass through
+    as memoryviews (a packed delta's arena views reach the transport
+    with zero copies in our code)."""
+    if codec is not None:
+        bufs = codec.encode(bufs, tally)
+    views = _flat_views(bufs)
+    total = sum(v.nbytes for v in views)
+    if total > MAX_FRAME_BYTES:
+        raise ValueError(f"frame of {total} bytes exceeds "
+                         f"MAX_FRAME_BYTES ({MAX_FRAME_BYTES})")
+    if tally is not None:
+        tally.sent += 4 + total
+    return [struct.pack(">I", total)] + views
+
+
+async def write_frame_async(writer: asyncio.StreamWriter, bufs,
+                            codec: Optional[FrameCodec] = None,
+                            tally: Optional[WireTally] = None) -> None:
+    writer.writelines(frame_pieces(bufs, codec, tally))
+    await writer.drain()
+
+
+async def write_json_async(writer: asyncio.StreamWriter, obj: Any,
+                           codec: Optional[FrameCodec] = None,
+                           tally: Optional[WireTally] = None) -> None:
+    await write_frame_async(writer, [json.dumps(obj).encode()],
+                            codec, tally)
+
+
+class ServeTier:
+    """Serve one replica to thousands of concurrent client sessions.
+
+    Runs its own asyncio event loop on a dedicated daemon thread
+    (``start``/``stop``, or use as a context manager), so synchronous
+    callers — tests, `PeerConnection` clients, the embedding app —
+    need no loop of their own. The replica's `ingest()` window is
+    held open for the tier's whole lifetime; ALL replica access from
+    the tier goes through :attr:`lock` on executor threads, never on
+    the event loop. An application that also touches the replica from
+    other threads must share this lock (pass its own via ``lock=``).
+
+    Serve-only ops, in the same framed JSON protocol::
+
+        {"op": "put",    "slot": s, "value": v} -> {"ok": true}
+        {"op": "delete", "slot": s}             -> {"ok": true}
+        {"op": "get",    "slot": s}             -> {"ok": true, "value": v|null}
+
+    Write acks resolve after the batch containing the write has
+    committed — read-your-writes for the writer, one flush per tick
+    for the tier. A malformed write is answered with code
+    ``write_rejected`` and the session STAYS OPEN (long-lived client
+    sessions should not die for one bad request; protocol-level
+    violations still hang up, like `SyncServer`).
+    """
+
+    # crdtlint lock-discipline contract, same as SyncServer: every
+    # replica access holds the replica lock.
+    _CRDTLINT_GUARDED = {"lock": ("crdt",)}
+
+    def __init__(self, crdt, host: str = "127.0.0.1", port: int = 0,
+                 max_sessions: int = 12000,
+                 flush_interval: float = 0.002,
+                 auto_flush_rows: int = 1 << 15,
+                 cold_lane_depth: int = 8,
+                 idle_timeout: Optional[float] = 300.0,
+                 io_timeout: float = 30.0,
+                 key_encoder=None, value_encoder=None,
+                 key_decoder=None, value_decoder=None,
+                 lock: Optional[threading.RLock] = None):
+        self.crdt = crdt
+        self.lock = lock if lock is not None else threading.RLock()
+        self.host = host
+        self.port: Optional[int] = None
+        self._want_port = port
+        self.max_sessions = max_sessions
+        self.flush_interval = flush_interval
+        self._auto_flush_rows = auto_flush_rows
+        self.cold_lane_depth = cold_lane_depth
+        self.idle_timeout = idle_timeout
+        self._io_timeout = io_timeout
+        self._kenc, self._venc = key_encoder, value_encoder
+        self._kdec, self._vdec = key_decoder, value_decoder
+        self._node = str(crdt.node_id)
+        self._n_slots = int(getattr(crdt, "n_slots", 0) or 0)
+
+        from .obs.registry import default_registry
+        reg = default_registry()
+        self.tally = WireTally()
+        reg.attach("wire", self.tally, role="serve", node=self._node)
+        self._m_sessions = reg.gauge(
+            "crdt_tpu_serve_sessions",
+            "live multiplexed client sessions")
+        self._m_depth = reg.gauge(
+            "crdt_tpu_serve_queue_depth",
+            "writes queued for the next combiner tick")
+        self._m_shed = reg.counter(
+            "crdt_tpu_serve_shed_total",
+            "requests shed for backpressure (admission watermark or "
+            "cold-join lane bound)")
+        self._m_ops = reg.counter(
+            "crdt_tpu_serve_ops_total", "serve-tier ops by kind")
+        self._m_flush = reg.histogram(
+            "crdt_tpu_serve_flush_seconds",
+            "combiner flush wall time under the serving tier, by "
+            "trigger")
+        self._m_ack = reg.histogram(
+            "crdt_tpu_serve_ack_seconds",
+            "write enqueue-to-ack latency (queue wait + tick commit)")
+
+        # Loop-confined state (touched only from the tier's event
+        # loop, so no lock): the pending write queue, live sessions,
+        # shed/drop counters, the cold-lane occupancy.
+        self._q: List[Tuple[int, int, bool, Any, float]] = []
+        self._writers: set = set()
+        self._sessions = 0
+        self.shed_count = 0
+        self.dropped_sessions = 0
+        self._cold_inflight = 0
+
+        # One replica executor serializes every warm-path replica
+        # touch; the cold lane gets its own single worker so a digest
+        # walk never queues AHEAD of the flusher tick.
+        self._replica_pool = ThreadPoolExecutor(
+            max_workers=1, thread_name_prefix="serve-replica")
+        self._cold_pool = ThreadPoolExecutor(
+            max_workers=1, thread_name_prefix="serve-cold")
+
+        self._thread: Optional[threading.Thread] = None
+        self._loop: Optional[asyncio.AbstractEventLoop] = None
+        self._stop_event: Optional[asyncio.Event] = None
+        self._started = threading.Event()
+        self._startup_error: Optional[BaseException] = None
+        self._ingest_cm = None
+        self._wc = None
+
+    # --- lifecycle ---
+
+    def start(self) -> "ServeTier":
+        self._thread = threading.Thread(target=self._run, daemon=True,
+                                        name="serve-tier-loop")
+        self._thread.start()
+        self._started.wait(timeout=60)
+        if self._startup_error is not None:
+            err, self._startup_error = self._startup_error, None
+            self._thread.join(timeout=5)
+            self._thread = None
+            raise err
+        if self.port is None:
+            raise RuntimeError("serving tier failed to start in time")
+        return self
+
+    def stop(self) -> None:
+        thread = self._thread
+        if thread is None:
+            return
+        loop, ev = self._loop, self._stop_event
+        if loop is not None and ev is not None:
+            try:
+                loop.call_soon_threadsafe(ev.set)
+            except RuntimeError:
+                pass
+        thread.join(timeout=60)
+        if thread.is_alive():
+            raise RuntimeError(
+                "serving tier loop failed to stop; the replica may "
+                "still be accessed — do not reuse it")
+        self._thread = None
+        self._replica_pool.shutdown(wait=True)
+        self._cold_pool.shutdown(wait=True)
+
+    def __enter__(self) -> "ServeTier":
+        return self.start()
+
+    def __exit__(self, *exc) -> None:
+        self.stop()
+
+    def _run(self) -> None:
+        try:
+            asyncio.run(self._main())
+        except BaseException as e:   # pragma: no cover - belt+braces
+            if not self._started.is_set():
+                self._startup_error = e
+                self._started.set()
+
+    async def _main(self) -> None:
+        self._loop = asyncio.get_running_loop()
+        self._stop_event = asyncio.Event()
+        try:
+            self._open_ingest()
+        except BaseException as e:
+            self._startup_error = e
+            self._started.set()
+            return
+        try:
+            server = await asyncio.start_server(
+                self._session, self.host, self._want_port,
+                backlog=2048)
+        except BaseException as e:
+            self._startup_error = e
+            self._close_ingest()
+            self._started.set()
+            return
+        self.port = server.sockets[0].getsockname()[1]
+        flusher = asyncio.ensure_future(self._flusher())
+        self._started.set()
+        try:
+            await self._stop_event.wait()
+        finally:
+            server.close()
+            await server.wait_closed()
+            flusher.cancel()
+            try:
+                await flusher
+            except asyncio.CancelledError:
+                pass
+            # Resolve every queued ack, give the sessions one loop
+            # breath to write their replies, then cut the transports.
+            await self._flush_tick()
+            await asyncio.sleep(0)
+            for w in list(self._writers):
+                try:
+                    w.close()
+                except Exception:
+                    pass
+            deadline = self._loop.time() + 5.0
+            while self._sessions and self._loop.time() < deadline:
+                await asyncio.sleep(0.01)
+            self._close_ingest()
+
+    def _open_ingest(self) -> None:
+        with self.lock:
+            self._ingest_cm = self.crdt.ingest(
+                auto_flush_rows=self._auto_flush_rows)
+            self._wc = self._ingest_cm.__enter__()
+            self._wc.on_flush = self._note_flush
+
+    def _close_ingest(self) -> None:
+        with self.lock:
+            wc, self._wc = self._wc, None
+            if wc is not None:
+                wc.on_flush = None
+            cm, self._ingest_cm = self._ingest_cm, None
+            if cm is not None:
+                cm.__exit__(None, None, None)
+
+    def _note_flush(self, trigger: str, rows: int,
+                    seconds: float) -> None:
+        # WriteCombiner flush listener — fires on EVERY trigger (tick,
+        # auto, any barrier a pack/merge drains through), so the
+        # histogram sees the tier's true flush distribution.
+        self._m_flush.observe(seconds, node=self._node,
+                              trigger=trigger)
+
+    # --- write path: queue -> one combiner tick ---
+
+    async def _flusher(self) -> None:
+        while not self._stop_event.is_set():
+            await asyncio.sleep(self.flush_interval)
+            await self._flush_tick()
+
+    async def _flush_tick(self) -> None:
+        if not self._q:
+            self._m_depth.set(0, node=self._node)
+            return
+        q, self._q = self._q, []
+        self._m_depth.set(0, node=self._node)
+        n = len(q)
+        slots = np.fromiter((e[0] for e in q), np.int64, count=n)
+        vals = np.fromiter((e[1] for e in q), np.int64, count=n)
+        tombs = np.fromiter((e[2] for e in q), bool, count=n)
+        try:
+            await self._loop.run_in_executor(
+                self._replica_pool, self._commit, slots, vals, tombs)
+            outcome: Any = True
+        except Exception as e:
+            # The whole tick failed (e.g. a value-width guard): every
+            # writer in it gets the rejection. Resolved via
+            # set_result, not set_exception, so a session torn down
+            # mid-ack never leaves an unretrieved exception behind.
+            outcome = f"{type(e).__name__}: {e}"
+        now = time.perf_counter()
+        for _, _, _, fut, t0 in q:
+            if not fut.done():
+                fut.set_result(outcome)
+            self._m_ack.observe(now - t0, node=self._node)
+
+    def _commit(self, slots: np.ndarray, vals: np.ndarray,
+                tombs: np.ndarray) -> None:
+        with self.lock:
+            wc = self._wc
+            self.crdt.put_batch(slots, vals, tombs)
+            if wc is not None:
+                wc.flush("tick")
+
+    # --- replica helpers (executor threads, lock held) ---
+
+    def _caps(self) -> set:
+        caps = {"zlib"}
+        with self.lock:
+            packed = (hasattr(self.crdt, "pack_since")
+                      and hasattr(self.crdt, "merge_packed"))
+            semantics = packed and hasattr(self.crdt, "set_semantics")
+            merkle = packed and callable(
+                getattr(self.crdt, "digest_tree", None))
+        if packed:
+            caps.add("packed")
+        if semantics:
+            caps.add("semantics")
+        if merkle:
+            caps.add("merkle")
+        return caps
+
+    def _read_slot(self, slot: int):
+        with self.lock:
+            return self.crdt.get(slot)
+
+    def _merge_json(self, payload: str) -> None:
+        with self.lock:
+            self.crdt.merge_json(payload, key_decoder=self._kdec,
+                                 value_decoder=self._vdec)
+
+    def _export_json(self, since: Optional[str]) -> str:
+        with self.lock:
+            return self.crdt.to_json(
+                modified_since=None if since is None
+                else Hlc.parse(since),
+                key_encoder=self._kenc, value_encoder=self._venc)
+
+    def _merge_dense(self, meta, blob: bytes, ids) -> None:
+        scs = _unpack_split(meta, blob)
+        if not isinstance(ids, list) or not ids:
+            raise ValueError("push_dense without node_ids")
+        with self.lock:
+            self.crdt.merge_split(scs, ids)
+
+    def _export_dense(self, since: Optional[str]):
+        with self.lock:
+            scs, ids = self.crdt.export_split_delta(
+                None if since is None else Hlc.parse(since))
+        meta, bufs = _pack_split(scs)
+        return {"meta": meta, "node_ids": list(ids)}, bufs
+
+    def _merge_packed(self, meta, blob: bytes, ids) -> None:
+        from .ops.packing import unpack_rows
+        packed = unpack_rows(meta, blob)
+        if not isinstance(ids, list):
+            raise ValueError("push_packed without node_ids")
+        if packed.k:
+            with self.lock:
+                self.crdt.merge_packed(packed, ids)
+
+    def _export_packed(self, since: Optional[str], ranges,
+                       sem_ok: bool):
+        from .ops.packing import pack_rows
+        if ranges is not None:
+            ranges = tuple((int(lo), int(hi)) for lo, hi in ranges)
+        with self.lock:
+            packed, ids = _pack_for_peer(
+                self.crdt, None if since is None else Hlc.parse(since),
+                sem_ok, ranges=ranges)
+        meta, bufs = pack_rows(packed)
+        return ({"meta": meta, "node_ids": list(ids),
+                 "k": packed.k}, bufs)
+
+    def _digest_values(self, groups):
+        with self.lock:
+            tree = self.crdt.digest_tree()
+            per_group = [tree.values(lvl, ix) for lvl, ix in groups]
+        flat = [v for vals in per_group for v in vals]
+        buf = np.asarray(flat, np.uint64).astype(">u8").tobytes()
+        return ({"op": "digest_resp", "ok": True, "k": len(flat),
+                 "ks": [len(v) for v in per_group],
+                 "n_slots": tree.n_slots,
+                 "leaf_width": tree.leaf_width,
+                 "depth": tree.depth}, buf)
+
+    def _metrics_snapshot(self) -> dict:
+        from .obs import metrics_snapshot
+        snap = metrics_snapshot()
+        if "node" not in snap:
+            with self.lock:
+                snap["node"] = {
+                    "node_id": str(self.crdt.node_id),
+                    "hlc_head": str(self.crdt.canonical_time)}
+        return snap
+
+    # --- the session coroutine ---
+
+    async def _session(self, reader: asyncio.StreamReader,
+                       writer: asyncio.StreamWriter) -> None:
+        if self._sessions >= self.max_sessions \
+                or self._stop_event.is_set():
+            # Admission watermark: refuse with the same pre-hello
+            # untagged busy frame SyncServer's accept path uses, so
+            # every client generation reads it and backs off
+            # (retryable, never the legacy-downgrade signal).
+            self.shed_count += 1
+            self._m_shed.inc(lane="admission", node=self._node)
+            try:
+                await write_json_async(
+                    writer,
+                    {"ok": False, "code": "busy",
+                     "error": "serving tier at capacity "
+                              f"(max_sessions={self.max_sessions})"},
+                    None, self.tally)
+            except (ConnectionError, OSError):
+                pass
+            await self._hangup(writer)
+            return
+        self._sessions += 1
+        self._m_sessions.set(self._sessions, node=self._node)
+        self._writers.add(writer)
+        try:
+            await self._session_loop(reader, writer)
+        except (ConnectionError, OSError, ValueError,
+                json.JSONDecodeError, asyncio.TimeoutError,
+                asyncio.IncompleteReadError):
+            # An ADMITTED session torn down by error (vs a clean
+            # bye/EOF) counts as dropped — the bench's "zero dropped
+            # below the watermark" criterion reads this.
+            self.dropped_sessions += 1
+        finally:
+            self._writers.discard(writer)
+            self._sessions -= 1
+            self._m_sessions.set(self._sessions, node=self._node)
+            await self._hangup(writer)
+
+    @staticmethod
+    async def _hangup(writer: asyncio.StreamWriter) -> None:
+        try:
+            writer.close()
+            await writer.wait_closed()
+        except (ConnectionError, OSError):
+            pass
+
+    async def _read_op(self, reader: asyncio.StreamReader,
+                       codec: Optional[FrameCodec]):
+        if self.idle_timeout is None:
+            return await read_frame_async(reader, codec, self.tally)
+        return await asyncio.wait_for(
+            read_frame_async(reader, codec, self.tally),
+            timeout=self.idle_timeout)
+
+    async def _read_blob(self, reader: asyncio.StreamReader,
+                         codec: Optional[FrameCodec]):
+        # Binary continuation frames are bounded by io_timeout, like
+        # SyncServer: an announced-but-never-sent frame must not hold
+        # the session forever.
+        return await asyncio.wait_for(
+            read_bytes_frame_async(reader, codec, self.tally),
+            timeout=self._io_timeout)
+
+    async def _session_loop(self, reader: asyncio.StreamReader,
+                            writer: asyncio.StreamWriter) -> None:
+        loop = self._loop
+        codec: Optional[FrameCodec] = None
+        sem_ok = False
+        while not self._stop_event.is_set():
+            msg = await self._read_op(reader, codec)
+            if msg is None or not isinstance(msg, dict) \
+                    or msg.get("op") == "bye":
+                return
+            op = msg.get("op")
+            self._m_ops.inc(op=str(op), node=self._node)
+
+            if op in ("put", "delete"):
+                slot = msg.get("slot")
+                value = msg.get("value", 0)
+                if not isinstance(slot, int) \
+                        or not 0 <= slot < self._n_slots \
+                        or not isinstance(value, int):
+                    await write_json_async(
+                        writer, {"ok": False, "code": "write_rejected",
+                                 "error": "bad slot/value"},
+                        codec, self.tally)
+                    continue
+                fut = loop.create_future()
+                self._q.append((slot, value, op == "delete", fut,
+                                time.perf_counter()))
+                self._m_depth.set(len(self._q), node=self._node)
+                outcome = await fut
+                if outcome is True:
+                    await write_json_async(writer, {"ok": True},
+                                           codec, self.tally)
+                else:
+                    await write_json_async(
+                        writer, {"ok": False, "code": "write_rejected",
+                                 "error": str(outcome)},
+                        codec, self.tally)
+
+            elif op == "get":
+                slot = msg.get("slot")
+                if not isinstance(slot, int) \
+                        or not 0 <= slot < self._n_slots:
+                    await write_json_async(
+                        writer, {"ok": False, "code": "write_rejected",
+                                 "error": "bad slot"},
+                        codec, self.tally)
+                    continue
+                value = await loop.run_in_executor(
+                    self._replica_pool, self._read_slot, slot)
+                await write_json_async(writer,
+                                       {"ok": True, "value": value},
+                                       codec, self.tally)
+
+            elif op == "hello":
+                want = msg.get("caps")
+                want = set(want) if isinstance(want, list) else set()
+                agreed = sorted(want & self._caps())
+                await write_json_async(
+                    writer, {"ok": True, "proto": 1, "caps": agreed},
+                    codec, self.tally)
+                codec = FrameCodec(compress="zlib" in agreed)
+                sem_ok = "semantics" in agreed
+
+            elif op == "push":
+                try:
+                    await loop.run_in_executor(
+                        self._replica_pool, self._merge_json,
+                        msg["payload"])
+                except Exception as e:
+                    await write_json_async(
+                        writer, {"ok": False, "code": "merge_rejected",
+                                 "error": type(e).__name__,
+                                 "detail": str(e)},
+                        codec, self.tally)
+                    return
+                await write_json_async(writer, {"ok": True}, codec,
+                                       self.tally)
+
+            elif op == "delta":
+                try:
+                    payload = await loop.run_in_executor(
+                        self._replica_pool, self._export_json,
+                        msg.get("since"))
+                except Exception as e:
+                    await write_json_async(
+                        writer, {"code": "delta_failed",
+                                 "error": type(e).__name__,
+                                 "detail": str(e)},
+                        codec, self.tally)
+                    return
+                await write_json_async(writer, {"payload": payload},
+                                       codec, self.tally)
+
+            elif op == "push_dense":
+                blob = await self._read_blob(reader, codec)
+                if blob is None:
+                    return
+                try:
+                    await loop.run_in_executor(
+                        self._replica_pool, self._merge_dense,
+                        msg.get("meta"), blob, msg.get("node_ids"))
+                except Exception as e:
+                    await write_json_async(
+                        writer, {"ok": False, "code": "dense_rejected",
+                                 "error": type(e).__name__,
+                                 "detail": str(e)},
+                        codec, self.tally)
+                    return
+                await write_json_async(writer, {"ok": True}, codec,
+                                       self.tally)
+
+            elif op == "delta_dense":
+                try:
+                    meta_msg, bufs = await loop.run_in_executor(
+                        self._replica_pool, self._export_dense,
+                        msg.get("since"))
+                except Exception as e:
+                    await write_json_async(
+                        writer, {"code": "dense_rejected",
+                                 "error": type(e).__name__,
+                                 "detail": str(e)},
+                        codec, self.tally)
+                    return
+                await write_json_async(writer, meta_msg, codec,
+                                       self.tally)
+                await write_frame_async(writer, bufs, codec,
+                                        self.tally)
+
+            elif op == "push_packed":
+                blob = await self._read_blob(reader, codec)
+                if blob is None:
+                    return
+                try:
+                    await loop.run_in_executor(
+                        self._replica_pool, self._merge_packed,
+                        msg.get("meta"), blob, msg.get("node_ids"))
+                except Exception as e:
+                    await write_json_async(
+                        writer, {"ok": False,
+                                 "code": "packed_rejected",
+                                 "error": type(e).__name__,
+                                 "detail": str(e)},
+                        codec, self.tally)
+                    return
+                await write_json_async(writer, {"ok": True}, codec,
+                                       self.tally)
+
+            elif op == "delta_packed":
+                try:
+                    meta_msg, bufs = await loop.run_in_executor(
+                        self._replica_pool, self._export_packed,
+                        msg.get("since"), msg.get("ranges"), sem_ok)
+                except Exception as e:
+                    await write_json_async(
+                        writer, {"code": "packed_rejected",
+                                 "error": type(e).__name__,
+                                 "detail": str(e)},
+                        codec, self.tally)
+                    return
+                await write_json_async(writer, meta_msg, codec,
+                                       self.tally)
+                # The packed read path's last hop: arena memoryviews,
+                # vectored out with zero copies in this module.
+                await write_frame_async(writer, bufs, codec,
+                                        self.tally)
+
+            elif op == "digest":
+                # Cold-join slow lane: bounded, sheddable, and on its
+                # OWN executor so a tree build never runs ahead of a
+                # warm flush tick in the replica queue.
+                if self._cold_inflight >= self.cold_lane_depth:
+                    self.shed_count += 1
+                    self._m_shed.inc(lane="cold", node=self._node)
+                    await write_json_async(
+                        writer,
+                        {"ok": False, "code": "busy",
+                         "error": "cold-join lane full "
+                                  f"(depth={self.cold_lane_depth})"},
+                        codec, self.tally)
+                    continue
+                try:
+                    groups = _parse_digest_groups(msg)
+                except ValueError as e:
+                    await write_json_async(
+                        writer, {"code": "merkle_rejected",
+                                 "error": type(e).__name__,
+                                 "detail": str(e)},
+                        codec, self.tally)
+                    return
+                self._cold_inflight += 1
+                try:
+                    reply, buf = await loop.run_in_executor(
+                        self._cold_pool, self._digest_values, groups)
+                except Exception as e:
+                    await write_json_async(
+                        writer, {"code": "merkle_rejected",
+                                 "error": type(e).__name__,
+                                 "detail": str(e)},
+                        codec, self.tally)
+                    return
+                finally:
+                    self._cold_inflight -= 1
+                await write_json_async(writer, reply, codec,
+                                       self.tally)
+                await write_frame_async(writer, [buf], codec,
+                                        self.tally)
+
+            elif op == "metrics":
+                try:
+                    snap = await loop.run_in_executor(
+                        self._replica_pool, self._metrics_snapshot)
+                except Exception as e:
+                    await write_json_async(
+                        writer, {"code": "metrics_failed",
+                                 "error": type(e).__name__,
+                                 "detail": str(e)},
+                        codec, self.tally)
+                    return
+                await write_json_async(writer, {"metrics": snap},
+                                       codec, self.tally)
+
+            else:
+                await write_json_async(
+                    writer, {"code": "unknown_op",
+                             "error": f"unknown op {op!r}"},
+                    codec, self.tally)
+                return
+
+
+def _parse_digest_groups(msg: dict) -> list:
+    """Validate a digest op into [(level, idx-list), ...] — the same
+    checks SyncServer applies, shared shape with the prefetch 'more'
+    extension."""
+    level = msg.get("level")
+    idxs = msg.get("idx")
+    if not isinstance(level, int) or not isinstance(idxs, list):
+        raise ValueError("digest needs int 'level' + list 'idx'")
+    groups = [(level, idxs)]
+    more = msg.get("more")
+    if more is not None:
+        if not isinstance(more, list):
+            raise ValueError(
+                "digest 'more' must be a list of [level, idx] pairs")
+        for pair in more:
+            lvl2, idx2 = pair
+            if not isinstance(lvl2, int) or not isinstance(idx2, list):
+                raise ValueError(
+                    "digest 'more' entries need int level + list idx")
+            groups.append((lvl2, idx2))
+    return groups

@@ -222,6 +222,80 @@ def test_pack_path_copy_suppressible_with_reason():
     assert "suppression-without-reason" not in rules
 
 
+def test_async_blocking_call_flags_all_three_families():
+    # The three blocking families the serving tier must never touch
+    # from a coroutine: time.sleep, a raw socket ctor, and the sync
+    # frame helpers (which block on sendall/recv under the hood).
+    src = (
+        "async def handle(reader, writer):\n"
+        "    time.sleep(0.01)\n"
+        "    s = socket.socket(socket.AF_INET, socket.SOCK_STREAM)\n"
+        "    send_frame(s, {'op': 'hello'}, None)\n"
+        "    reply = recv_frame(s, None)\n")
+    findings = [f for f in lint_source(src, "snippet.py")
+                if f.rule == "async-blocking-call"]
+    assert len(findings) == 4
+    assert all("coroutine handle()" in f.message for f in findings)
+
+
+def test_async_blocking_call_ignores_sync_functions():
+    # The exact same calls in a plain def are the NORMAL sync path
+    # (net.py is built from them) — only coroutines are in scope.
+    src = (
+        "def handle(conn):\n"
+        "    time.sleep(0.01)\n"
+        "    send_frame(conn, {'op': 'hello'}, None)\n")
+    rules = {f.rule for f in lint_source(src, "snippet.py")}
+    assert "async-blocking-call" not in rules
+
+
+def test_async_blocking_call_skips_nested_sync_def_and_executor_refs():
+    # A sync helper DEFINED inside the coroutine is executor bait —
+    # its body runs off-loop. Passing a frame helper by reference to
+    # run_in_executor never calls it on the loop either.
+    src = (
+        "async def serve(loop, conn, frame):\n"
+        "    def _pump():\n"
+        "        send_frame(conn, frame, None)\n"
+        "        time.sleep(0)\n"
+        "    await loop.run_in_executor(None, _pump)\n"
+        "    await loop.run_in_executor(None, recv_frame, conn, None)\n")
+    rules = {f.rule for f in lint_source(src, "snippet.py")}
+    assert "async-blocking-call" not in rules
+
+
+def test_async_blocking_call_flags_blocking_socket_methods():
+    src = (
+        "async def relay(sock, blob):\n"
+        "    sock.sendall(blob)\n"
+        "    return sock.recv(4)\n")
+    findings = [f for f in lint_source(src, "snippet.py")
+                if f.rule == "async-blocking-call"]
+    assert len(findings) == 2
+
+
+def test_async_blocking_call_awaited_calls_pass():
+    # Directly-awaited calls are async APIs whatever their name —
+    # asyncio's own loop.sock_connect / connect coroutines must pass.
+    src = (
+        "async def dial(loop, sock, addr, conn):\n"
+        "    await loop.sock_connect(sock, addr)\n"
+        "    await conn.connect()\n")
+    rules = {f.rule for f in lint_source(src, "snippet.py")}
+    assert "async-blocking-call" not in rules
+
+
+def test_async_blocking_call_suppressible_with_reason():
+    src = (
+        "async def shutdown(self, sock):\n"
+        "    # crdtlint: disable=async-blocking-call -- teardown path,"
+        " loop already draining\n"
+        "    sock.sendall(b'bye')\n")
+    rules = {f.rule for f in lint_source(src, "snippet.py")}
+    assert "async-blocking-call" not in rules
+    assert "suppression-without-reason" not in rules
+
+
 def test_shipped_tree_lints_clean():
     from crdt_tpu.analysis.host_lint import lint_package
     import crdt_tpu

@@ -18,7 +18,7 @@ from crdt_tpu import (DenseCrdt, GossipNode, RetryPolicy, SyncServer,
                       sync_merkle, sync_merkle_over_conn)
 from crdt_tpu.gossip import Peer
 from crdt_tpu.obs.registry import default_registry
-from crdt_tpu.ops.digest import (coalesce_leaf_ranges,
+from crdt_tpu.ops.digest import (PREFETCH_LEVELS, coalesce_leaf_ranges,
                                  walk_divergent_leaves)
 from crdt_tpu.sync import _packed_nbytes
 from crdt_tpu.testing import (FakeClock, FaultProxy, ScriptedSchedule)
@@ -77,6 +77,7 @@ def test_walk_localizes_single_slot_divergence():
     b.put_batch([37], [999])
     tb = b.digest_tree()
     leaves, rounds, fetched = walk_divergent_leaves(ta, tb.values)
+    # single-level fetch (the pre-prefetch wire op): one round/level
     assert rounds == ta.depth
     spans = coalesce_leaf_ranges(leaves, ta.leaf_width, ta.n_slots)
     assert len(spans) == 1
@@ -84,6 +85,14 @@ def test_walk_localizes_single_slot_divergence():
     assert lo <= 37 < hi and hi - lo == ta.leaf_width
     # the walk touches one path, not the whole bottom level
     assert fetched < 3 * ta.depth
+    # batched frontier prefetch: PREFETCH_LEVELS levels per round
+    # trip, same leaves, and the speculative fan-out stays bounded by
+    # (2^P - 1) digests per frontier node per round
+    leaves_p, rounds_p, fetched_p = walk_divergent_leaves(
+        ta, None, fetch_levels=tb.values_levels)
+    assert sorted(leaves_p) == sorted(leaves)
+    assert rounds_p == -(-ta.depth // PREFETCH_LEVELS)
+    assert fetched_p <= rounds_p * 2 * (2 ** PREFETCH_LEVELS - 1)
 
 
 def test_clean_walk_costs_one_round():
@@ -92,6 +101,14 @@ def test_clean_walk_costs_one_round():
     t = a.digest_tree()
     leaves, rounds, fetched = walk_divergent_leaves(t, t.values)
     assert leaves == [] and rounds == 1 and fetched == 1
+    # prefetch: still ONE round trip — matching roots end the walk at
+    # level 0; the speculative descendants rode along (2^l digests at
+    # each prefetched level l) and were simply unused
+    leaves, rounds, fetched = walk_divergent_leaves(
+        t, None, fetch_levels=t.values_levels)
+    assert leaves == [] and rounds == 1
+    assert fetched == sum(
+        2 ** l for l in range(min(PREFETCH_LEVELS, t.depth)))
 
 
 # ------------------------------------------------ range pack
@@ -153,6 +170,53 @@ def test_unchanged_store_answers_digest_from_cache():
     assert ctr.value(outcome="miss", node="cache") == m0 + 2
 
 
+def test_restart_answers_first_walk_from_persisted_digest(tmp_path):
+    """Digest-tree persistence: `DenseCrdt.save` writes the tree under
+    its cache key; `load` re-seeds the cache, so the restarted
+    replica's FIRST digest_tree() is a cache hit — zero digest
+    dispatches before the first walk — and the tree is level-for-level
+    identical to the one saved."""
+    ctr = default_registry().counter("crdt_tpu_digest_cache_total", "")
+    c = _make("boot", 64)
+    c.put_batch(list(range(0, 64, 4)), list(range(16)))
+    c.delete_batch([8])
+    t_saved = c.digest_tree()
+    path = str(tmp_path / "snap.npz")
+    c.save(path)
+    r = DenseCrdt.load("boot", path, wall_clock=FakeClock(start=BASE))
+    h0 = ctr.value(outcome="hit", node="boot")
+    m0 = ctr.value(outcome="miss", node="boot")
+    t = r.digest_tree()
+    assert ctr.value(outcome="hit", node="boot") == h0 + 1
+    assert ctr.value(outcome="miss", node="boot") == m0   # no rebuild
+    assert t.same_geometry(t_saved.n_slots, t_saved.leaf_width,
+                           t_saved.depth)
+    for saved_lvl, got_lvl in zip(t_saved.levels, t.levels):
+        np.testing.assert_array_equal(np.asarray(saved_lvl),
+                                      np.asarray(got_lvl))
+    # and the seeded cache obeys the usual invalidation discipline
+    r.put_batch([1], [999])
+    assert r.digest_tree() is not t
+    assert ctr.value(outcome="miss", node="boot") == m0 + 1
+
+
+def test_pre_digest_snapshot_loads_and_rebuilds(tmp_path):
+    """A snapshot saved WITHOUT a digest (store-level `save_dense`,
+    i.e. every pre-persistence snapshot) still loads; the first walk
+    simply rebuilds — a missing cache, never a failed restore."""
+    from crdt_tpu.checkpoint import load_dense_digest, save_dense
+    c = _make("old", 32)
+    c.put_batch([1, 2], [10, 20])
+    path = str(tmp_path / "old.npz")
+    save_dense(c.store, path, node_ids=["old"])
+    assert load_dense_digest(path) is None
+    ctr = default_registry().counter("crdt_tpu_digest_cache_total", "")
+    r = DenseCrdt.load("old", path, wall_clock=FakeClock(start=BASE))
+    m0 = ctr.value(outcome="miss", node="old")
+    r.digest_tree()
+    assert ctr.value(outcome="miss", node="old") == m0 + 1
+
+
 # ------------------------------------------------ socket path
 
 def test_cold_empty_peer_converges_over_socket():
@@ -168,8 +232,11 @@ def test_cold_empty_peer_converges_over_socket():
             sync_merkle_over_conn(client, conn, _stats=stats)
     _stores_equal(client, server_crdt)
     assert client.digest_tree().root == server_crdt.digest_tree().root
-    # every level costs one round trip; a cold join walks the tree
-    assert 1 <= stats["rounds"] <= client.digest_tree().depth
+    # frontier prefetch batches PREFETCH_LEVELS tree levels per round
+    # trip, so a cold join walks the whole tree in ceil(depth/P)
+    # rounds — the pinned wire-round budget for high-RTT links
+    depth = client.digest_tree().depth
+    assert stats["rounds"] == -(-depth // PREFETCH_LEVELS)
     assert stats["pulled_rows"] == len(ids)
 
 

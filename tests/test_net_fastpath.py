@@ -171,6 +171,70 @@ def test_zlib_cap_compresses_big_payloads():
     assert hub.map == edge.map
 
 
+# --- admission refusal: busy is retryable, never a downgrade ---
+
+def test_busy_refusal_is_retryable_not_legacy():
+    """A connection past max_conns used to be closed silently (the
+    client saw a raw EOF mid-hello). The server now answers a 'busy'
+    error frame pre-hello; the client must classify it as a RETRYABLE
+    transport fault — no sticky legacy mark, no capability downgrade —
+    and succeed on a later redial once a slot frees."""
+    import time
+    with SyncServer(DenseCrdt("s", n_slots=16), max_conns=1) as server:
+        with PeerConnection(server.host, server.port,
+                            timeout=5.0) as first:
+            first.ensure()                   # occupies the only slot
+            second = PeerConnection(server.host, server.port,
+                                    timeout=5.0)
+            with pytest.raises(SyncTransportError, match="busy"):
+                second.ensure()
+            # the refusal was understood, not mistaken for a pre-hello
+            # server or a dead link
+            assert second.legacy is False
+            assert second.caps == frozenset()
+            assert not second.connected
+        # first session closed -> its handler exits; the retry that
+        # gossip's backoff would issue now lands in the freed slot
+        for _ in range(100):
+            try:
+                second.ensure()
+                break
+            except SyncTransportError:
+                time.sleep(0.02)
+        else:
+            raise AssertionError("slot never freed after close")
+        assert second.legacy is False
+        assert "packed" in second.caps       # full renegotiation
+        second.close()
+
+
+def test_busy_refusal_speaks_pre_hello_framing():
+    """The refusal crosses BEFORE any hello, so it must ride the
+    untagged legacy framing every client generation can read — a
+    pre-fast-path client sees a structured error, not a reset."""
+    with SyncServer(DenseCrdt("s", n_slots=16), max_conns=1) as server:
+        with PeerConnection(server.host, server.port,
+                            timeout=5.0) as first:
+            first.ensure()
+            with socket.create_connection(
+                    (server.host, server.port), timeout=5.0) as sock:
+                reply = _legacy_recv(sock)
+                assert reply["ok"] is False
+                assert reply["code"] == "busy"
+
+
+def test_busy_code_is_not_a_gossip_fallback_signal():
+    """'busy' must never appear in the sticky-downgrade code sets:
+    a capacity blip on a merkle-capable peer would otherwise demote
+    the pair to packed/dense/json forever."""
+    from crdt_tpu.gossip import (_DENSE_FALLBACK_CODES,
+                                 _MERKLE_FALLBACK_CODES,
+                                 _PACKED_FALLBACK_CODES)
+    for codes in (_MERKLE_FALLBACK_CODES, _PACKED_FALLBACK_CODES,
+                  _DENSE_FALLBACK_CODES):
+        assert "busy" not in codes
+
+
 # --- legacy interop: the pre-PR wire, both directions ---
 
 def _legacy_send(sock, obj):

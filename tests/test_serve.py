@@ -1,0 +1,267 @@
+"""Serving-tier suite (docs/SERVING.md): session multiplexing onto
+the combiner tick, admission watermark, cold-lane bounds, and wire
+compatibility with every client generation — negotiated
+`PeerConnection` sessions (packed + merkle) and pre-hello legacy
+peers — in both directions."""
+
+import socket
+import time
+
+import pytest
+
+from crdt_tpu import (DenseCrdt, PeerConnection, ServeTier,
+                      SyncTransportError, default_registry,
+                      fetch_metrics, sync_merkle_over_conn,
+                      sync_over_tcp, sync_packed_over_conn)
+from crdt_tpu.net import recv_frame, send_frame
+
+pytestmark = pytest.mark.serve
+
+
+def _connect(tier):
+    sock = socket.create_connection((tier.host, tier.port),
+                                    timeout=10.0)
+    sock.settimeout(10.0)
+    return sock
+
+
+def _req(sock, obj, codec=None):
+    send_frame(sock, obj, None, codec)
+    return recv_frame(sock, deadline=time.monotonic() + 10.0,
+                      codec=codec)
+
+
+# --- serve-only ops: put / get / delete over the framed wire ---
+
+def test_put_get_delete_roundtrip():
+    crdt = DenseCrdt("a", n_slots=64)
+    with ServeTier(crdt, flush_interval=0.002) as tier:
+        with _connect(tier) as sock:
+            assert _req(sock, {"op": "put", "slot": 3,
+                               "value": 42}) == {"ok": True}
+            # read-your-writes: the ack resolved AFTER the commit, so
+            # the overlay/store answers immediately.
+            assert _req(sock, {"op": "get", "slot": 3}) \
+                == {"ok": True, "value": 42}
+            assert _req(sock, {"op": "delete", "slot": 3}) \
+                == {"ok": True}
+            assert _req(sock, {"op": "get", "slot": 3})["value"] is None
+            send_frame(sock, {"op": "bye"})
+    # tier stopped -> ingest window closed; direct reads are safe.
+    assert crdt.get(3) is None
+
+
+def test_malformed_write_rejected_session_survives():
+    crdt = DenseCrdt("a", n_slots=64)
+    with ServeTier(crdt) as tier:
+        with _connect(tier) as sock:
+            for bad in ({"op": "put", "slot": 999, "value": 1},
+                        {"op": "put", "slot": -1, "value": 1},
+                        {"op": "put", "slot": 1, "value": "x"},
+                        {"op": "get", "slot": "nope"}):
+                reply = _req(sock, bad)
+                assert reply["ok"] is False
+                assert reply["code"] == "write_rejected"
+            # ...and the session is still alive afterwards.
+            assert _req(sock, {"op": "put", "slot": 5,
+                               "value": 7}) == {"ok": True}
+            send_frame(sock, {"op": "bye"})
+    assert crdt.get(5) == 7
+
+
+def test_unknown_op_hangs_up():
+    crdt = DenseCrdt("a", n_slots=64)
+    with ServeTier(crdt) as tier:
+        with _connect(tier) as sock:
+            reply = _req(sock, {"op": "frobnicate"})
+            assert reply["code"] == "unknown_op"
+            assert recv_frame(sock,
+                              deadline=time.monotonic() + 10.0) is None
+
+
+# --- the tentpole property: N writers, ONE combiner tick ---
+
+def test_many_sessions_share_one_combiner_tick():
+    crdt = DenseCrdt("a", n_slots=256)
+    flushes = default_registry().counter(
+        "crdt_tpu_ingest_flush_total",
+        "write-combiner flushes by trigger")
+    before = flushes.value(trigger="tick", node="a")
+    with ServeTier(crdt, flush_interval=0.05) as tier:
+        socks = [_connect(tier) for _ in range(8)]
+        try:
+            # All eight sessions write BEFORE any reads its ack: the
+            # writes land in the same queue window and commit as one
+            # put_batch + one combiner flush.
+            for i, s in enumerate(socks):
+                send_frame(s, {"op": "put", "slot": i, "value": i * 10})
+            for s in socks:
+                assert recv_frame(
+                    s, deadline=time.monotonic() + 10.0) == {"ok": True}
+            ticks = flushes.value(trigger="tick", node="a") - before
+            # 8 writers, at most 2 ticks (2 only if a tick boundary
+            # happened to split the sends) — never one flush per write.
+            assert 1 <= ticks <= 2
+        finally:
+            for s in socks:
+                s.close()
+    for i in range(8):
+        assert crdt.get(i) == i * 10
+    assert tier.dropped_sessions == 0
+
+
+# --- admission watermark ---
+
+def test_admission_watermark_sheds_with_busy():
+    crdt = DenseCrdt("a", n_slots=64)
+    with ServeTier(crdt, max_sessions=2) as tier:
+        c1 = PeerConnection(tier.host, tier.port, timeout=5.0)
+        c2 = PeerConnection(tier.host, tier.port, timeout=5.0)
+        c3 = PeerConnection(tier.host, tier.port, timeout=5.0)
+        try:
+            c1.ensure()
+            c2.ensure()
+            with pytest.raises(SyncTransportError, match="busy"):
+                c3.ensure()
+            # Retryable refusal, NOT the legacy-downgrade signal.
+            assert c3.legacy is False
+            assert tier.shed_count >= 1
+            shed = default_registry().counter(
+                "crdt_tpu_serve_shed_total",
+                "requests shed for backpressure (admission watermark "
+                "or cold-join lane bound)")
+            assert shed.value(lane="admission", node="a") >= 1
+            # Freeing a slot readmits the shed client (bye is
+            # processed asynchronously server-side, so poll).
+            c1.close()
+            for _ in range(500):
+                try:
+                    c3.ensure()
+                    break
+                except SyncTransportError:
+                    time.sleep(0.01)
+            else:
+                raise AssertionError("slot never freed after close")
+            assert "packed" in c3.caps
+        finally:
+            for c in (c1, c2, c3):
+                c.close()
+
+
+def test_hello_negotiates_full_caps():
+    crdt = DenseCrdt("a", n_slots=64)
+    with ServeTier(crdt) as tier:
+        with PeerConnection(tier.host, tier.port, timeout=5.0) as conn:
+            conn.ensure()
+            assert {"zlib", "packed", "semantics",
+                    "merkle"} <= conn.caps
+            assert conn.codec is not None
+
+
+# --- cold-join slow lane ---
+
+def test_cold_lane_bound_sheds_digest_with_busy():
+    crdt = DenseCrdt("a", n_slots=64)
+    crdt.put_batch([1], [1])
+    joiner = DenseCrdt("b", n_slots=64)
+    with ServeTier(crdt, cold_lane_depth=0) as tier:
+        with PeerConnection(tier.host, tier.port, timeout=5.0) as conn:
+            with pytest.raises(SyncTransportError, match="busy"):
+                sync_merkle_over_conn(joiner, conn)
+        assert tier.shed_count >= 1
+        shed = default_registry().counter(
+            "crdt_tpu_serve_shed_total",
+            "requests shed for backpressure (admission watermark "
+            "or cold-join lane bound)")
+        assert shed.value(lane="cold", node="a") >= 1
+
+
+def test_merkle_cold_join_through_tier():
+    crdt = DenseCrdt("a", n_slots=64)
+    slots = list(range(0, 64, 7))
+    crdt.put_batch(slots, [s * 3 + 1 for s in slots])
+    joiner = DenseCrdt("b", n_slots=64)
+    with ServeTier(crdt) as tier:
+        with PeerConnection(tier.host, tier.port, timeout=5.0) as conn:
+            stats = {}
+            sync_merkle_over_conn(joiner, conn, _stats=stats)
+            assert stats["rounds"] >= 1
+    for s in slots:
+        assert joiner.get(s) == s * 3 + 1
+
+
+# --- wire compat: negotiated packed sessions, both directions ---
+
+def test_packed_round_through_tier_converges_both_ways():
+    served = DenseCrdt("a", n_slots=64)
+    client = DenseCrdt("b", n_slots=64)
+    served.put_batch([1, 2], [10, 20])
+    client.put_batch([5], [50])
+    with ServeTier(served) as tier:
+        with PeerConnection(tier.host, tier.port, timeout=5.0) as conn:
+            mark = sync_packed_over_conn(client, conn, since=None)
+            assert client.get(1) == 10 and client.get(2) == 20
+            for _ in range(6):
+                with tier.lock:
+                    before = (str(served.canonical_time),
+                              str(client.canonical_time))
+                mark = sync_packed_over_conn(client, conn, since=mark)
+                with tier.lock:
+                    after = (str(served.canonical_time),
+                             str(client.canonical_time))
+                if after == before:
+                    break
+            else:
+                raise AssertionError(
+                    "clocks never settled through the tier")
+    assert served.get(5) == 50
+    assert client.get(5) == 50
+    assert served.get(1) == 10 and served.get(2) == 20
+
+
+def test_writes_landed_mid_session_reach_packed_pulls():
+    served = DenseCrdt("a", n_slots=64)
+    client = DenseCrdt("b", n_slots=64)
+    with ServeTier(served) as tier:
+        # A serve-session write...
+        with _connect(tier) as wsock:
+            assert _req(wsock, {"op": "put", "slot": 9,
+                                "value": 99}) == {"ok": True}
+            send_frame(wsock, {"op": "bye"})
+        # ...is visible to a packed replication pull on the same tier
+        # (the pack path drains the combiner as its barrier).
+        with PeerConnection(tier.host, tier.port, timeout=5.0) as conn:
+            sync_packed_over_conn(client, conn, since=None)
+    assert client.get(9) == 99
+
+
+# --- wire compat: pre-hello legacy JSON peers ---
+
+def test_legacy_pre_hello_json_round():
+    served = DenseCrdt("a", n_slots=64)
+    legacy = DenseCrdt("b", n_slots=64)
+    served.put_batch([2], [22])
+    legacy.put_batch([4], [44])
+    with ServeTier(served) as tier:
+        # sync_over_tcp never sends hello: byte-identical legacy wire.
+        sync_over_tcp(legacy, tier.host, tier.port)
+        assert legacy.get(2) == 22
+        with tier.lock:
+            assert served.get(4) == 44
+    assert served.get(4) == 44
+
+
+# --- observability surface ---
+
+def test_metrics_op_reports_serve_instruments():
+    crdt = DenseCrdt("a", n_slots=64)
+    with ServeTier(crdt) as tier:
+        with _connect(tier) as sock:
+            assert _req(sock, {"op": "put", "slot": 1,
+                               "value": 2}) == {"ok": True}
+            send_frame(sock, {"op": "bye"})
+        snap = fetch_metrics(tier.host, tier.port)
+    assert "crdt_tpu_serve_sessions" in snap["gauges"]
+    assert "crdt_tpu_serve_ops_total" in snap["counters"]
+    assert "crdt_tpu_serve_ack_seconds" in snap["histograms"]
+    assert "crdt_tpu_serve_flush_seconds" in snap["histograms"]
