@@ -1043,6 +1043,11 @@ class PeerConnection:
         self.negotiate = negotiate
         self.want_caps = tuple(want_caps)
         self.legacy = False
+        # Cleared (sticky per session) the first time a peer answers a
+        # multi-level digest probe with only the first group — a
+        # pre-prefetch server that advertises "merkle" but ignores
+        # "more". Later walks on the session go single-level directly.
+        self.digest_prefetch = True
         self.caps: frozenset = frozenset()
         self.codec: Optional[FrameCodec] = None
         self.connects = 0      # raw TCP connects (tests/bench hook)
@@ -1078,6 +1083,7 @@ class PeerConnection:
         self.connects += 1
         self.caps = frozenset()
         self.codec = None
+        self.digest_prefetch = True   # re-probe: the peer may differ
         if self.negotiate and not self.legacy:
             try:
                 send_frame(sock, {"op": "hello", "proto": 1,
@@ -1391,6 +1397,13 @@ def sync_packed_over_conn(crdt, conn: PeerConnection,
     return watermark
 
 
+class _DigestPrefetchUnsupported(Exception):
+    """Internal walk signal: the peer advertises "merkle" but ignored
+    a multi-level probe's "more" groups (pre-prefetch release). Both
+    reply frames were consumed, so the session is still framed-in-sync
+    — the walk restarts single-level instead of aborting."""
+
+
 def sync_merkle_over_conn(crdt, conn: PeerConnection,
                           lock: Optional[threading.Lock] = None,
                           tally: Optional[WireTally] = None,
@@ -1472,6 +1485,15 @@ def sync_merkle_over_conn(crdt, conn: PeerConnection,
             tally=tally, codec=codec)
         ks = reply.get("ks")
         if ks is None:
+            if len(groups) > 1 and blob is not None \
+                    and reply["k"] == len(groups[0][1]) \
+                    and len(blob) == 8 * reply["k"]:
+                # A pre-prefetch server (previous release, same
+                # "merkle" cap) ignores "more" and answers ONLY the
+                # first group, without "ks". The exchange is complete,
+                # so degrade the walk to single-level rather than
+                # treating the shorter reply as a framing error.
+                raise _DigestPrefetchUnsupported
             ks = [reply["k"]]
         if blob is None or not isinstance(ks, list) \
                 or len(ks) != len(groups) \
@@ -1486,11 +1508,28 @@ def sync_merkle_over_conn(crdt, conn: PeerConnection,
             off += k
         return out
 
+    def fetch_one(level, idxs):
+        # Single-group probes never carry "more", so every "merkle"
+        # server generation answers them identically.
+        return fetch_levels([(level, idxs)])[0]
+
     try:
         with span("sync_merkle", kind="sync",
                   hlc=lambda: watermark, node=node):
-            leaves, rounds, fetched = walk_divergent_leaves(
-                tree, None, fetch_levels=fetch_levels)
+            if conn.digest_prefetch:
+                try:
+                    leaves, rounds, fetched = walk_divergent_leaves(
+                        tree, None, fetch_levels=fetch_levels)
+                except _DigestPrefetchUnsupported:
+                    # Sticky for the session: later walks skip the
+                    # futile multi-level probe entirely.
+                    conn.digest_prefetch = False
+                    leaves, rounds, fetched = walk_divergent_leaves(
+                        tree, fetch_one)
+                    rounds += 1   # the aborted prefetch probe
+            else:
+                leaves, rounds, fetched = walk_divergent_leaves(
+                    tree, fetch_one)
             reg = default_registry()
             reg.counter(
                 "crdt_tpu_merkle_digest_rounds_total",

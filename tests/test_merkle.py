@@ -286,6 +286,56 @@ def test_divergence_proportional_bytes_vs_full_scan():
     assert stats["pulled_rows"] <= 16            # leaf-rounded, not 1024
 
 
+def test_prefetch_client_degrades_against_pre_prefetch_server(
+        monkeypatch):
+    """Mixed versions, new-client/old-server direction: a previous
+    release advertises the same 'merkle' cap but ignores the 'more'
+    prefetch groups and omits 'ks' from digest_resp. The walk must
+    degrade to single-level rounds (sticky per session) and still
+    converge — never abort with a framing error. Simulated by
+    stripping exactly those fields at the module frame helpers the
+    server resolves at call time."""
+    import crdt_tpu.net as net_mod
+    server_crdt = _make("srv", 256)
+    ids = list(range(0, 256, 3))
+    server_crdt.put_batch(ids, [i + 7 for i in ids])
+    client = _make("cli", 256)
+
+    real_recv, real_send = net_mod.recv_frame, net_mod.send_frame
+
+    def legacy_recv(sock, *a, **kw):
+        msg = real_recv(sock, *a, **kw)
+        if isinstance(msg, dict) and msg.get("op") == "digest":
+            msg.pop("more", None)        # server-side: never parsed
+        return msg
+
+    def legacy_send(sock, obj, tally=None, codec=None):
+        if isinstance(obj, dict) and obj.get("op") == "digest_resp":
+            obj = {k: v for k, v in obj.items() if k != "ks"}
+        return real_send(sock, obj, tally, codec)
+
+    monkeypatch.setattr(net_mod, "recv_frame", legacy_recv)
+    monkeypatch.setattr(net_mod, "send_frame", legacy_send)
+    stats = {}
+    with SyncServer(server_crdt) as server:
+        with PeerConnection(server.host, server.port,
+                            timeout=5.0) as conn:
+            sync_merkle_over_conn(client, conn, _stats=stats)
+            # the degrade is sticky: the NEXT walk on this session
+            # skips the futile multi-level probe entirely
+            assert conn.digest_prefetch is False
+            depth = client.digest_tree().depth
+            # one aborted prefetch probe + one single-level round per
+            # tree level
+            assert stats["rounds"] == depth + 1
+            stats2 = {}
+            sync_merkle_over_conn(client, conn, _stats=stats2)
+            assert stats2["rounds"] == 1         # converged roots
+    _stores_equal(client, server_crdt)
+    for s in ids:
+        assert client.get(s) == s + 7
+
+
 def test_legacy_server_rejects_merkle_before_payload():
     legacy = _LegacyDense("old", n_slots=32,
                           wall_clock=FakeClock(start=BASE))

@@ -69,6 +69,65 @@ def test_malformed_write_rejected_session_survives():
     assert crdt.get(5) == 7
 
 
+def test_out_of_range_and_bool_writes_rejected_flusher_survives():
+    """An int outside int64 passes `isinstance(value, int)` but would
+    blow up the flush tick's np.int64 conversion — it must be rejected
+    per-write at the session, and the flusher must survive even if
+    something slips through (a dead flusher hangs EVERY later ack).
+    JSON true/false are ints to isinstance and must be rejected too."""
+    crdt = DenseCrdt("a", n_slots=64)
+    with ServeTier(crdt) as tier:
+        with _connect(tier) as sock:
+            for bad in ({"op": "put", "slot": 1, "value": 2 ** 63},
+                        {"op": "put", "slot": 1, "value": -(2 ** 63) - 1},
+                        {"op": "put", "slot": 1, "value": 2 ** 200},
+                        {"op": "put", "slot": True, "value": 1},
+                        {"op": "put", "slot": 1, "value": False},
+                        {"op": "delete", "slot": False},
+                        {"op": "get", "slot": True}):
+                reply = _req(sock, bad)
+                assert reply["ok"] is False
+                assert reply["code"] == "write_rejected"
+            # int64 boundaries themselves are legal...
+            assert _req(sock, {"op": "put", "slot": 2,
+                               "value": 2 ** 63 - 1}) == {"ok": True}
+            # ...and the flusher is still ticking afterwards.
+            assert _req(sock, {"op": "put", "slot": 5,
+                               "value": 7}) == {"ok": True}
+            assert _req(sock, {"op": "get", "slot": 5}) \
+                == {"ok": True, "value": 7}
+            send_frame(sock, {"op": "bye"})
+    assert crdt.get(5) == 7
+
+
+def test_malformed_digest_more_replies_merkle_rejected():
+    """A 'more' entry that is not a [level, idx] pair must get the
+    merkle_rejected reply (like SyncServer), not an unhandled
+    TypeError that kills the session without a reply."""
+    crdt = DenseCrdt("a", n_slots=64)
+    with ServeTier(crdt) as tier:
+        for more in ([5], ["xy"], [[0]], [[0, [0], 9]]):
+            with _connect(tier) as sock:
+                reply = _req(sock, {"op": "digest", "level": 0,
+                                    "idx": [0], "more": more})
+                assert reply["code"] == "merkle_rejected"
+
+
+def test_idle_timeout_is_clean_close_not_a_drop():
+    """Routine idle expiry must not inflate dropped_sessions — the
+    bench's zero-dropped acceptance criterion reads that counter."""
+    crdt = DenseCrdt("a", n_slots=64)
+    with ServeTier(crdt, idle_timeout=0.2) as tier:
+        with _connect(tier) as sock:
+            assert _req(sock, {"op": "put", "slot": 1,
+                               "value": 1}) == {"ok": True}
+            # park past idle_timeout: the server closes cleanly (EOF)
+            assert recv_frame(sock,
+                              deadline=time.monotonic() + 10.0) is None
+        assert tier.idle_closed_sessions == 1
+        assert tier.dropped_sessions == 0
+
+
 def test_unknown_op_hangs_up():
     crdt = DenseCrdt("a", n_slots=64)
     with ServeTier(crdt) as tier:
