@@ -799,6 +799,90 @@ def bench_antientropy(replicas: int = 64, divergent: int = 8,
         hi["star_total_bytes"] / lo["star_total_bytes"], 3)
     out["full_scan_growth"] = round(
         hi["one_full_scan_bytes"] / lo["one_full_scan_bytes"], 3)
+
+    # Canary convergence matrix + trace overhead (docs/OBSERVABILITY
+    # .md): every replica beats its reserved canary slot once, the
+    # star soak heals the mesh, and the SAME pure fleet math the
+    # network poller uses (crdt_tpu.obs.fleet) turns the converged
+    # snapshots into a per-(origin, observer) lag matrix and an SLO
+    # verdict — no sockets, so the soak exercises the math at mesh
+    # scale. The soak is also re-timed with the trace ring enabled
+    # (every sync_merkle emits a round-id'd span) to pin the tracing
+    # overhead against the observability layer's 5% budget; min-of-
+    # repeats on both sides keeps host scheduling noise out of the
+    # ratio.
+    from crdt_tpu.obs.fleet import evaluate_slo, lag_matrix
+    from crdt_tpu.obs.probe import CanaryProbe
+    from crdt_tpu.obs.trace import tracer
+
+    def canary_soak():
+        nodes = build_mesh(base_n)
+        probes = [CanaryProbe(node, i, replicas)
+                  for i, node in enumerate(nodes)]
+        for p in probes:
+            p.beat()
+        t0 = time.perf_counter()
+        rep = soak(nodes, star_edges, 3)
+        dt = time.perf_counter() - t0
+        snaps = {f"r{i}": {"canary": p.snapshot()}
+                 for i, p in enumerate(probes)}
+        return dt, rep, snaps
+
+    plain_dt, rep, snaps = canary_soak()
+    was_on = tracer().enabled
+    plain_ts, traced_ts = [plain_dt], []
+    try:
+        # Alternated untraced/traced samples, each a fresh-mesh soak
+        # (the honest workload — a converged mesh re-soaks for near
+        # free). A single soak is ~tens of ms, the same order as this
+        # host's scheduling jitter, so the ratio comes from the mean
+        # of each side's 3 fastest samples: pairing cancels slow
+        # drift, the fastest-k floor drops preemption spikes, and the
+        # pair count adapts to a wall-clock budget so full-size
+        # meshes don't pay smoke-size repetition. GC is paused inside
+        # each pair (collected at the seam): a soak this small emits
+        # only ~a hundred events, so a collector pass landing in one
+        # sample but not its twin would otherwise dominate the very
+        # per-event cost being measured.
+        import gc
+        deadline = time.perf_counter() + 3.0
+        pairs = 0
+        while pairs < 4 or (pairs < 8
+                            and time.perf_counter() < deadline):
+            gc.collect()
+            gc.disable()
+            try:
+                tracer().enable(capacity=4096)
+                traced_ts.append(canary_soak()[0])
+                if not was_on:
+                    tracer().disable()
+                plain_ts.append(canary_soak()[0])
+            finally:
+                gc.enable()
+            pairs += 1
+    finally:
+        if not was_on:
+            tracer().disable()
+
+    def floor(ts, k=3):
+        best = sorted(ts)[:k]
+        return sum(best) / len(best)
+
+    overhead = max(0.0, floor(traced_ts) / floor(plain_ts) - 1.0)
+
+    matrix = lag_matrix(snaps)
+    verdict = evaluate_slo(snaps, matrix)
+    out["canary"] = {
+        "origins": len(matrix["origins"]),
+        "observers": len(matrix["observers"]),
+        "matrix_complete": matrix["complete"],
+        "max_lag_s": matrix["max_lag_s"],
+        "soak_converged": rep["converged"],
+    }
+    out["trace_overhead_frac"] = round(overhead, 4)
+    out["trace_overhead_budget_frac"] = 0.05
+    out["trace_overhead_within_budget"] = overhead < 0.05
+    out["_slo"] = verdict
     return out
 
 
@@ -828,6 +912,7 @@ def bench_serve(sessions: int = 10000, rate_hz: float = 1.0,
     import resource
     import struct as _struct
     from crdt_tpu import DenseCrdt, ServeTier
+    from crdt_tpu.obs.fleet import evaluate_slo
     from crdt_tpu.obs.registry import default_registry
     from crdt_tpu.serve import read_frame_async
 
@@ -944,6 +1029,28 @@ def bench_serve(sessions: int = 10000, rate_hz: float = 1.0,
         base_lats.sort()
         single_p50 = pct_ms(base_lats, 0.50)
         ticks0 = ticks_c.value(trigger="tick", node="srv")
+
+        # Ack attribution: the tier decomposes every acked write into
+        # queue_wait / stamp / scatter / ack_write phase observations
+        # (crdt_tpu_serve_ack_phase_seconds); the per-phase histogram
+        # SUM deltas across the measured run must reconstruct the ack
+        # histogram's sum delta — the 10% acceptance bound from the
+        # PR 11 issue. Deltas, not absolutes: the jit warm loop and
+        # the single-session yardstick above already observed.
+        def _hist_sums(h, key=None):
+            out = {}
+            for s in h.samples():
+                if s["labels"].get("node") != "srv":
+                    continue
+                out[s["labels"].get(key, "")] = (s["count"], s["sum"])
+            return out
+
+        ack_h = default_registry().histogram(
+            "crdt_tpu_serve_ack_seconds")
+        phase_h = default_registry().histogram(
+            "crdt_tpu_serve_ack_phase_seconds")
+        ack0 = _hist_sums(ack_h)
+        phase0 = _hist_sums(phase_h, "phase")
         # The fleet forks: client fds land in the child's own limit.
         # Fork start method, so the closures need no pickling; only
         # the result crosses back (the child never touches jax or the
@@ -975,6 +1082,17 @@ def bench_serve(sessions: int = 10000, rate_hz: float = 1.0,
         shed, dropped = tier.shed_count, tier.dropped_sessions
     ticks = int(ticks_c.value(trigger="tick", node="srv") - ticks0)
 
+    def _delta(after, before):
+        return {k: (c - before.get(k, (0, 0.0))[0],
+                    s - before.get(k, (0, 0.0))[1])
+                for k, (c, s) in after.items()}
+
+    ack_d = _delta(_hist_sums(ack_h), ack0)
+    phase_d = _delta(_hist_sums(phase_h, "phase"), phase0)
+    ack_n, ack_sum = ack_d.get("", (0, 0.0))
+    phase_sum = sum(s for _, s in phase_d.values())
+    attribution = (phase_sum / ack_sum) if ack_sum else None
+
     lats.sort()
     n = len(lats)
     p99 = pct_ms(lats, 0.99)
@@ -998,6 +1116,19 @@ def bench_serve(sessions: int = 10000, rate_hz: float = 1.0,
         "dropped_sessions": dropped,
         "session_errors": counters["errors"],
         "connect_failures": counters["connect_failures"],
+        # Per-phase mean over the measured run (docs/OBSERVABILITY.md
+        # "Ack attribution"); the phase sums must reconstruct the ack
+        # histogram's sum to within 10% or the attribution is lying.
+        "ack_phase_mean_ms": {
+            k: (round(1e3 * s / c, 4) if c else None)
+            for k, (c, s) in sorted(phase_d.items())},
+        "ack_mean_ms": (round(1e3 * ack_sum / ack_n, 4)
+                        if ack_n else None),
+        "ack_phase_sum_vs_ack": (round(attribution, 4)
+                                 if attribution is not None else None),
+        "attribution_within_10pct": (
+            attribution is not None
+            and abs(attribution - 1.0) <= 0.10),
         "baseline_single_client_flush_p50_ms": 0.85,
         "write_ack_p99_budget_ms": 4.25,
         "within_budget": (p99 is not None and p99 <= 4.25),
@@ -1008,6 +1139,12 @@ def bench_serve(sessions: int = 10000, rate_hz: float = 1.0,
         "within_5x_single_session": (
             p99 is not None and bool(single_p50)
             and p99 <= 5 * single_p50),
+        # Fleet SLO verdict over this process's own registry snapshot
+        # (same evaluator the network poller runs); main() prints it
+        # as the trailing JSON line CI gates on. The ack p99 here is
+        # the log2-bucket upper bound, coarser than the measured
+        # percentile above.
+        "_slo": evaluate_slo({"srv": default_registry().snapshot()}),
     }
 
 
@@ -1355,9 +1492,15 @@ def main() -> None:
                        config=args.config, repeats=args.repeats,
                        with_phases=True)
     phases = result.pop("_phases", None)
+    slo = result.pop("_slo", None)
     print(json.dumps(result))
     if phases is not None:
         print(json.dumps(phases))
+    if slo is not None:
+        # Trailing machine-readable SLO verdict (same shape as
+        # `python -m crdt_tpu.obs fleet --json`'s "slo"); CI gates on
+        # the last line of serve/antientropy bench output.
+        print(json.dumps({"slo": slo}))
 
 
 if __name__ == "__main__":

@@ -58,6 +58,13 @@ reproduced bugs):
   ``loop.run_in_executor`` and sleep with ``asyncio.sleep``. Passing
   a sync helper BY REFERENCE to an executor is fine — only the
   direct call blocks.
+- ``metric-name-unprefixed`` — a counter/gauge/histogram registered
+  outside the ``crdt_tpu_`` namespace, or a metric label whose value
+  is drawn from a user key/slot. The fleet poller (obs/fleet.py)
+  federates series by name, so an unprefixed name collides with
+  foreign exporters; a per-key label value mints one time series per
+  key — unbounded cardinality that melts the registry
+  (docs/OBSERVABILITY.md).
 
 The linter is purely lexical/AST — no imports of the linted code — so
 it runs on broken or unimportable files (the self-test fixtures).
@@ -90,6 +97,7 @@ RULES = (
     "pack-path-extra-copy",
     "merkle-digest-host-hash",
     "async-blocking-call",
+    "metric-name-unprefixed",
     "suppression-without-reason",
 )
 
@@ -630,6 +638,71 @@ def _check_async_blocking(tree: ast.AST, path: str) -> List[Finding]:
     return out
 
 
+# --- rule: metric-name-unprefixed ---
+
+# Metric registration surfaces (MetricsRegistry methods) and the
+# observation methods that accept **label kwargs. jax's `.at[..].set()`
+# takes labels-free positional/mode args, so restricting the
+# cardinality scan to KEYWORD values keeps it off the device paths.
+_METRIC_CTORS = {"counter", "gauge", "histogram"}
+_METRIC_LABEL_SINKS = _METRIC_CTORS | {"inc", "observe", "set"}
+# Identifier shapes that mean "this came from user data": a key or a
+# store slot. Bounded enums (op/trigger/phase/lane/node/peer) are the
+# sanctioned label vocabulary.
+_USER_KEY_NAMES = {"key", "keys", "user_key", "raw_key",
+                   "slot", "slots"}
+_METRIC_PREFIX = "crdt_tpu_"
+
+
+def _mentions_user_key(node: ast.AST) -> Optional[str]:
+    for n in ast.walk(node):
+        if isinstance(n, ast.Name) and n.id in _USER_KEY_NAMES:
+            return n.id
+        if isinstance(n, ast.Attribute) and n.attr in _USER_KEY_NAMES:
+            return n.attr
+    return None
+
+
+def _check_metric_names(tree: ast.AST, path: str) -> List[Finding]:
+    out: List[Finding] = []
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call) \
+                or not isinstance(node.func, ast.Attribute):
+            continue
+        attr = node.func.attr
+        if attr in _METRIC_CTORS and node.args:
+            first = node.args[0]
+            if isinstance(first, ast.Constant) \
+                    and isinstance(first.value, str) \
+                    and not first.value.startswith(_METRIC_PREFIX):
+                out.append(Finding(
+                    rule="metric-name-unprefixed", path=path,
+                    line=node.lineno,
+                    message=f".{attr}({first.value!r}) registers a "
+                            "metric outside the 'crdt_tpu_' "
+                            "namespace; the fleet poller federates "
+                            "series by name, and an unprefixed name "
+                            "collides with foreign exporters "
+                            "(docs/OBSERVABILITY.md)"))
+        if attr in _METRIC_LABEL_SINKS:
+            for kw in node.keywords:
+                if kw.arg is None:
+                    continue
+                bad = _mentions_user_key(kw.value)
+                if bad is not None:
+                    out.append(Finding(
+                        rule="metric-name-unprefixed", path=path,
+                        line=node.lineno,
+                        message=f"label {kw.arg}= draws its value "
+                                f"from {bad!r} (a user key/slot); "
+                                "per-key label values mint one time "
+                                "series per key — unbounded "
+                                "cardinality. Aggregate, bucket, or "
+                                "drop the label "
+                                "(docs/OBSERVABILITY.md)"))
+    return out
+
+
 _ALL_CHECKS = (
     _check_sockets,
     _check_lock_discipline,
@@ -641,6 +714,7 @@ _ALL_CHECKS = (
     _check_pack_path_copies,
     _check_digest_host_hash,
     _check_async_blocking,
+    _check_metric_names,
 )
 
 

@@ -295,8 +295,8 @@ class GossipNode:
         # (per-peer splits live in each PeerSyncStats). The server's
         # metrics op folds our per-peer lag table into its snapshot.
         self.wire = WireTally()
-        default_registry().attach("wire", self.wire, role="client",
-                                  node=str(crdt.node_id))
+        default_registry().attach("wire", self.wire, replace=True,
+                                  role="client", node=str(crdt.node_id))
         self.server.metrics_extra = self._metrics_extra
         # Guards the peer REGISTRY (the dict itself): add_peer may run
         # from any thread while the gossip loop iterates. Per-peer
@@ -312,6 +312,9 @@ class GossipNode:
                                                crdt.node_id))
         self._gossip_thread: Optional[threading.Thread] = None
         self._stop = threading.Event()
+        # Fleet canary probe (obs/probe.py): enabled explicitly via
+        # enable_canary — user stores must never lose slots silently.
+        self._canary = None
 
     # --- topology ---
 
@@ -426,6 +429,14 @@ class GossipNode:
         pack latency behind the wire. Everything else — first
         contact, legacy/dense/JSON peers, open or probing breakers —
         takes the plain sequential path."""
+        if self._canary is not None:
+            # One canary beat per sweep, BEFORE the watermark reads:
+            # the beat rides this very sweep's deltas, so the fleet
+            # matrix measures write->replicate->observe end to end.
+            try:
+                self._canary.beat()
+            except Exception:
+                pass   # a failed beat must never stall gossip
         with self._peers_lock:
             names = list(self.peers)
         self._rng.shuffle(names)
@@ -730,4 +741,23 @@ class GossipNode:
         with self.server.lock:
             node = {"node_id": str(self.crdt.node_id),
                     "hlc_head": str(self.crdt.canonical_time)}
-        return {"node": node, "lag": self.lag_snapshot()}
+        extra = {"node": node, "lag": self.lag_snapshot()}
+        if self._canary is not None:
+            extra["canary"] = self._canary.snapshot()
+        return extra
+
+    # --- fleet canary (obs/probe.py) ---
+
+    def enable_canary(self, origin: int, n_origins: int,
+                      base_slot: Optional[int] = None):
+        """Join the fleet's canary protocol: reserve ``n_origins``
+        slots (the top of the store unless ``base_slot`` is given),
+        beat slot ``base_slot + origin`` each gossip sweep, and expose
+        last-seen beats per origin in the ``canary`` section of the
+        ``metrics`` op — the fleet poller's lag-matrix feed
+        (docs/OBSERVABILITY.md). Returns the probe."""
+        from .obs.probe import CanaryProbe
+        self._canary = CanaryProbe(self.crdt, origin, n_origins,
+                                   base_slot=base_slot,
+                                   lock=self.server.lock)
+        return self._canary

@@ -408,4 +408,8 @@ class Hlc(Generic[T]):
         return self > other or self == other
 
     def __hash__(self) -> int:
-        return hash(str(self))
+        # Field tuple, not hash(str(self)): equality is (logical_time,
+        # node_id) order, which the fields determine exactly, and the
+        # ISO-8601 render is ~6x the cost of a tuple hash — it shows
+        # up on any path that caches by stamp (trace emit, dedupe).
+        return hash((self.millis, self.counter, self.node_id))

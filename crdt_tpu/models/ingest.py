@@ -85,7 +85,7 @@ class WriteCombiner:
 
     __slots__ = ("_owner", "_auto", "_slots", "_vals", "_tombs",
                  "_group", "_k", "_groups", "_pending", "flushes",
-                 "rows_committed", "on_flush")
+                 "rows_committed", "on_flush", "last_phase_seconds")
 
     def __init__(self, owner: "DenseCrdt",
                  auto_flush_rows: int = 1 << 16):
@@ -113,6 +113,12 @@ class WriteCombiner:
         # site. Listener errors are swallowed: observability must
         # never fail a commit.
         self.on_flush = None
+        # Per-phase wall time of the LAST flush: {"stamp": s,
+        # "scatter": s} — the HLC stamp leg (wall read + counter run)
+        # vs the dedup + device-scatter dispatch leg. The serving tier
+        # reads this after each tick commit to attribute write-ack
+        # latency (crdt_tpu_serve_ack_phase_seconds).
+        self.last_phase_seconds: dict = {}
 
     # --- staging ---
 
@@ -210,6 +216,7 @@ class WriteCombiner:
             new_canonical, group_lts = Hlc.send_batch(
                 owner.canonical_time, self._groups,
                 millis=owner._wall_clock())
+            t_stamp = time.perf_counter()
             d = 0
             if k:
                 slots = self._slots[:k]
@@ -232,6 +239,7 @@ class WriteCombiner:
                 owner._store = owner._commit_scatter(slots, lt, vals,
                                                      tombs)
                 owner._store_escaped = False
+            t_scatter = time.perf_counter()
             owner._canonical_time = new_canonical
             owner.stats.puts += self._groups
             owner.stats.records_put += k
@@ -244,6 +252,8 @@ class WriteCombiner:
             if d:
                 self._emit_commit(slots, vals, tombs)
         dt = time.perf_counter() - t0
+        self.last_phase_seconds = {"stamp": t_stamp - t0,
+                                   "scatter": t_scatter - t_stamp}
         flushes_c, rows_c, groups_c, seconds_h = _metrics()
         flushes_c.inc(trigger=trigger, node=node)
         rows_c.inc(d, node=node)

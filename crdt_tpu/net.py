@@ -506,8 +506,8 @@ class SyncServer:
         # atomic int reads.
         from .obs.registry import default_registry
         self.tally = WireTally()
-        default_registry().attach("wire", self.tally, role="server",
-                                  node=str(crdt.node_id))
+        default_registry().attach("wire", self.tally, replace=True,
+                                  role="server", node=str(crdt.node_id))
         # Optional hook merged into the `metrics` op reply — a
         # `GossipNode` installs its lag snapshot here so the wire op
         # answers "how far behind is replica B?" without the server
@@ -672,6 +672,12 @@ class SyncServer:
             caps.add("semantics")
         if merkle:
             caps.add("merkle")
+        # "trace" is pure metadata: when both ends agree, sync frames
+        # may carry a compact trace context ({rid, origin, hlc_lo,
+        # hlc_hi}) so initiator sync spans and responder merge spans
+        # correlate in the JSONL sink (docs/OBSERVABILITY.md). Needs
+        # no replica surface, so it is always advertised.
+        caps.add("trace")
         return caps
 
     def _handle(self, conn: socket.socket) -> None:
@@ -684,6 +690,7 @@ class SyncServer:
         ops = 0
         codec: Optional[FrameCodec] = None
         sem_ok = False   # this session negotiated the sem tag lane
+        trace_ok = False  # this session negotiated trace piggyback
         while not self._stop.is_set():
             sent0, received0 = self.tally.sent, self.tally.received
             try:
@@ -702,6 +709,9 @@ class SyncServer:
             if ops > self._max_ops or _time.monotonic() > deadline:
                 return
             op = msg.get("op")
+            tctx = msg.get("trace") if trace_ok else None
+            if not isinstance(tctx, dict):
+                tctx = None
             if op == "hello":
                 want = msg.get("caps")
                 want = set(want) if isinstance(want, list) else set()
@@ -714,12 +724,15 @@ class SyncServer:
                 # it speaks the tagged framing.
                 codec = FrameCodec(compress="zlib" in agreed)
                 sem_ok = "semantics" in agreed
+                trace_ok = "trace" in agreed
             elif op == "push":
                 try:
-                    with self.lock:
-                        self.crdt.merge_json(msg["payload"],
-                                             key_decoder=self._kdec,
-                                             value_decoder=self._vdec)
+                    with _recv_span("push", tctx):
+                        with self.lock:
+                            self.crdt.merge_json(
+                                msg["payload"],
+                                key_decoder=self._kdec,
+                                value_decoder=self._vdec)
                 except Exception as e:
                     # clock guards (duplicate node, drift) reject the
                     # push; the server survives and tells the client
@@ -771,10 +784,11 @@ class SyncServer:
                     ids = msg.get("node_ids")
                     if not isinstance(ids, list) or not ids:
                         raise ValueError("push_dense without node_ids")
-                    with self.lock:
-                        # AttributeError on non-dense replicas reports
-                        # back like any other rejection.
-                        self.crdt.merge_split(scs, ids)
+                    with _recv_span("push_dense", tctx):
+                        with self.lock:
+                            # AttributeError on non-dense replicas
+                            # reports back like any other rejection.
+                            self.crdt.merge_split(scs, ids)
                 except Exception as e:
                     self._reply(conn, {"ok": False,
                                        "code": "dense_rejected",
@@ -826,8 +840,9 @@ class SyncServer:
                     if not isinstance(ids, list):
                         raise ValueError("push_packed without node_ids")
                     if packed.k:
-                        with self.lock:
-                            self.crdt.merge_packed(packed, ids)
+                        with _recv_span("push_packed", tctx):
+                            with self.lock:
+                                self.crdt.merge_packed(packed, ids)
                     # k == 0: nothing to join — skipping the merge
                     # keeps the clock (and thus the pack cache) still.
                 except Exception as e:
@@ -969,9 +984,17 @@ class SyncServer:
             if ring.enabled:
                 with self.lock:
                     stamp = str(self.crdt.canonical_time)
+                extra = {}
+                if tctx is not None:
+                    # Correlate the responder's frame with the
+                    # initiator's sync span by round id.
+                    for k in ("rid", "origin"):
+                        if tctx.get(k) is not None:
+                            extra[k] = tctx[k]
                 ring.emit("wire_frame", hlc=stamp, op=op,
                           sent=self.tally.sent - sent0,
-                          received=self.tally.received - received0)
+                          received=self.tally.received - received0,
+                          **extra)
 
     @staticmethod
     def _reply(conn: socket.socket, obj: Any,
@@ -1005,6 +1028,39 @@ def _check_reply(what: str, reply: Any, want_field: str) -> None:
     raise SyncTransportError(f"{what}: {reply!r}")
 
 
+def _trace_ctx(conn: "PeerConnection", node: str,
+               since: Optional[Hlc], watermark: Hlc
+               ) -> Optional[dict]:
+    """Initiator-side trace context for one sync round — the compact
+    payload the "trace" hello cap lets ride on sync frames: origin
+    node, the round's HLC stamp range, and a fleet-unique round id.
+    Returns None unless the session negotiated "trace" AND the
+    process tracer is enabled, so with tracing off (or against a
+    pre-trace peer) every frame stays byte-identical to the un-traced
+    protocol and the hot path pays one attribute read."""
+    from .obs.trace import round_id, tracer
+    if "trace" not in conn.caps or not tracer().enabled:
+        return None
+    return {"rid": round_id(node), "origin": node,
+            "hlc_lo": None if since is None else str(since),
+            "hlc_hi": str(watermark)}
+
+
+def _recv_span(op: str, tctx: Optional[dict]):
+    """Responder-side merge span named ``<op>_recv`` carrying the
+    initiator's round id/origin/stamp range, so both ends of a round
+    correlate in one JSONL sink. A no-op context when the frame bore
+    no trace context or tracing is off."""
+    from .obs.trace import span, tracer
+    if not isinstance(tctx, dict) or not tracer().enabled:
+        import contextlib
+        return contextlib.nullcontext()
+    fields = {k: tctx[k] for k in ("rid", "origin", "hlc_lo",
+                                   "hlc_hi")
+              if tctx.get(k) is not None}
+    return span(f"{op}_recv", kind="sync_recv", **fields)
+
+
 class PeerConnection:
     """One keep-alive framed session to a :class:`SyncServer`.
 
@@ -1035,7 +1091,8 @@ class PeerConnection:
                  idle_timeout: Optional[float] = 20.0,
                  negotiate: bool = True,
                  want_caps: Iterable[str] = ("zlib", "packed",
-                                             "semantics", "merkle")):
+                                             "semantics", "merkle",
+                                             "trace")):
         self.host = host
         self.port = port
         self.timeout = timeout
@@ -1179,26 +1236,36 @@ def sync_over_conn(crdt: Crdt, conn: PeerConnection,
         payload = crdt.to_json(key_encoder=key_encoder,
                                value_encoder=value_encoder)
     import time as _time
+    from .obs.trace import span
     sock = conn.ensure(tally)
+    node = str(getattr(crdt, "node_id", "?"))
+    tctx = _trace_ctx(conn, node, since, watermark)
+    rid = {"rid": tctx["rid"]} if tctx else {}
     try:
         codec = conn.codec
-        send_frame(sock, {"op": "push", "payload": payload}, tally,
-                   codec)
-        reply = recv_frame(sock,
-                           deadline=_time.monotonic() + conn.timeout,
-                           tally=tally, codec=codec)
-        _check_reply("push rejected", reply, "ok")
-        send_frame(sock, {"op": "delta",
-                          "since": None if since is None
-                          else str(since)}, tally, codec)
-        reply = recv_frame(sock,
-                           deadline=_time.monotonic() + conn.timeout,
-                           tally=tally, codec=codec)
-        _check_reply("delta failed", reply, "payload")
-        pulled = reply["payload"]
-        with lock:
-            crdt.merge_json(pulled, key_decoder=key_decoder,
-                            value_decoder=value_decoder)
+        with span("sync_json", kind="sync", hlc=lambda: watermark,
+                  node=node, **rid):
+            msg = {"op": "push", "payload": payload}
+            if tctx:
+                msg["trace"] = tctx
+            send_frame(sock, msg, tally, codec)
+            reply = recv_frame(
+                sock, deadline=_time.monotonic() + conn.timeout,
+                tally=tally, codec=codec)
+            _check_reply("push rejected", reply, "ok")
+            msg = {"op": "delta", "since": None if since is None
+                   else str(since)}
+            if tctx:
+                msg["trace"] = tctx
+            send_frame(sock, msg, tally, codec)
+            reply = recv_frame(
+                sock, deadline=_time.monotonic() + conn.timeout,
+                tally=tally, codec=codec)
+            _check_reply("delta failed", reply, "payload")
+            pulled = reply["payload"]
+            with lock:
+                crdt.merge_json(pulled, key_decoder=key_decoder,
+                                value_decoder=value_decoder)
     except SyncError:
         conn.reset()
         raise
@@ -1222,35 +1289,47 @@ def sync_dense_over_conn(crdt, conn: PeerConnection,
         scs, ids = crdt.export_split_delta()
         meta, bufs = _pack_split(scs)
     import time as _time
+    from .obs.trace import span
     sock = conn.ensure(tally)
+    node = str(getattr(crdt, "node_id", "?"))
+    tctx = _trace_ctx(conn, node, since, watermark)
+    rid = {"rid": tctx["rid"]} if tctx else {}
     try:
         codec = conn.codec
-        send_frame(sock, {"op": "push_dense", "meta": meta,
-                          "node_ids": list(ids)}, tally, codec)
-        send_bytes_frame(sock, bufs, tally, codec)
-        reply = recv_frame(sock,
-                           deadline=_time.monotonic() + conn.timeout,
-                           tally=tally, codec=codec)
-        _check_reply("push rejected", reply, "ok")
-        send_frame(sock, {"op": "delta_dense",
-                          "since": None if since is None
-                          else str(since)}, tally, codec)
-        reply = recv_frame(sock,
-                           deadline=_time.monotonic() + conn.timeout,
-                           tally=tally, codec=codec)
-        _check_reply("delta failed", reply, "meta")
-        blob = recv_bytes_frame(sock,
-                                deadline=_time.monotonic()
-                                + conn.timeout,
-                                tally=tally, codec=codec)
-        if blob is None:
-            raise SyncTransportError("delta binary frame missing")
-        peer_scs = _unpack_split(reply["meta"], blob)
-        ids_in = reply.get("node_ids")
-        if not isinstance(ids_in, list) or not ids_in:
-            raise SyncTransportError("delta reply without node_ids")
-        with lock:
-            crdt.merge_split(peer_scs, ids_in)
+        with span("sync_dense", kind="sync", hlc=lambda: watermark,
+                  node=node, **rid):
+            msg = {"op": "push_dense", "meta": meta,
+                   "node_ids": list(ids)}
+            if tctx:
+                msg["trace"] = tctx
+            send_frame(sock, msg, tally, codec)
+            send_bytes_frame(sock, bufs, tally, codec)
+            reply = recv_frame(
+                sock, deadline=_time.monotonic() + conn.timeout,
+                tally=tally, codec=codec)
+            _check_reply("push rejected", reply, "ok")
+            msg = {"op": "delta_dense", "since": None if since is None
+                   else str(since)}
+            if tctx:
+                msg["trace"] = tctx
+            send_frame(sock, msg, tally, codec)
+            reply = recv_frame(
+                sock, deadline=_time.monotonic() + conn.timeout,
+                tally=tally, codec=codec)
+            _check_reply("delta failed", reply, "meta")
+            blob = recv_bytes_frame(sock,
+                                    deadline=_time.monotonic()
+                                    + conn.timeout,
+                                    tally=tally, codec=codec)
+            if blob is None:
+                raise SyncTransportError("delta binary frame missing")
+            peer_scs = _unpack_split(reply["meta"], blob)
+            ids_in = reply.get("node_ids")
+            if not isinstance(ids_in, list) or not ids_in:
+                raise SyncTransportError(
+                    "delta reply without node_ids")
+            with lock:
+                crdt.merge_split(peer_scs, ids_in)
     except SyncError:
         conn.reset()
         raise
@@ -1345,49 +1424,64 @@ def sync_packed_over_conn(crdt, conn: PeerConnection,
             watermark = crdt.canonical_time
             packed, ids = _pack_for_peer(crdt, since,
                                          "semantics" in conn.caps)
+    from .obs.trace import span
+    node = str(getattr(crdt, "node_id", "?"))
+    tctx = _trace_ctx(conn, node, since, watermark)
+    rid = {"rid": tctx["rid"]} if tctx else {}
     try:
         codec = conn.codec
-        if packed.k:
-            meta, bufs = pack_rows(packed)
-            send_frame(sock, {"op": "push_packed", "meta": meta,
-                              "node_ids": list(ids)}, tally, codec)
-            send_bytes_frame(sock, bufs, tally, codec)
+        with span("sync_packed", kind="sync", hlc=lambda: watermark,
+                  node=node, rows=packed.k, **rid):
+            if packed.k:
+                meta, bufs = pack_rows(packed)
+                msg = {"op": "push_packed", "meta": meta,
+                       "node_ids": list(ids)}
+                if tctx:
+                    msg["trace"] = tctx
+                send_frame(sock, msg, tally, codec)
+                send_bytes_frame(sock, bufs, tally, codec)
+                reply = recv_frame(
+                    sock, deadline=_time.monotonic() + conn.timeout,
+                    tally=tally, codec=codec)
+                _check_reply("push rejected", reply, "ok")
+            msg = {"op": "delta_packed",
+                   "since": None if since is None else str(since)}
+            if tctx:
+                msg["trace"] = tctx
+            send_frame(sock, msg, tally, codec)
             reply = recv_frame(
                 sock, deadline=_time.monotonic() + conn.timeout,
                 tally=tally, codec=codec)
-            _check_reply("push rejected", reply, "ok")
-        send_frame(sock, {"op": "delta_packed",
-                          "since": None if since is None
-                          else str(since)}, tally, codec)
-        reply = recv_frame(sock,
-                           deadline=_time.monotonic() + conn.timeout,
-                           tally=tally, codec=codec)
-        _check_reply("delta failed", reply, "meta")
-        blob = recv_bytes_frame(sock,
-                                deadline=_time.monotonic()
-                                + conn.timeout,
-                                tally=tally, codec=codec)
-        if blob is None:
-            raise SyncTransportError("delta binary frame missing")
-        peer_packed = unpack_rows(reply["meta"], blob)
-        ids_in = reply.get("node_ids")
-        if not isinstance(ids_in, list):
-            raise SyncTransportError("delta reply without node_ids")
-        if peer_packed.k:
-            if not ids_in:
-                raise SyncTransportError("delta reply without node_ids")
-            with lock:
-                if fused_repack and hasattr(crdt, "merge_and_repack"):
-                    # Seed the next round's pack while the join is on
-                    # device anyway; `watermark` (this round's
-                    # pre-push canonical) is the `since` the next
-                    # round's pack_for_peer will present.
-                    crdt.merge_and_repack(
-                        peer_packed, ids_in, since=watermark,
-                        sem_mode=("include" if "semantics" in conn.caps
-                                  else "auto"))
-                else:
-                    crdt.merge_packed(peer_packed, ids_in)
+            _check_reply("delta failed", reply, "meta")
+            blob = recv_bytes_frame(sock,
+                                    deadline=_time.monotonic()
+                                    + conn.timeout,
+                                    tally=tally, codec=codec)
+            if blob is None:
+                raise SyncTransportError("delta binary frame missing")
+            peer_packed = unpack_rows(reply["meta"], blob)
+            ids_in = reply.get("node_ids")
+            if not isinstance(ids_in, list):
+                raise SyncTransportError(
+                    "delta reply without node_ids")
+            if peer_packed.k:
+                if not ids_in:
+                    raise SyncTransportError(
+                        "delta reply without node_ids")
+                with lock:
+                    if fused_repack and hasattr(crdt,
+                                                "merge_and_repack"):
+                        # Seed the next round's pack while the join is
+                        # on device anyway; `watermark` (this round's
+                        # pre-push canonical) is the `since` the next
+                        # round's pack_for_peer will present.
+                        crdt.merge_and_repack(
+                            peer_packed, ids_in, since=watermark,
+                            sem_mode=("include"
+                                      if "semantics" in conn.caps
+                                      else "auto"))
+                    else:
+                        crdt.merge_packed(peer_packed, ids_in)
     except SyncError:
         conn.reset()
         raise
@@ -1451,6 +1545,11 @@ def sync_merkle_over_conn(crdt, conn: PeerConnection,
         tree = crdt.digest_tree()
     codec = conn.codec
     node = str(getattr(crdt, "node_id", "?"))
+    # One round id spans the whole walk: every digest probe and both
+    # re-ship halves carry it, so the responder's merge span and each
+    # wire_frame correlate back to this initiator span.
+    tctx = _trace_ctx(conn, node, None, watermark)
+    rid = {"rid": tctx["rid"]} if tctx else {}
 
     def fetch_levels(groups):
         # One round trip for the whole multi-level probe: the first
@@ -1463,6 +1562,8 @@ def sync_merkle_over_conn(crdt, conn: PeerConnection,
         msg = {"op": "digest", "level": level0, "idx": list(idxs0)}
         if len(groups) > 1:
             msg["more"] = [[lvl, list(ix)] for lvl, ix in groups[1:]]
+        if tctx:
+            msg["trace"] = tctx
         send_frame(sock, msg, tally, codec)
         reply = recv_frame(
             sock, deadline=_time.monotonic() + conn.timeout,
@@ -1515,7 +1616,7 @@ def sync_merkle_over_conn(crdt, conn: PeerConnection,
 
     try:
         with span("sync_merkle", kind="sync",
-                  hlc=lambda: watermark, node=node):
+                  hlc=lambda: watermark, node=node, **rid):
             if conn.digest_prefetch:
                 try:
                     leaves, rounds, fetched = walk_divergent_leaves(
@@ -1558,16 +1659,21 @@ def sync_merkle_over_conn(crdt, conn: PeerConnection,
                     ranges=ranges)
             if packed.k:
                 meta, bufs = pack_rows(packed)
-                send_frame(sock, {"op": "push_packed", "meta": meta,
-                                  "node_ids": list(ids)}, tally, codec)
+                msg = {"op": "push_packed", "meta": meta,
+                       "node_ids": list(ids)}
+                if tctx:
+                    msg["trace"] = tctx
+                send_frame(sock, msg, tally, codec)
                 send_bytes_frame(sock, bufs, tally, codec)
                 reply = recv_frame(
                     sock, deadline=_time.monotonic() + conn.timeout,
                     tally=tally, codec=codec)
                 _check_reply("push rejected", reply, "ok")
-            send_frame(sock, {"op": "delta_packed", "since": None,
-                              "ranges": [list(r) for r in ranges]},
-                       tally, codec)
+            msg = {"op": "delta_packed", "since": None,
+                   "ranges": [list(r) for r in ranges]}
+            if tctx:
+                msg["trace"] = tctx
+            send_frame(sock, msg, tally, codec)
             reply = recv_frame(
                 sock, deadline=_time.monotonic() + conn.timeout,
                 tally=tally, codec=codec)

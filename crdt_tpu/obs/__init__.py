@@ -23,6 +23,15 @@ package already requires):
   ``node.health()`` and over the wire via the `SyncServer` ``metrics``
   op.
 
+Fleet plane (PR 11): :mod:`~crdt_tpu.obs.probe` writes timestamped
+canary beats into a reserved slot range through the ordinary write
+path; :mod:`~crdt_tpu.obs.fleet` scrapes N replicas' ``metrics`` ops
+into a per-(origin, observer) replication-lag matrix and a
+machine-readable SLO verdict (``python -m crdt_tpu.obs fleet``); the
+``trace`` hello capability (net.py) carries round ids across the wire
+so initiator sync spans and responder merge spans correlate in one
+JSONL sink.
+
 Exposition: :func:`~crdt_tpu.obs.render.render_prometheus` renders a
 snapshot as Prometheus text; ``python -m crdt_tpu.obs`` polls a live
 node's ``metrics`` op or summarizes a trace JSONL into a per-phase
@@ -33,8 +42,11 @@ from __future__ import annotations
 
 from .registry import (Counter, Gauge, Histogram, MetricsRegistry,
                        default_registry)
-from .trace import TraceRing, span, tracer
+from .trace import TraceRing, round_id, span, tracer
 from .lag import health_status, lag_entry, lag_millis
+from .probe import CanaryProbe, canary_observed
+from .fleet import (evaluate_slo, format_matrix, lag_matrix,
+                    poll_fleet, render_federation)
 from .render import (format_phase_table, render_prometheus,
                      render_summary, summarize_trace)
 
@@ -48,8 +60,11 @@ def metrics_snapshot() -> dict:
 __all__ = [
     "Counter", "Gauge", "Histogram", "MetricsRegistry",
     "default_registry", "metrics_snapshot",
-    "TraceRing", "tracer", "span",
+    "TraceRing", "tracer", "span", "round_id",
     "lag_millis", "lag_entry", "health_status",
+    "CanaryProbe", "canary_observed",
+    "poll_fleet", "lag_matrix", "evaluate_slo", "render_federation",
+    "format_matrix",
     "render_prometheus", "render_summary", "summarize_trace",
     "format_phase_table",
 ]

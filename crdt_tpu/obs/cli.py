@@ -14,6 +14,11 @@ Two modes:
   table::
 
       python -m crdt_tpu.obs --trace /tmp/crdt-trace.jsonl
+
+The ``fleet`` subcommand scrapes N replicas into a canary lag matrix
+and SLO verdict (see :mod:`crdt_tpu.obs.fleet`)::
+
+    python -m crdt_tpu.obs fleet --peers a=127.0.0.1:7000,b=127.0.0.1:7001 --once
 """
 
 from __future__ import annotations
@@ -60,6 +65,11 @@ def _summarize_file(path: str, out) -> int:
 
 def main(argv: Optional[List[str]] = None, out=None) -> int:
     out = out or sys.stdout
+    if argv is None:
+        argv = sys.argv[1:]
+    if argv and argv[0] == "fleet":
+        from .fleet import fleet_main
+        return fleet_main(argv[1:], out)
     ap = argparse.ArgumentParser(
         prog="python -m crdt_tpu.obs",
         description="poll a node's metrics op, or summarize a trace "

@@ -1,0 +1,316 @@
+"""Fleet poller: N replicas' ``metrics`` ops → lag matrix + SLO verdict.
+
+``python -m crdt_tpu.obs fleet --peers a=h:p,b=h:p [--once]`` scrapes
+the existing ``metrics`` wire op (no new wire surface) across the
+fleet and derives what no single replica can know:
+
+- **Lag matrix** — per-(origin, observer) end-to-end replication lag
+  from the canary beats (`crdt_tpu.obs.probe`):
+  ``lag_s[origin][observer] = (newest_beat(origin) −
+  observed(observer)[origin]) / 1000``. ``None`` marks a pair where
+  the observer has never seen that origin's canary; ``complete`` is
+  True only when every (origin, observer) pair has a value.
+- **SLO verdict** — a machine-readable pass/fail over three budgets:
+  serve ack p99 (`crdt_tpu_serve_ack_seconds`), worst convergence lag
+  (the matrix), and shed writes (`crdt_tpu_serve_shed_total` == 0).
+  Each check is ``{"value", "budget", "ok"}`` with ``ok=None`` when
+  the fleet exposes no data for it (not measured ≠ passed ≠ failed);
+  the top-level ``ok`` requires every *measured* check to pass. Bench
+  modes emit this verdict as a trailing JSON line; CI gates on it.
+- **Federation output** — an aggregated Prometheus exposition of the
+  fleet-level series (matrix, beats, per-instance SLO inputs), each
+  labelled by ``instance`` so same-named per-replica series can't
+  collide.
+
+Everything below `poll_fleet` is pure (dicts in, dicts/strings out),
+so bench's in-process soaks feed `lag_matrix`/`evaluate_slo` directly
+from replica snapshots without sockets.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Any, Dict, List, Optional, Tuple
+
+from .render import _fmt, _labels, _table
+
+# Default budgets: the serve ack budget SERVE_r01 was judged against
+# (p99 <= 4.25 ms) and a convergence budget loose enough for WAN
+# gossip but tight enough to catch a wedged peer.
+ACK_P99_BUDGET_S = 0.00425
+CONVERGENCE_BUDGET_S = 5.0
+
+
+def parse_peers(spec: str) -> List[Tuple[str, str, int]]:
+    """``"a=host:1234,b=host:1235"`` (or bare ``host:port``) →
+    ``[(name, host, port), ...]``."""
+    peers = []
+    for part in spec.split(","):
+        part = part.strip()
+        if not part:
+            continue
+        name, _, addr = part.rpartition("=")
+        host, _, port = addr.rpartition(":")
+        if not host or not port:
+            raise ValueError(f"peer {part!r} is not [name=]host:port")
+        peers.append((name or addr, host, int(port)))
+    return peers
+
+
+def poll_fleet(peers: List[Tuple[str, str, int]],
+               timeout: float = 5.0) -> Dict[str, dict]:
+    """Scrape each peer's ``metrics`` op. Unreachable peers map to
+    ``{"_scrape_error": "..."}`` — the matrix and verdict treat them
+    as observers that saw nothing."""
+    # Lazy: obs stays importable below net (cli.py contract).
+    from ..net import SyncError, fetch_metrics
+    out: Dict[str, dict] = {}
+    for name, host, port in peers:
+        try:
+            out[name] = fetch_metrics(host, port, timeout=timeout)
+        except (SyncError, OSError) as exc:
+            out[name] = {"_scrape_error":
+                         f"{type(exc).__name__}: {exc}"}
+    return out
+
+
+def _okey(origin: str):
+    return (0, int(origin)) if origin.isdigit() else (1, origin)
+
+
+def lag_matrix(snapshots: Dict[str, dict]) -> Dict[str, Any]:
+    """Per-(origin, observer) replication lag from the ``canary``
+    sections of scraped (or in-process) metrics snapshots. Pure."""
+    canaries: Dict[str, dict] = {}
+    for name, snap in snapshots.items():
+        if not isinstance(snap, dict):
+            continue
+        can = snap.get("canary")
+        if isinstance(can, dict) and isinstance(can.get("observed"),
+                                                dict):
+            canaries[name] = can
+    observers = sorted(canaries)
+    origin_peers: Dict[str, str] = {}
+    newest: Dict[str, int] = {}
+    for name, can in canaries.items():
+        if can.get("origin") is not None:
+            origin_peers[str(can["origin"])] = name
+        for o, v in can["observed"].items():
+            o = str(o)
+            if v is not None and (o not in newest
+                                  or int(v) > newest[o]):
+                newest[o] = int(v)
+    origins = sorted(newest, key=_okey)
+    lag: Dict[str, Dict[str, Optional[float]]] = {}
+    complete = bool(origins) and bool(observers)
+    worst: Optional[float] = None
+    for o in origins:
+        row: Dict[str, Optional[float]] = {}
+        for w in observers:
+            v = canaries[w]["observed"].get(o)
+            if v is None:
+                row[w] = None
+                complete = False
+            else:
+                row[w] = max(0.0, (newest[o] - int(v)) / 1000.0)
+                worst = (row[w] if worst is None
+                         else max(worst, row[w]))
+        lag[o] = row
+    return {"origins": origins, "observers": observers,
+            "origin_peers": origin_peers, "lag_s": lag,
+            "complete": complete, "max_lag_s": worst}
+
+
+def histogram_quantile(sample: Dict[str, Any], q: float
+                       ) -> Optional[float]:
+    """Upper-bound quantile estimate from one log2-bucket histogram
+    sample (the `Histogram.samples()` shape): the smallest bucket
+    bound whose cumulative count reaches ``q``; ``inf`` when the
+    quantile lands in the overflow bucket; ``None`` when empty."""
+    count = sample.get("count", 0)
+    if not count:
+        return None
+    target = q * count
+    cum = 0
+    for bound, n in sample.get("buckets", []):
+        cum += n
+        if cum >= target:
+            return float(bound)
+    return math.inf
+
+
+def _check(value: Optional[float], budget: float,
+           ok: Optional[bool] = None) -> Dict[str, Any]:
+    if ok is None:
+        ok = None if value is None else bool(value <= budget)
+    return {"value": value, "budget": budget, "ok": ok}
+
+
+def evaluate_slo(snapshots: Dict[str, dict],
+                 matrix: Optional[Dict[str, Any]] = None, *,
+                 ack_p99_budget_s: float = ACK_P99_BUDGET_S,
+                 convergence_budget_s: float = CONVERGENCE_BUDGET_S
+                 ) -> Dict[str, Any]:
+    """Machine-readable fleet SLO verdict (see module docstring)."""
+    if matrix is None:
+        matrix = lag_matrix(snapshots)
+    ack_p99: Optional[float] = None
+    shed: Optional[float] = None
+    for snap in snapshots.values():
+        if not isinstance(snap, dict):
+            continue
+        hists = snap.get("histograms", {})
+        for s in hists.get("crdt_tpu_serve_ack_seconds", []):
+            v = histogram_quantile(s, 0.99)
+            if v is not None:
+                ack_p99 = v if ack_p99 is None else max(ack_p99, v)
+        ctrs = snap.get("counters", {})
+        for s in ctrs.get("crdt_tpu_serve_shed_total", []):
+            shed = (shed or 0.0) + s["value"]
+    conv = matrix.get("max_lag_s")
+    conv_ok: Optional[bool] = None
+    if matrix.get("origins"):
+        # An incomplete matrix is a failed convergence check even if
+        # the seen pairs are fast — an unseen pair IS unbounded lag.
+        conv_ok = bool(matrix.get("complete")
+                       and conv is not None
+                       and conv <= convergence_budget_s)
+    checks = {
+        "ack_p99_s": _check(ack_p99, ack_p99_budget_s),
+        "convergence_lag_s": _check(conv, convergence_budget_s,
+                                    ok=conv_ok),
+        "shed_writes": _check(shed, 0.0),
+    }
+    measured = [c["ok"] for c in checks.values()
+                if c["ok"] is not None]
+    scrape_errors = sorted(
+        name for name, snap in snapshots.items()
+        if isinstance(snap, dict) and "_scrape_error" in snap)
+    ok = bool(measured) and all(measured) and not scrape_errors
+    return {"checks": checks, "matrix_complete":
+            bool(matrix.get("complete")),
+            "scrape_errors": scrape_errors, "ok": ok}
+
+
+def render_federation(snapshots: Dict[str, dict],
+                      matrix: Optional[Dict[str, Any]] = None) -> str:
+    """Aggregated Prometheus exposition of the fleet-level series;
+    every series carries an ``instance`` (or origin/observer) label so
+    same-named per-replica series cannot collide."""
+    if matrix is None:
+        matrix = lag_matrix(snapshots)
+    lines: List[str] = []
+    lines.append("# TYPE crdt_tpu_fleet_up gauge")
+    for name, snap in sorted(snapshots.items()):
+        up = int(isinstance(snap, dict)
+                 and "_scrape_error" not in snap)
+        lines.append(f"crdt_tpu_fleet_up"
+                     f"{_labels({'instance': name})} {up}")
+    if matrix["origins"]:
+        lines.append("# TYPE crdt_tpu_canary_lag_seconds gauge")
+        for o in matrix["origins"]:
+            for w, v in sorted(matrix["lag_s"][o].items()):
+                if v is None:
+                    continue
+                lines.append(
+                    f"crdt_tpu_canary_lag_seconds"
+                    f"{_labels({'origin': o, 'observer': w})} "
+                    f"{_fmt(v)}")
+    emitted_type = False
+    for name, snap in sorted(snapshots.items()):
+        if not isinstance(snap, dict):
+            continue
+        for s in snap.get("histograms", {}).get(
+                "crdt_tpu_serve_ack_seconds", []):
+            v = histogram_quantile(s, 0.99)
+            if v is None or math.isinf(v):
+                continue
+            if not emitted_type:
+                lines.append(
+                    "# TYPE crdt_tpu_fleet_ack_p99_seconds gauge")
+                emitted_type = True
+            lines.append(f"crdt_tpu_fleet_ack_p99_seconds"
+                         f"{_labels(dict(s['labels'], instance=name))}"
+                         f" {_fmt(v)}")
+    emitted_type = False
+    for name, snap in sorted(snapshots.items()):
+        if not isinstance(snap, dict):
+            continue
+        for s in snap.get("counters", {}).get(
+                "crdt_tpu_serve_shed_total", []):
+            if not emitted_type:
+                lines.append(
+                    "# TYPE crdt_tpu_fleet_shed_total counter")
+                emitted_type = True
+            lines.append(f"crdt_tpu_fleet_shed_total"
+                         f"{_labels(dict(s['labels'], instance=name))}"
+                         f" {_fmt(s['value'])}")
+    return "\n".join(lines) + ("\n" if lines else "")
+
+
+def format_matrix(matrix: Dict[str, Any]) -> str:
+    """Human-readable (origin × observer) lag table, seconds."""
+    if not matrix["origins"]:
+        return "no canary data\n"
+    headers = ["origin\\observer"] + list(matrix["observers"])
+    rows = []
+    for o in matrix["origins"]:
+        row = [o]
+        for w in matrix["observers"]:
+            v = matrix["lag_s"][o].get(w)
+            row.append("-" if v is None else f"{v:.3f}")
+        rows.append(row)
+    return "\n".join(_table(headers, rows)) + "\n"
+
+
+def fleet_main(argv: Optional[List[str]] = None, out=None) -> int:
+    """``python -m crdt_tpu.obs fleet`` entry point. Returns the exit
+    code CI gates on: 0 iff the SLO verdict is ok (with ``--once``)."""
+    import argparse
+    import json
+    import sys
+    import time
+
+    ap = argparse.ArgumentParser(
+        prog="python -m crdt_tpu.obs fleet",
+        description="scrape a replica fleet into a canary lag matrix "
+                    "and SLO verdict")
+    ap.add_argument("--peers", required=True,
+                    help="comma list of [name=]host:port")
+    ap.add_argument("--once", action="store_true",
+                    help="poll once and exit (exit 1 on SLO breach)")
+    ap.add_argument("--interval", type=float, default=5.0)
+    ap.add_argument("--timeout", type=float, default=5.0)
+    ap.add_argument("--json", action="store_true",
+                    help="emit {matrix, slo} JSON per poll")
+    ap.add_argument("--prom", action="store_true",
+                    help="emit Prometheus federation text per poll")
+    ap.add_argument("--ack-budget", type=float,
+                    default=ACK_P99_BUDGET_S,
+                    help="serve ack p99 budget, seconds")
+    ap.add_argument("--lag-budget", type=float,
+                    default=CONVERGENCE_BUDGET_S,
+                    help="convergence lag budget, seconds")
+    args = ap.parse_args(argv)
+    out = sys.stdout if out is None else out
+    peers = parse_peers(args.peers)
+
+    while True:
+        snapshots = poll_fleet(peers, timeout=args.timeout)
+        matrix = lag_matrix(snapshots)
+        verdict = evaluate_slo(
+            snapshots, matrix, ack_p99_budget_s=args.ack_budget,
+            convergence_budget_s=args.lag_budget)
+        if args.json:
+            out.write(json.dumps({"matrix": matrix,
+                                  "slo": verdict}) + "\n")
+        elif args.prom:
+            out.write(render_federation(snapshots, matrix))
+        else:
+            out.write(format_matrix(matrix))
+            out.write(f"slo ok={verdict['ok']} "
+                      f"{json.dumps(verdict['checks'])}\n")
+        out.flush()
+        if args.once:
+            return 0 if verdict["ok"] else 1
+        time.sleep(args.interval)

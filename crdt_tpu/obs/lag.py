@@ -50,11 +50,16 @@ def lag_entry(local_head: Hlc, watermark: Optional[Hlc], *,
               last_error: Optional[BaseException] = None
               ) -> Dict[str, Any]:
     """One peer's staleness row — the shape `health()`, the ``metrics``
-    wire op, and the CLI all share."""
+    wire op, the fleet lag matrix, and the CLI all share.
+    ``seconds_behind`` is the same HLC-millis delta in seconds (the
+    unit the fleet poller's convergence-SLO budget is expressed in);
+    ``None`` when unsynced, like ``lag_ms``."""
+    ms = lag_millis(local_head, watermark)
     return {
         "watermark": None if watermark is None else str(watermark),
         "synced": watermark is not None,
-        "lag_ms": lag_millis(local_head, watermark),
+        "lag_ms": ms,
+        "seconds_behind": None if ms is None else ms / 1000.0,
         "pending_records": pending,
         "breaker": breaker,
         "dense": dense,

@@ -34,6 +34,13 @@ _LabelKey = Tuple[Tuple[str, str], ...]
 
 
 def _label_key(labels: Dict[str, Any]) -> _LabelKey:
+    # Single-label fast path: most hot-path series carry exactly one
+    # label (span name, trigger, outcome), and the sorted() generator
+    # round trip is pure overhead there — this sits under every
+    # counter.inc/histogram.observe in the tree.
+    if len(labels) == 1:
+        for k, v in labels.items():
+            return ((str(k), str(v)),)
     return tuple(sorted((str(k), str(v)) for k, v in labels.items()))
 
 
@@ -195,13 +202,39 @@ class MetricsRegistry:
         return self._get_or_create(Histogram, name, help,
                                    low_exp=low_exp, high_exp=high_exp)
 
-    def attach(self, kind: str, obj: Any, **labels: Any) -> Any:
+    def attach(self, kind: str, obj: Any, *, replace: bool = False,
+               **labels: Any) -> Any:
         """Register ``obj`` (anything with ``as_dict()``) as a live
-        stats collector; returns ``obj`` for chaining. Weakly held."""
-        entry = (kind, {str(k): str(v) for k, v in labels.items()},
-                 weakref.ref(obj))
+        stats collector; returns ``obj`` for chaining. Weakly held.
+
+        A ``(kind, label-set)`` pair identifies one exposition series.
+        Attaching a second live collector under an already-live pair
+        raises ``ValueError`` — duplicate label sets must never reach
+        the Prometheus exposition, where they are undefined. Pass
+        ``replace=True`` to supersede the prior entry instead: the
+        restart idiom (a replica re-created under the same node id
+        while the old object is still weakly reachable) keeps exactly
+        one series, the newest. Entries whose referent died are always
+        fair game for reuse.
+        """
+        label_map = {str(k): str(v) for k, v in labels.items()}
+        key = (kind, _label_key(label_map))
+        entry = (kind, label_map, weakref.ref(obj))
         with self._lock:
-            self._collectors.append(entry)
+            kept = []
+            for c in self._collectors:
+                if c[2]() is None:
+                    continue  # dead — prune opportunistically
+                if (c[0], _label_key(c[1])) == key:
+                    if not replace:
+                        raise ValueError(
+                            f"duplicate collector label set: "
+                            f"kind={kind!r} labels={label_map!r} "
+                            f"(pass replace=True to supersede)")
+                    continue  # superseded by the new entry
+                kept.append(c)
+            kept.append(entry)
+            self._collectors = kept
         return obj
 
     def snapshot(self) -> dict:

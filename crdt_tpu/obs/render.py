@@ -102,6 +102,11 @@ def render_prometheus(snapshot: Dict[str, Any]) -> str:
                 lines.append(
                     f"crdt_tpu_peer_lag_millis{_labels(labels)} "
                     f"{_fmt(entry['lag_ms'])}")
+            if entry.get("seconds_behind") is not None:
+                lines.append(
+                    f"crdt_tpu_peer_seconds_behind"
+                    f"{_labels(labels)} "
+                    f"{_fmt(entry['seconds_behind'])}")
             if entry.get("pending_records") is not None:
                 lines.append(
                     f"crdt_tpu_peer_pending_records"

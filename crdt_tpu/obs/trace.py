@@ -32,7 +32,9 @@ event is actually recorded, so disabled tracing never pays for a
 
 from __future__ import annotations
 
+import functools
 import json
+import os
 import threading
 import time
 from collections import deque
@@ -42,20 +44,56 @@ from typing import Any, Dict, List, Optional
 import jax.profiler
 
 
+@functools.lru_cache(maxsize=512)
+def _hlc_str_cached(millis: int, counter: int, node_id: Any) -> str:
+    # Format straight from the fields (the Hlc.__str__ layout);
+    # constructing a throwaway Hlc just to render it would double the
+    # miss cost. millis comes from a live Hlc, already normalized.
+    from ..hlc import _iso8601
+    return f"{_iso8601(millis)}-{counter:04X}-{node_id}"
+
+
+def _hlc_str(hlc: Any) -> str:
+    """``str(hlc)`` with a small field-keyed cache: emit sites hand
+    the SAME canonical stamp to every event between refreshes, so the
+    ISO-8601 render (the single biggest per-event cost) is paid once
+    per stamp, not once per event — what keeps the soak-measured
+    tracing overhead inside the 5% budget (bench.py antientropy
+    mode). Keyed on the raw fields, not the object: hashing must not
+    re-render the stamp."""
+    if isinstance(hlc, str):
+        return hlc
+    try:
+        return _hlc_str_cached(hlc.millis, hlc.counter, hlc.node_id)
+    except (AttributeError, TypeError):  # stamp-like — render directly
+        return str(hlc)
+
+
 class TraceRing:
-    """Bounded in-memory trace event ring + optional JSONL sink."""
+    """Bounded in-memory trace event ring + optional JSONL sink.
+
+    The sink is size-bounded: when ``max_sink_bytes`` is set on
+    :meth:`enable`, the file rolls to ``<path>.1`` (one generation,
+    overwritten on each roll) once it crosses the budget, so a
+    multi-hour soak holds at most ~2x the budget on disk.
+    """
 
     # crdtlint lock-discipline contract: ring storage and sink are
     # touched only under self._lock. ``enabled`` is a bare bool read
     # on hot paths by design (stale reads only delay on/off by one
     # event).
-    _CRDTLINT_GUARDED = {"_lock": ("_events", "_sink", "_seq")}
+    _CRDTLINT_GUARDED = {"_lock": ("_events", "_sink", "_seq",
+                                   "_sink_path", "_sink_bytes",
+                                   "_sink_max_bytes")}
 
     def __init__(self, capacity: int = 4096):
         self.enabled = False
         self._lock = threading.Lock()
         self._events: deque = deque(maxlen=capacity)
         self._sink = None
+        self._sink_path: Optional[str] = None
+        self._sink_bytes = 0
+        self._sink_max_bytes: Optional[int] = None
         self._seq = 0
 
     @property
@@ -64,9 +102,12 @@ class TraceRing:
             return self._events.maxlen
 
     def enable(self, capacity: Optional[int] = None,
-               jsonl_path: Optional[str] = None) -> "TraceRing":
+               jsonl_path: Optional[str] = None,
+               max_sink_bytes: Optional[int] = None) -> "TraceRing":
         """Turn event recording on; optionally resize the ring and/or
-        append every event to a JSONL file."""
+        append every event to a JSONL file. ``max_sink_bytes`` bounds
+        the sink: once the file crosses it, it is rotated to
+        ``<path>.1`` and a fresh file is started."""
         with self._lock:
             if capacity is not None:
                 self._events = deque(self._events, maxlen=capacity)
@@ -74,6 +115,13 @@ class TraceRing:
                 if self._sink is not None:
                     self._sink.close()
                 self._sink = open(jsonl_path, "a", encoding="utf-8")
+                self._sink_path = jsonl_path
+                try:
+                    self._sink_bytes = os.path.getsize(jsonl_path)
+                except OSError:
+                    self._sink_bytes = 0
+            if max_sink_bytes is not None:
+                self._sink_max_bytes = max_sink_bytes
         self.enabled = True
         return self
 
@@ -84,6 +132,9 @@ class TraceRing:
             if self._sink is not None:
                 self._sink.close()
                 self._sink = None
+            self._sink_path = None
+            self._sink_bytes = 0
+            self._sink_max_bytes = None
 
     def clear(self) -> None:
         with self._lock:
@@ -100,15 +151,26 @@ class TraceRing:
             if callable(hlc):
                 hlc = hlc()
             if hlc is not None:
-                event["hlc"] = str(hlc)
+                event["hlc"] = _hlc_str(hlc)
         event.update(fields)
         with self._lock:
             self._seq += 1
             event["seq"] = self._seq
             self._events.append(event)
             if self._sink is not None:
-                self._sink.write(json.dumps(event, default=str) + "\n")
+                # json.dumps defaults to ASCII output, so len() == bytes.
+                line = json.dumps(event, default=str) + "\n"
+                self._sink.write(line)
                 self._sink.flush()
+                self._sink_bytes += len(line)
+                if (self._sink_max_bytes is not None
+                        and self._sink_path is not None
+                        and self._sink_bytes >= self._sink_max_bytes):
+                    self._sink.close()
+                    os.replace(self._sink_path, self._sink_path + ".1")
+                    self._sink = open(self._sink_path, "a",
+                                      encoding="utf-8")
+                    self._sink_bytes = 0
 
     def events(self, kind: Optional[str] = None) -> List[dict]:
         """Snapshot the ring (oldest first), optionally one kind."""
@@ -120,6 +182,25 @@ class TraceRing:
 
 
 _DEFAULT = TraceRing()
+
+# Cross-replica round ids come from a locked process counter, NOT the
+# wall clock (crdtlint wall-clock-read): the node-id prefix makes them
+# fleet-unique, the counter makes them process-unique, and no clock
+# skew can make two rounds collide or reorder.
+_RID_LOCK = threading.Lock()
+_RID_N = 0
+
+
+def round_id(node: Any = None) -> str:
+    """Compact fleet-unique sync-round id, e.g. ``"a.r17"``: the
+    initiator stamps one per round and piggybacks it on sync frames
+    (the ``trace`` hello cap) so its ``sync_*`` span and the
+    responder's merge span correlate in the JSONL sink."""
+    global _RID_N
+    with _RID_LOCK:
+        _RID_N += 1
+        n = _RID_N
+    return f"{node}.r{n}" if node not in (None, "") else f"r{n}"
 
 # Span durations double into a fixed log2 histogram so the metrics op
 # exposes per-phase latency distributions, not just the event tail the

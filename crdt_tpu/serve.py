@@ -66,7 +66,7 @@ import numpy as np
 from .hlc import Hlc
 from .net import (MAX_FRAME_BYTES, FrameCodec, WireTally,
                   _flat_views, _pack_for_peer, _pack_split,
-                  _unpack_split)
+                  _recv_span, _unpack_split)
 
 
 # --- async framing (the length-prefixed wire of net.py, loop-side) ---
@@ -196,7 +196,8 @@ class ServeTier:
         from .obs.registry import default_registry
         reg = default_registry()
         self.tally = WireTally()
-        reg.attach("wire", self.tally, role="serve", node=self._node)
+        reg.attach("wire", self.tally, replace=True, role="serve",
+                   node=self._node)
         self._m_sessions = reg.gauge(
             "crdt_tpu_serve_sessions",
             "live multiplexed client sessions")
@@ -216,6 +217,12 @@ class ServeTier:
         self._m_ack = reg.histogram(
             "crdt_tpu_serve_ack_seconds",
             "write enqueue-to-ack latency (queue wait + tick commit)")
+        self._m_ack_phase = reg.histogram(
+            "crdt_tpu_serve_ack_phase_seconds",
+            "write-ack latency decomposed by phase: queue_wait (enqueue "
+            "to tick pickup), stamp (HLC send_batch), scatter (device "
+            "commit dispatch), ack_write (residual tick work + ack "
+            "fan-out)")
 
         # Loop-confined state (touched only from the tier's event
         # loop, so no lock): the pending write queue, live sessions,
@@ -384,11 +391,13 @@ class ServeTier:
         q, self._q = self._q, []
         self._m_depth.set(0, node=self._node)
         n = len(q)
+        tick_t = time.perf_counter()
+        phases: dict = {}
         try:
             slots = np.fromiter((e[0] for e in q), np.int64, count=n)
             vals = np.fromiter((e[1] for e in q), np.int64, count=n)
             tombs = np.fromiter((e[2] for e in q), bool, count=n)
-            await self._loop.run_in_executor(
+            phases = await self._loop.run_in_executor(
                 self._replica_pool, self._commit, slots, vals, tombs)
             outcome: Any = True
         except Exception as e:
@@ -398,18 +407,40 @@ class ServeTier:
             # mid-ack never leaves an unretrieved exception behind.
             outcome = f"{type(e).__name__}: {e}"
         now = time.perf_counter()
+        # Ack attribution (SERVE_r01 follow-up): every write in the
+        # tick shares the combiner's stamp/scatter legs; queue_wait is
+        # per write; ack_write is the residual tick time the phase
+        # timers don't cover (queue drain, executor hop, ack fan-out).
+        # Per-write observation keeps sum(phase sums) comparable to
+        # the crdt_tpu_serve_ack_seconds sum. Failed ticks committed
+        # nothing, so nothing is attributed.
+        stamp = float(phases.get("stamp", 0.0)) if phases else 0.0
+        scatter = float(phases.get("scatter", 0.0)) if phases else 0.0
+        ack_write = max(0.0, (now - tick_t) - stamp - scatter)
         for _, _, _, fut, t0 in q:
             if not fut.done():
                 fut.set_result(outcome)
             self._m_ack.observe(now - t0, node=self._node)
+            if outcome is True:
+                self._m_ack_phase.observe(
+                    max(0.0, tick_t - t0), phase="queue_wait",
+                    node=self._node)
+                self._m_ack_phase.observe(stamp, phase="stamp",
+                                          node=self._node)
+                self._m_ack_phase.observe(scatter, phase="scatter",
+                                          node=self._node)
+                self._m_ack_phase.observe(ack_write, phase="ack_write",
+                                          node=self._node)
 
     def _commit(self, slots: np.ndarray, vals: np.ndarray,
-                tombs: np.ndarray) -> None:
+                tombs: np.ndarray) -> dict:
         with self.lock:
             wc = self._wc
             self.crdt.put_batch(slots, vals, tombs)
             if wc is not None:
                 wc.flush("tick")
+                return dict(wc.last_phase_seconds)
+        return {}
 
     # --- replica helpers (executor threads, lock held) ---
 
@@ -427,16 +458,21 @@ class ServeTier:
             caps.add("semantics")
         if merkle:
             caps.add("merkle")
+        # Trace-context piggybacking is pure frame metadata — no
+        # replica surface needed, so it is advertised unconditionally
+        # (same as SyncServer).
+        caps.add("trace")
         return caps
 
     def _read_slot(self, slot: int):
         with self.lock:
             return self.crdt.get(slot)
 
-    def _merge_json(self, payload: str) -> None:
-        with self.lock:
-            self.crdt.merge_json(payload, key_decoder=self._kdec,
-                                 value_decoder=self._vdec)
+    def _merge_json(self, payload: str, tctx=None) -> None:
+        with _recv_span("push", tctx):
+            with self.lock:
+                self.crdt.merge_json(payload, key_decoder=self._kdec,
+                                     value_decoder=self._vdec)
 
     def _export_json(self, since: Optional[str]) -> str:
         with self.lock:
@@ -445,12 +481,13 @@ class ServeTier:
                 else Hlc.parse(since),
                 key_encoder=self._kenc, value_encoder=self._venc)
 
-    def _merge_dense(self, meta, blob: bytes, ids) -> None:
+    def _merge_dense(self, meta, blob: bytes, ids, tctx=None) -> None:
         scs = _unpack_split(meta, blob)
         if not isinstance(ids, list) or not ids:
             raise ValueError("push_dense without node_ids")
-        with self.lock:
-            self.crdt.merge_split(scs, ids)
+        with _recv_span("push_dense", tctx):
+            with self.lock:
+                self.crdt.merge_split(scs, ids)
 
     def _export_dense(self, since: Optional[str]):
         with self.lock:
@@ -459,14 +496,15 @@ class ServeTier:
         meta, bufs = _pack_split(scs)
         return {"meta": meta, "node_ids": list(ids)}, bufs
 
-    def _merge_packed(self, meta, blob: bytes, ids) -> None:
+    def _merge_packed(self, meta, blob: bytes, ids, tctx=None) -> None:
         from .ops.packing import unpack_rows
         packed = unpack_rows(meta, blob)
         if not isinstance(ids, list):
             raise ValueError("push_packed without node_ids")
         if packed.k:
-            with self.lock:
-                self.crdt.merge_packed(packed, ids)
+            with _recv_span("push_packed", tctx):
+                with self.lock:
+                    self.crdt.merge_packed(packed, ids)
 
     def _export_packed(self, since: Optional[str], ranges,
                        sem_ok: bool):
@@ -584,6 +622,7 @@ class ServeTier:
         loop = self._loop
         codec: Optional[FrameCodec] = None
         sem_ok = False
+        trace_ok = False
         while not self._stop_event.is_set():
             msg = await self._read_op(reader, codec)
             if msg is None or not isinstance(msg, dict) \
@@ -591,6 +630,9 @@ class ServeTier:
                 return
             op = msg.get("op")
             self._m_ops.inc(op=str(op), node=self._node)
+            tctx = msg.get("trace") if trace_ok else None
+            if not isinstance(tctx, dict):
+                tctx = None
 
             if op in ("put", "delete"):
                 slot = msg.get("slot")
@@ -643,12 +685,13 @@ class ServeTier:
                     codec, self.tally)
                 codec = FrameCodec(compress="zlib" in agreed)
                 sem_ok = "semantics" in agreed
+                trace_ok = "trace" in agreed
 
             elif op == "push":
                 try:
                     await loop.run_in_executor(
                         self._replica_pool, self._merge_json,
-                        msg["payload"])
+                        msg["payload"], tctx)
                 except Exception as e:
                     await write_json_async(
                         writer, {"ok": False, "code": "merge_rejected",
@@ -681,7 +724,8 @@ class ServeTier:
                 try:
                     await loop.run_in_executor(
                         self._replica_pool, self._merge_dense,
-                        msg.get("meta"), blob, msg.get("node_ids"))
+                        msg.get("meta"), blob, msg.get("node_ids"),
+                        tctx)
                 except Exception as e:
                     await write_json_async(
                         writer, {"ok": False, "code": "dense_rejected",
@@ -716,7 +760,8 @@ class ServeTier:
                 try:
                     await loop.run_in_executor(
                         self._replica_pool, self._merge_packed,
-                        msg.get("meta"), blob, msg.get("node_ids"))
+                        msg.get("meta"), blob, msg.get("node_ids"),
+                        tctx)
                 except Exception as e:
                     await write_json_async(
                         writer, {"ok": False,

@@ -106,23 +106,31 @@ def sync_packed(local, remote, since=_SAME_ROUND) -> Hlc:
     # only when BOTH replicas expose the typed surface (docs/TYPES.md);
     # otherwise typed rows are withheld, never stripped of their tags.
     from .net import _pack_for_peer
+    from .obs.trace import round_id, span, tracer
     sem_ok = (hasattr(local, "set_semantics")
               and hasattr(remote, "set_semantics"))
-    packed, ids = _pack_for_peer(local, push_bound, sem_ok)
-    if packed.k:
-        remote.merge_packed(packed, ids)
-    pulled, pulled_ids = _pack_for_peer(remote, pull_bound, sem_ok)
-    if pulled.k:
-        if hasattr(local, "merge_and_repack"):
-            # Fused merge+repack: the pull's join also computes (and
-            # caches) the NEXT round's push pack under this round's
-            # watermark — the exact `since` a resumed delta round
-            # presents (docs/FASTPATH.md).
-            local.merge_and_repack(
-                pulled, pulled_ids, since=watermark,
-                sem_mode="include" if sem_ok else "auto")
-        else:
-            local.merge_packed(pulled, pulled_ids)
+    node = str(getattr(local, "node_id", ""))
+    # Same round id a socket round would carry in its trace context —
+    # in-process rounds correlate in the one JSONL sink identically.
+    rid = {"rid": round_id(node)} if tracer().enabled else {}
+    with span("sync_packed", kind="sync", node=node,
+              hlc=lambda: local.canonical_time,
+              peer=str(getattr(remote, "node_id", "")), **rid):
+        packed, ids = _pack_for_peer(local, push_bound, sem_ok)
+        if packed.k:
+            remote.merge_packed(packed, ids)
+        pulled, pulled_ids = _pack_for_peer(remote, pull_bound, sem_ok)
+        if pulled.k:
+            if hasattr(local, "merge_and_repack"):
+                # Fused merge+repack: the pull's join also computes
+                # (and caches) the NEXT round's push pack under this
+                # round's watermark — the exact `since` a resumed
+                # delta round presents (docs/FASTPATH.md).
+                local.merge_and_repack(
+                    pulled, pulled_ids, since=watermark,
+                    sem_mode="include" if sem_ok else "auto")
+            else:
+                local.merge_packed(pulled, pulled_ids)
     return watermark
 
 
@@ -177,42 +185,49 @@ def sync_merkle(local, remote) -> MerkleSyncReport:
     mismatch — the socket path's ``merkle_rejected``, where a full
     packed round is the right fallback."""
     from .ops.digest import coalesce_leaf_ranges, walk_divergent_leaves
+    from .obs.trace import round_id, span, tracer
     drain = getattr(local, "drain_ingest", None)
     if drain is not None:
         drain()
     watermark = local.canonical_time
-    tree = local.digest_tree()
-    remote_tree = remote.digest_tree()
-    if not tree.same_geometry(remote_tree.n_slots,
-                              remote_tree.leaf_width,
-                              remote_tree.depth):
-        raise ValueError(
-            f"merkle geometry mismatch: local ({tree.n_slots}, "
-            f"{tree.leaf_width}) vs remote ({remote_tree.n_slots}, "
-            f"{remote_tree.leaf_width})")
-    leaves, rounds, fetched = walk_divergent_leaves(
-        tree, remote_tree.values)
-    if not leaves:
-        return MerkleSyncReport(watermark, rounds, fetched, (),
-                                0, 0, 0)
-    ranges = coalesce_leaf_ranges(leaves, tree.leaf_width,
-                                  tree.n_slots)
-    from .net import _pack_for_peer
-    sem_ok = (hasattr(local, "set_semantics")
-              and hasattr(remote, "set_semantics"))
-    packed, ids = _pack_for_peer(local, None, sem_ok, ranges=ranges)
-    payload = _packed_nbytes(packed) if packed.k else 0
-    if packed.k:
-        remote.merge_packed(packed, ids)
-    pulled, pulled_ids = _pack_for_peer(remote, None, sem_ok,
-                                        ranges=ranges)
-    payload += _packed_nbytes(pulled) if pulled.k else 0
-    if pulled.k:
-        if hasattr(local, "merge_and_repack"):
-            local.merge_and_repack(
-                pulled, pulled_ids, since=watermark,
-                sem_mode="include" if sem_ok else "auto")
-        else:
-            local.merge_packed(pulled, pulled_ids)
+    node = str(getattr(local, "node_id", ""))
+    rid = {"rid": round_id(node)} if tracer().enabled else {}
+    with span("sync_merkle", kind="sync", node=node,
+              hlc=lambda: local.canonical_time,
+              peer=str(getattr(remote, "node_id", "")), **rid):
+        tree = local.digest_tree()
+        remote_tree = remote.digest_tree()
+        if not tree.same_geometry(remote_tree.n_slots,
+                                  remote_tree.leaf_width,
+                                  remote_tree.depth):
+            raise ValueError(
+                f"merkle geometry mismatch: local ({tree.n_slots}, "
+                f"{tree.leaf_width}) vs remote ({remote_tree.n_slots}, "
+                f"{remote_tree.leaf_width})")
+        leaves, rounds, fetched = walk_divergent_leaves(
+            tree, remote_tree.values)
+        if not leaves:
+            return MerkleSyncReport(watermark, rounds, fetched, (),
+                                    0, 0, 0)
+        ranges = coalesce_leaf_ranges(leaves, tree.leaf_width,
+                                      tree.n_slots)
+        from .net import _pack_for_peer
+        sem_ok = (hasattr(local, "set_semantics")
+                  and hasattr(remote, "set_semantics"))
+        packed, ids = _pack_for_peer(local, None, sem_ok,
+                                     ranges=ranges)
+        payload = _packed_nbytes(packed) if packed.k else 0
+        if packed.k:
+            remote.merge_packed(packed, ids)
+        pulled, pulled_ids = _pack_for_peer(remote, None, sem_ok,
+                                            ranges=ranges)
+        payload += _packed_nbytes(pulled) if pulled.k else 0
+        if pulled.k:
+            if hasattr(local, "merge_and_repack"):
+                local.merge_and_repack(
+                    pulled, pulled_ids, since=watermark,
+                    sem_mode="include" if sem_ok else "auto")
+            else:
+                local.merge_packed(pulled, pulled_ids)
     return MerkleSyncReport(watermark, rounds, fetched, ranges,
                             int(packed.k), int(pulled.k), payload)

@@ -91,9 +91,11 @@ class MergeStats:
         collector (weakly held); returns self for chaining. Note the
         scrape drains the lazy device sums (`records_seen` /
         `records_adopted` force a device→host fetch) — snapshot from a
-        monitoring thread, not from inside a pipelined window."""
+        monitoring thread, not from inside a pipelined window.
+        Re-registering under an already-live label set supersedes it
+        (the replica-restart idiom: same node id, new object)."""
         from ..obs.registry import default_registry
-        default_registry().attach("merge", self, **labels)
+        default_registry().attach("merge", self, replace=True, **labels)
         return self
 
 
@@ -134,9 +136,12 @@ class PeerSyncStats:
 
     def register(self, **labels: Any) -> "PeerSyncStats":
         """Attach to the process-wide metrics registry as a
-        ``peer_sync`` collector (weakly held); returns self."""
+        ``peer_sync`` collector (weakly held); returns self. A
+        re-``add_peer`` under the same (node, peer) labels supersedes
+        the prior collector rather than duplicating the series."""
         from ..obs.registry import default_registry
-        default_registry().attach("peer_sync", self, **labels)
+        default_registry().attach("peer_sync", self, replace=True,
+                                  **labels)
         return self
 
 

@@ -296,6 +296,63 @@ def test_async_blocking_call_suppressible_with_reason():
     assert "suppression-without-reason" not in rules
 
 
+def test_metric_name_unprefixed_flagged():
+    src = (
+        "def setup(reg):\n"
+        "    reg.counter('requests_total', 'h')\n"
+        "    reg.gauge('depth')\n"
+        "    reg.histogram('lat_seconds', 'h')\n")
+    findings = [f for f in lint_source(src, "snippet.py")
+                if f.rule == "metric-name-unprefixed"]
+    assert len(findings) == 3
+    assert {f.line for f in findings} == {2, 3, 4}
+    assert "namespace" in findings[0].message
+
+
+def test_metric_name_prefixed_passes():
+    src = (
+        "def setup(reg):\n"
+        "    c = reg.counter('crdt_tpu_requests_total', 'h')\n"
+        "    c.inc(op='put', node=node)\n"
+        "    reg.histogram('crdt_tpu_lat_seconds').observe(\n"
+        "        0.5, trigger=trigger, peer=name)\n")
+    rules = {f.rule for f in lint_source(src, "snippet.py")}
+    assert "metric-name-unprefixed" not in rules
+
+
+def test_metric_label_from_user_key_flagged():
+    src = (
+        "def record(c, h, key, slot):\n"
+        "    c.inc(key=str(key))\n"
+        "    h.observe(0.1, shard=slot % 4)\n")
+    findings = [f for f in lint_source(src, "snippet.py")
+                if f.rule == "metric-name-unprefixed"]
+    assert {f.line for f in findings} == {2, 3}
+    assert "cardinality" in findings[0].message
+
+
+def test_metric_label_rule_skips_jax_at_set():
+    # jax's .at[slots].set(values, mode='drop') is not a metric sink:
+    # the cardinality scan only inspects keyword values, and mode= is
+    # a constant
+    src = (
+        "def commit(store, slots, values):\n"
+        "    return store.at[slots].set(values, mode='drop')\n")
+    rules = {f.rule for f in lint_source(src, "snippet.py")}
+    assert "metric-name-unprefixed" not in rules
+
+
+def test_metric_name_suppressible_with_reason():
+    src = (
+        "def bridge(reg):\n"
+        "    # crdtlint: disable=metric-name-unprefixed --"
+        " exporting a foreign exporter's series verbatim\n"
+        "    reg.counter('up', 'h')\n")
+    rules = {f.rule for f in lint_source(src, "snippet.py")}
+    assert "metric-name-unprefixed" not in rules
+    assert "suppression-without-reason" not in rules
+
+
 def test_shipped_tree_lints_clean():
     from crdt_tpu.analysis.host_lint import lint_package
     import crdt_tpu

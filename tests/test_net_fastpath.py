@@ -83,7 +83,8 @@ def test_hello_negotiates_cap_intersection():
                             timeout=5.0) as conn:
             conn.ensure()
             assert conn.caps == frozenset({"zlib", "packed",
-                                           "semantics", "merkle"})
+                                           "semantics", "merkle",
+                                           "trace"})
             assert not conn.legacy
         with PeerConnection(server.host, server.port, timeout=5.0,
                             want_caps=("zlib",)) as conn:
@@ -96,7 +97,7 @@ def test_map_server_does_not_advertise_packed():
         with PeerConnection(server.host, server.port,
                             timeout=5.0) as conn:
             conn.ensure()
-            assert conn.caps == frozenset({"zlib"})
+            assert conn.caps == frozenset({"zlib", "trace"})
 
 
 def test_pooled_session_reuses_one_connect():
@@ -662,3 +663,117 @@ def test_gossip_downgrade_is_sticky_and_converges_lww_rows():
     assert a.get(9) == 90
     assert b.get(0) is None                   # withheld, not mangled
     assert a.orset_members(0) == frozenset({1})
+
+
+# --- mixed-version trace negotiation (PR 11) ---
+
+def _packed_round_bytes(enable_trace, want_caps=None,
+                        strip_server_trace=False):
+    """One deterministic packed round; returns (sent, received,
+    caps). FakeClock makes the payload byte-identical across calls,
+    so two runs differing only in tracer state compare exactly."""
+    from crdt_tpu.obs import tracer
+    clk = FakeClock()
+    a = DenseCrdt("mv-a", n_slots=64, wall_clock=clk)
+    b = DenseCrdt("mv-b", n_slots=64, wall_clock=clk)
+    a.put_batch([1, 2, 3], [10, 20, 30])
+    ring = tracer()
+    if enable_trace:
+        ring.enable()
+        ring.clear()
+    try:
+        with SyncServer(b) as server:
+            if strip_server_trace:
+                orig = server._caps
+                server._caps = lambda: orig() - {"trace"}
+            kw = {} if want_caps is None else {"want_caps": want_caps}
+            tally = WireTally()
+            with PeerConnection(server.host, server.port,
+                                timeout=5.0, **kw) as conn:
+                sync_packed_over_conn(a, conn, since=None,
+                                      lock=server.lock, tally=tally)
+                caps = conn.caps
+        assert b.get(1) == 10 and b.get(3) == 30
+        return tally.sent, tally.received, caps
+    finally:
+        if enable_trace:
+            ring.disable()
+            ring.clear()
+
+
+def test_trace_client_against_pretrace_server_byte_identical():
+    """A trace-capable client syncing with a pre-trace server must
+    negotiate the cap off and keep the wire byte-identical to an
+    untraced run — even with the process tracer ENABLED."""
+    base = _packed_round_bytes(False, strip_server_trace=True)
+    traced = _packed_round_bytes(True, strip_server_trace=True)
+    assert base[2] == traced[2]
+    assert "trace" not in traced[2]
+    assert (base[0], base[1]) == (traced[0], traced[1])
+
+
+def test_pretrace_client_against_trace_server_byte_identical():
+    """The other direction: an old client that never asks for the cap
+    gets identical bytes from a modern server whatever the server's
+    tracer state."""
+    want = ("zlib", "packed", "semantics", "merkle")
+    base = _packed_round_bytes(False, want_caps=want)
+    traced = _packed_round_bytes(True, want_caps=want)
+    assert "trace" not in traced[2]
+    assert (base[0], base[1]) == (traced[0], traced[1])
+
+
+def test_trace_cap_rides_only_when_tracer_enabled():
+    """Negotiating the cap costs nothing on the round itself: with
+    the tracer OFF, a trace-negotiated session sends byte-identical
+    requests, and only the hello REPLY differs (the server naming the
+    extra cap). With the tracer ON the context does ride."""
+    capless = _packed_round_bytes(False, strip_server_trace=True)
+    negotiated = _packed_round_bytes(False)
+    assert "trace" in negotiated[2]
+    assert capless[0] == negotiated[0]
+    # the received delta is the hello caps list alone — one short
+    # token, nothing per-frame
+    assert 0 < negotiated[1] - capless[1] <= 16
+    traced = _packed_round_bytes(True)
+    assert traced[0] > negotiated[0]
+
+
+def test_trace_negotiation_survives_midhello_truncate():
+    """FaultProxy cuts connection 1 twenty bytes in — mid-hello. The
+    client sees a retryable transport fault, reconnects, and the
+    fresh hello still negotiates trace; the round then correlates
+    across the wire as usual."""
+    from crdt_tpu.obs import tracer
+    from crdt_tpu.testing_faults import ScriptedSchedule
+    clk = FakeClock()
+    a = DenseCrdt("mh-a", n_slots=64, wall_clock=clk)
+    b = DenseCrdt("mh-b", n_slots=64, wall_clock=clk)
+    a.put_batch([7], [70])
+    ring = tracer()
+    ring.enable()
+    ring.clear()
+    schedule = ScriptedSchedule([{"kind": "truncate", "after": 20}])
+    try:
+        with SyncServer(b) as server:
+            with FaultProxy(server.host, server.port,
+                            schedule) as proxy:
+                conn = PeerConnection(proxy.host, proxy.port,
+                                      timeout=5.0)
+                with pytest.raises(SyncTransportError):
+                    sync_packed_over_conn(a, conn, since=None,
+                                          lock=server.lock)
+                assert not conn.connected and not conn.legacy
+                sync_packed_over_conn(a, conn, since=None,
+                                      lock=server.lock)
+                assert "trace" in conn.caps
+                conn.close()
+        assert b.get(7) == 70
+        (sync_span,) = [e for e in ring.events("sync")
+                        if e.get("span") == "sync_packed"
+                        and e.get("rid")]
+        assert any(e.get("rid") == sync_span["rid"]
+                   for e in ring.events("sync_recv"))
+    finally:
+        ring.disable()
+        ring.clear()

@@ -501,3 +501,330 @@ def test_crdtlint_clean_on_obs_package():
     obs_dir = os.path.join(os.path.dirname(os.path.dirname(
         os.path.abspath(__file__))), "crdt_tpu", "obs")
     assert lint_main(["--lint", obs_dir, "--json"]) == 0
+
+
+# ------------------------- fleet plane: registry attach semantics
+
+
+def test_attach_rejects_duplicate_live_label_set():
+    reg = MetricsRegistry()
+    a, b = MergeStats(), MergeStats()
+    reg.attach("merge", a, backend="X", node="n")
+    with pytest.raises(ValueError, match="duplicate"):
+        reg.attach("merge", b, backend="X", node="n")
+    # a different label set is a different series: fine
+    reg.attach("merge", b, backend="X", node="m")
+    assert len(reg.snapshot()["stats"]["merge"]) == 2
+
+
+def test_attach_replace_supersedes_live_entry():
+    reg = MetricsRegistry()
+    a, b = MergeStats(), MergeStats()
+    a.merges, b.merges = 1, 2
+    reg.attach("merge", a, node="n")
+    reg.attach("merge", b, node="n", replace=True)
+    (entry,) = reg.snapshot()["stats"]["merge"]
+    assert entry["values"]["merges"] == 2
+    del a   # keep the superseded object alive until after the check
+
+
+def test_attach_reuses_dead_entry_without_replace():
+    import gc
+    reg = MetricsRegistry()
+    a = MergeStats()
+    reg.attach("merge", a, node="n")
+    del a
+    gc.collect()
+    b = MergeStats()
+    reg.attach("merge", b, node="n")          # no raise: referent died
+    assert len(reg.snapshot()["stats"]["merge"]) == 1
+
+
+def test_gossip_restart_same_node_id_does_not_raise():
+    """The restart idiom: a node re-created under the same node id
+    while the prior incarnation is still weakly reachable must
+    supersede its collectors, not raise (replace=True at every
+    identity-collector site)."""
+    clk = FakeClock()
+    first = _node(MapCrdt("obs-restart", wall_clock=clk))
+    second = _node(MapCrdt("obs-restart", wall_clock=clk))
+    rows = [e for e in metrics_snapshot()["stats"]["wire"]
+            if e["labels"] == {"role": "client",
+                               "node": "obs-restart"}]
+    assert len(rows) == 1
+    del first, second
+
+
+# ------------------------- fleet plane: exposition escaping
+
+
+def test_prometheus_escapes_label_values():
+    reg = MetricsRegistry()
+    c = reg.counter("crdt_tpu_esc_total", "escape regression")
+    c.inc(peer='quo"te', path="back\\slash", msg="line\nbreak")
+    text = render_prometheus(reg.snapshot())
+    assert 'peer="quo\\"te"' in text
+    assert 'path="back\\\\slash"' in text
+    assert 'msg="line\\nbreak"' in text
+    # no raw newline may survive inside any sample line
+    for line in text.splitlines():
+        assert "\n" not in line
+
+
+def test_prometheus_renders_seconds_behind():
+    head = Hlc(1_700_000_060_000, 0, "a")
+    mark = Hlc(1_700_000_000_000, 0, "a")
+    snap = {"node": {"node_id": "sb-a"},
+            "lag": {"b": lag_entry(head, mark)}}
+    text = render_prometheus(snap)
+    assert ('crdt_tpu_peer_seconds_behind{node="sb-a",peer="b"} 60'
+            in text)
+
+
+def test_lag_entry_seconds_behind():
+    head = Hlc(1_700_000_060_000, 0, "a")
+    mark = Hlc(1_700_000_000_000, 3, "a")
+    assert lag_entry(head, mark)["seconds_behind"] == \
+        pytest.approx(60.0)
+    assert lag_entry(head, None)["seconds_behind"] is None
+
+
+# ------------------------- fleet plane: bounded trace sink
+
+
+def test_trace_sink_rotates_at_byte_budget(tmp_path):
+    import os
+    path = str(tmp_path / "trace.jsonl")
+    ring = TraceRing()
+    ring.enable(jsonl_path=path, max_sink_bytes=512)
+    for i in range(64):
+        ring.emit("soak", i=i, pad="x" * 40)
+    ring.disable()
+    rolled = path + ".1"
+    assert os.path.exists(rolled)
+    # one generation: live file + rolled file bound the disk footprint
+    # to ~2x the budget, however long the soak ran
+    line_len = len(json.dumps({"kind": "soak", "mono_s": 0.0,
+                               "i": 1, "pad": "x" * 40,
+                               "seq": 1})) + 1
+    assert os.path.getsize(path) <= 512 + line_len
+    assert os.path.getsize(rolled) <= 512 + line_len
+    # both generations hold intact JSONL (no torn lines at the roll)
+    for p in (path, rolled):
+        for line in open(p).read().splitlines():
+            json.loads(line)
+
+
+def test_round_id_unique_and_node_prefixed():
+    from crdt_tpu.obs import round_id
+    a, b = round_id("n1"), round_id("n1")
+    assert a != b
+    assert a.startswith("n1.r") and b.startswith("n1.r")
+    assert round_id().startswith("r")
+
+
+# ------------------------- fleet plane: canary probe + lag matrix
+
+
+def test_canary_probe_beat_observed_and_matrix():
+    from crdt_tpu.obs import CanaryProbe, evaluate_slo, lag_matrix
+    from crdt_tpu.sync import sync_packed
+    a = DenseCrdt("can-a", 32, wall_clock=FakeClock())
+    b = DenseCrdt("can-b", 32, wall_clock=FakeClock())
+    pa = CanaryProbe(a, origin=0, n_origins=2)
+    pb = CanaryProbe(b, origin=1, n_origins=2)
+    assert (pa.slot, pb.slot) == (30, 31)     # top of the store
+    pa.beat(1_000_000)
+    pb.beat(1_002_500)
+    sync_packed(a, b, since=None)
+    snaps = {"a": {"canary": pa.snapshot()},
+             "b": {"canary": pb.snapshot()}}
+    m = lag_matrix(snaps)
+    assert m["origins"] == ["0", "1"] and m["complete"]
+    assert m["max_lag_s"] == 0.0
+    # origin 0 beats again without replicating: b falls 60s behind
+    pa.beat(1_060_000)
+    snaps = {"a": {"canary": pa.snapshot()},
+             "b": {"canary": pb.snapshot()}}
+    m = lag_matrix(snaps)
+    assert m["complete"]                       # pair seen, just stale
+    assert m["lag_s"]["0"]["b"] == pytest.approx(60.0)
+    assert m["lag_s"]["0"]["a"] == 0.0
+    verdict = evaluate_slo(snaps, m)
+    assert verdict["checks"]["convergence_lag_s"]["ok"] is False
+    assert verdict["ok"] is False
+
+
+def test_canary_probe_validates_range():
+    from crdt_tpu.obs import CanaryProbe
+    crdt = DenseCrdt("can-v", 16, wall_clock=FakeClock())
+    with pytest.raises(ValueError):
+        CanaryProbe(crdt, origin=2, n_origins=2)
+    with pytest.raises(ValueError):
+        CanaryProbe(crdt, origin=0, n_origins=32)
+
+
+def test_lag_matrix_incomplete_pair_fails_convergence():
+    from crdt_tpu.obs import evaluate_slo, lag_matrix
+    snaps = {"a": {"canary": {"origin": 0, "n_origins": 2,
+                              "base_slot": 30,
+                              "observed": {"0": 1000, "1": None}}},
+             "b": {"canary": {"origin": 1, "n_origins": 2,
+                              "base_slot": 30,
+                              "observed": {"0": 1000, "1": 2000}}}}
+    m = lag_matrix(snaps)
+    assert not m["complete"]
+    assert m["lag_s"]["1"]["a"] is None
+    verdict = evaluate_slo(snaps, m)
+    # an unseen pair IS unbounded lag, whatever the seen pairs say
+    assert verdict["checks"]["convergence_lag_s"]["ok"] is False
+
+
+def test_histogram_quantile_bounds():
+    import math
+    from crdt_tpu.obs.fleet import histogram_quantile
+    h = Histogram("crdt_tpu_hq", "", low_exp=-2, high_exp=2)
+    for v in (0.2, 0.2, 3.0):
+        h.observe(v)
+    (s,) = h.samples()
+    assert histogram_quantile(s, 0.5) == 0.25
+    assert histogram_quantile(s, 0.99) == 4.0
+    assert histogram_quantile({"count": 0}, 0.5) is None
+    h2 = Histogram("crdt_tpu_hq2", "", low_exp=-2, high_exp=2)
+    h2.observe(100.0)                          # overflow bucket
+    (s2,) = h2.samples()
+    assert math.isinf(histogram_quantile(s2, 0.99))
+
+
+def test_parse_peers_forms():
+    from crdt_tpu.obs.fleet import parse_peers
+    assert parse_peers("a=h:1, b=h2:2") == [("a", "h", 1),
+                                            ("b", "h2", 2)]
+    assert parse_peers("127.0.0.1:9") == \
+        [("127.0.0.1:9", "127.0.0.1", 9)]
+    with pytest.raises(ValueError):
+        parse_peers("nope")
+
+
+def test_evaluate_slo_unmeasured_and_scrape_errors():
+    from crdt_tpu.obs import evaluate_slo
+    v = evaluate_slo({})
+    assert all(c["ok"] is None for c in v["checks"].values())
+    assert v["ok"] is False                    # nothing measured
+    v2 = evaluate_slo({"a": {"_scrape_error": "ConnectionError: x"}})
+    assert v2["scrape_errors"] == ["a"] and v2["ok"] is False
+
+
+def test_render_federation_series():
+    from crdt_tpu.obs.fleet import render_federation
+    snaps = {"a": {"canary": {"origin": 0, "n_origins": 1,
+                              "base_slot": 31,
+                              "observed": {"0": 5000}}},
+             "down": {"_scrape_error": "refused"}}
+    text = render_federation(snaps)
+    assert 'crdt_tpu_fleet_up{instance="a"} 1' in text
+    assert 'crdt_tpu_fleet_up{instance="down"} 0' in text
+    assert ('crdt_tpu_canary_lag_seconds{observer="a",origin="0"} 0'
+            in text)
+
+
+def test_fleet_poller_end_to_end():
+    """Two live GossipNodes with canary probes: the fleet poller
+    scrapes the real metrics wire op into a complete matrix, and
+    ``python -m crdt_tpu.obs fleet --once --json`` gates on it."""
+    from crdt_tpu.obs.cli import main as obs_main
+    from crdt_tpu.obs.fleet import lag_matrix, poll_fleet
+    clk = FakeClock()
+    a = _node(DenseCrdt("fleet-a", 32, wall_clock=clk))
+    b = _node(DenseCrdt("fleet-b", 32, wall_clock=clk))
+    with a, b:
+        a.enable_canary(0, 2)
+        b.enable_canary(1, 2)
+        a.add_peer("b", b.host, b.port)
+        b.add_peer("a", a.host, a.port)
+        for _ in range(2):                     # beats cross both ways
+            assert a.run_round() == {"b": "ok"}
+            assert b.run_round() == {"a": "ok"}
+        peers = [("a", a.host, a.port), ("b", b.host, b.port)]
+        snaps = poll_fleet(peers)
+        m = lag_matrix(snaps)
+        assert m["origins"] == ["0", "1"]
+        assert m["observers"] == ["a", "b"]
+        assert m["complete"], m
+        assert m["origin_peers"] == {"0": "a", "1": "b"}
+
+        out = io.StringIO()
+        spec = f"a={a.host}:{a.port},b={b.host}:{b.port}"
+        rc = obs_main(["fleet", "--peers", spec, "--once", "--json",
+                       "--lag-budget", "1e9"], out=out)
+        doc = json.loads(out.getvalue())
+        assert doc["matrix"]["complete"] is True
+        assert doc["slo"]["checks"]["convergence_lag_s"]["ok"] is True
+        assert rc == 0
+
+
+def test_fleet_poller_marks_unreachable_peer():
+    import socket
+    from crdt_tpu.obs.fleet import poll_fleet
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+    snaps = poll_fleet([("dead", "127.0.0.1", port)], timeout=2.0)
+    assert "_scrape_error" in snaps["dead"]
+
+
+# ------------------------- fleet plane: cross-replica trace rounds
+
+
+def test_trace_round_ids_correlate_across_wire():
+    """Initiator sync span and responder merge span carry the SAME
+    round id — both ends live in this process, so both land in the
+    one ring."""
+    from crdt_tpu.net import (PeerConnection, SyncServer,
+                              sync_packed_over_conn)
+    a = DenseCrdt("tr-a", 32, wall_clock=FakeClock())
+    b = DenseCrdt("tr-b", 32, wall_clock=FakeClock())
+    a.put_batch([1, 2], [10, 20])
+    ring = tracer()
+    ring.enable()
+    ring.clear()
+    try:
+        with SyncServer(b) as server:
+            with PeerConnection(server.host, server.port,
+                                timeout=5.0) as conn:
+                sync_packed_over_conn(a, conn, since=None)
+        (sync_span,) = [e for e in ring.events("sync")
+                        if e.get("span") == "sync_packed"]
+        rid = sync_span["rid"]
+        assert rid.startswith("tr-a.r")
+        recv = [e for e in ring.events("sync_recv")
+                if e.get("rid") == rid]
+        assert recv and recv[0]["origin"] == "tr-a"
+        assert recv[0]["span"] == "push_packed_recv"
+        assert "hlc_hi" in recv[0]
+        # the responder's wire_frame events carry the rid too
+        framed = [e for e in ring.events("wire_frame")
+                  if e.get("rid") == rid]
+        assert framed
+    finally:
+        ring.disable()
+        ring.clear()
+
+
+def test_in_process_sync_spans_carry_round_ids():
+    from crdt_tpu.sync import sync_merkle
+    a = DenseCrdt("ip-a", 64, wall_clock=FakeClock())
+    b = DenseCrdt("ip-b", 64, wall_clock=FakeClock())
+    a.put_batch([3], [30])
+    ring = tracer()
+    ring.enable()
+    ring.clear()
+    try:
+        sync_merkle(a, b)
+        (e,) = [e for e in ring.events("sync")
+                if e.get("span") == "sync_merkle"]
+        assert e["rid"].startswith("ip-a.r")
+        assert e["peer"] == "ip-b"
+    finally:
+        ring.disable()
+        ring.clear()

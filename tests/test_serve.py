@@ -213,7 +213,7 @@ def test_hello_negotiates_full_caps():
         with PeerConnection(tier.host, tier.port, timeout=5.0) as conn:
             conn.ensure()
             assert {"zlib", "packed", "semantics",
-                    "merkle"} <= conn.caps
+                    "merkle", "trace"} <= conn.caps
             assert conn.codec is not None
 
 
@@ -324,3 +324,75 @@ def test_metrics_op_reports_serve_instruments():
     assert "crdt_tpu_serve_ops_total" in snap["counters"]
     assert "crdt_tpu_serve_ack_seconds" in snap["histograms"]
     assert "crdt_tpu_serve_flush_seconds" in snap["histograms"]
+
+
+# --- ack attribution (PR 11): queue_wait / stamp / scatter / ack_write ---
+
+def test_ack_phase_attribution_sums_to_ack():
+    """Every acked write decomposes into queue_wait + stamp + scatter
+    + ack_write; the phase-histogram sums must reconstruct the ack
+    histogram's sum (per-write observation, shared tick legs)."""
+    crdt = DenseCrdt("phase-a", n_slots=64)
+    node = str(crdt.node_id)
+    reg = default_registry()
+    ack = reg.histogram("crdt_tpu_serve_ack_seconds")
+    phase = reg.histogram("crdt_tpu_serve_ack_phase_seconds")
+
+    def _sum(h, **labels):
+        return sum(s["sum"] for s in h.samples()
+                   if all(s["labels"].get(k) == v
+                          for k, v in labels.items()))
+
+    ack0 = _sum(ack, node=node)
+    with ServeTier(crdt, flush_interval=0.002) as tier:
+        with _connect(tier) as sock:
+            for i in range(20):
+                assert _req(sock, {"op": "put", "slot": i,
+                                   "value": i})["ok"] is True
+            send_frame(sock, {"op": "bye"})
+    ack_sum = _sum(ack, node=node) - ack0
+    phases = {p: _sum(phase, node=node, phase=p)
+              for p in ("queue_wait", "stamp", "scatter", "ack_write")}
+    counts = {p: sum(s["count"] for s in phase.samples()
+                     if s["labels"] == {"node": node, "phase": p})
+              for p in ("queue_wait", "stamp", "scatter", "ack_write")}
+    # one observation per phase per acked write
+    assert counts["queue_wait"] == 20
+    assert counts == {p: 20 for p in counts}
+    assert phases["stamp"] > 0 and phases["scatter"] > 0
+    total = sum(phases.values())
+    assert total == pytest.approx(ack_sum, rel=0.10), \
+        (phases, ack_sum)
+
+
+def test_rejected_tick_observes_ack_but_not_phases():
+    """A failed tick still acks (with the rejection) but attributes
+    nothing — phase sums must only ever cover committed writes."""
+    crdt = DenseCrdt("phase-r", n_slots=64)
+    node = str(crdt.node_id)
+    reg = default_registry()
+    phase = reg.histogram("crdt_tpu_serve_ack_phase_seconds")
+
+    def _count(**labels):
+        return sum(s["count"] for s in phase.samples()
+                   if all(s["labels"].get(k) == v
+                          for k, v in labels.items()))
+
+    before = _count(node=node)
+    with ServeTier(crdt, flush_interval=0.002) as tier:
+        # an in-range slot whose value overflows int64 passes the
+        # session-side guard shape but np.fromiter(int64) rejects the
+        # WHOLE tick
+        import crdt_tpu.serve as serve_mod
+        orig = serve_mod._value_ok
+        serve_mod._value_ok = lambda v: True
+        try:
+            with _connect(tier) as sock:
+                reply = _req(sock, {"op": "put", "slot": 1,
+                                    "value": 1 << 80})
+                assert reply["ok"] is False
+                assert reply["code"] == "write_rejected"
+                send_frame(sock, {"op": "bye"})
+        finally:
+            serve_mod._value_ok = orig
+    assert _count(node=node) == before
