@@ -1,0 +1,180 @@
+"""One-shot backfill: fold the historical result files into the bench
+trajectory (``benchmarks/history/trajectory.jsonl``).
+
+The repo's first eleven PRs left results in four incompatible shapes:
+
+- ``BENCH_r01..r06.json`` — driver wrapper dicts
+  ``{n, cmd, rc, tail, parsed:{metric, value, ...}}``;
+- ``BENCH_r07/r08.json`` — LISTS of those wrappers (multi-mode runs);
+- ``BENCH_r09.json``, ``SERVE_r01.json``, ``MULTICHIP_SCALE_*.json``
+  — raw result dicts straight off the bench's JSON line;
+- ``MULTICHIP_r0*.json`` — validate-on-chip wrappers whose payload
+  (when the run survived) is JSON lines inside ``tail``;
+- ``SUITE_r0*.json`` — multi-line JSONL, one metric dict per line.
+
+Each becomes one normalized trajectory record
+(:func:`crdt_tpu.obs.trajectory.normalize_record`): ``run_id`` from
+the source filename (stable and idempotent — re-running skips ids
+already in the output), ``git_sha`` "unknown" (the files predate the
+schema and carry no sha), ``host_class`` from the recorded platform
+(coarse historical classes like ``tpu`` / ``cpu`` / ``multichip8`` —
+deliberately never equal to a live `host_class()` string, so history
+informs trends but can never serve as a floor for a fresh run on
+different hardware).
+
+Usage::
+
+    python benchmarks/backfill_trajectory.py            # repo root
+    python benchmarks/backfill_trajectory.py --out PATH --src DIR
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+from crdt_tpu.obs.trajectory import (TRAJECTORY_PATH, append_record,
+                                     load_trajectory, normalize_record)
+
+#: metric-name → bench.py mode. Metrics with no entry keep their own
+#: name as the mode — still one comparable group per metric family.
+_MODE_BY_METRIC = {
+    "e2e_sync": "sync",
+    "ingest_fast_lane": "ingest",
+    "typed_merges_per_sec_1024_slots": "types",
+    "merkle_antientropy_soak": "antientropy",
+    "serve_open_loop": "serve",
+}
+
+
+def _mode_for(metric: str) -> str:
+    if metric in _MODE_BY_METRIC:
+        return _MODE_BY_METRIC[metric]
+    if metric.startswith("record_merges_per_sec"):
+        return "stream"
+    if metric.startswith("oracle_"):
+        return "oracle"
+    if metric.startswith("tpu_backend_"):
+        return "tpu-backend"
+    return metric
+
+
+def _json_lines(text: str):
+    """Every parseable JSON object in a blob of output lines —
+    the validate-on-chip wrappers bury their payload in ``tail``."""
+    out = []
+    for line in text.splitlines():
+        line = line.strip()
+        if not (line.startswith("{") and line.endswith("}")):
+            continue
+        try:
+            obj = json.loads(line)
+        except ValueError:
+            continue
+        if isinstance(obj, dict):
+            out.append(obj)
+    return out
+
+
+def _rec(run_id, metric, result, platform, source):
+    return normalize_record(
+        _mode_for(metric or "unknown"), result, run_id=run_id,
+        sha="unknown", host=str(platform or "unknown"), smoke=False,
+        source=source)
+
+
+def records_from(path: str):
+    """Normalized records for ONE historical file (see module
+    docstring for the shapes)."""
+    stem = os.path.splitext(os.path.basename(path))[0]
+    with open(path) as f:
+        text = f.read()
+    if stem.startswith("SUITE_"):
+        data = None  # multi-line JSONL, parsed per line below
+    else:
+        data = json.loads(text)
+    out = []
+
+    def from_wrapper(w, run_id):
+        parsed = w.get("parsed")
+        if not isinstance(parsed, dict):
+            parsed = {"rc": w.get("rc")}
+        result = dict(parsed)
+        if w.get("rc") is not None:
+            result.setdefault("rc", w["rc"])
+        out.append(_rec(run_id, parsed.get("metric"), result,
+                        parsed.get("platform"), stem))
+
+    if stem.startswith("BENCH_"):
+        if isinstance(data, list):
+            for i, w in enumerate(data):
+                if isinstance(w, dict) and "parsed" in w:
+                    from_wrapper(w, f"{stem.lower()}-{i:02d}")
+                elif isinstance(w, dict):
+                    out.append(_rec(f"{stem.lower()}-{i:02d}",
+                                    w.get("metric"), w,
+                                    w.get("platform"), stem))
+        elif isinstance(data, dict) and "parsed" in data:
+            from_wrapper(data, stem.lower())
+        elif isinstance(data, dict):
+            out.append(_rec(stem.lower(), data.get("metric"), data,
+                            data.get("platform"), stem))
+    elif stem.startswith("SERVE_"):
+        out.append(_rec(stem.lower(), data.get("metric"), data,
+                        data.get("platform"), stem))
+    elif stem.startswith("MULTICHIP_SCALE_"):
+        host = f"multichip{data.get('n_devices', 0)}"
+        out.append(_rec(stem.lower(), "multichip_scale", data, host,
+                        stem))
+    elif stem.startswith("MULTICHIP_"):
+        host = f"multichip{data.get('n_devices', 0)}"
+        payload = {"rc": data.get("rc"),
+                   "n_devices": data.get("n_devices")}
+        for obj in _json_lines(data.get("tail", "")):
+            payload.update(obj)
+        out.append(_rec(stem.lower(), "multichip_validate", payload,
+                        host, stem))
+    elif stem.startswith("SUITE_"):
+        for i, obj in enumerate(_json_lines(text)):
+            out.append(_rec(f"{stem.lower()}-{i:02d}",
+                            obj.get("metric"), obj,
+                            obj.get("platform"), stem))
+    return out
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="fold historical BENCH/SERVE/MULTICHIP/SUITE "
+                    "result files into the bench trajectory")
+    ap.add_argument("--src", default=".",
+                    help="directory holding the historical files")
+    ap.add_argument("--out", default=TRAJECTORY_PATH)
+    args = ap.parse_args(argv)
+
+    have = {r.get("run_id") for r in load_trajectory(args.out)}
+    paths = []
+    for pat in ("BENCH_r*.json", "SERVE_r*.json", "MULTICHIP_r*.json",
+                "MULTICHIP_SCALE_r*.json", "SUITE_r*.json"):
+        paths.extend(glob.glob(os.path.join(args.src, pat)))
+    added = skipped = 0
+    for path in sorted(set(paths)):
+        for rec in records_from(path):
+            if rec["run_id"] in have:
+                skipped += 1
+                continue
+            append_record(rec, args.out)
+            have.add(rec["run_id"])
+            added += 1
+    print(f"backfill: {added} record(s) added, {skipped} skipped "
+          f"(already present) -> {args.out}")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

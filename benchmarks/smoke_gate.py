@@ -1,0 +1,74 @@
+"""CI smoke-bench regression gate.
+
+Runs one fast bench (default ``bench.py --mode sync --smoke``) — which
+appends a normalized record to the trajectory — then verdicts that
+record against the fastest-of-N floors of its ``(mode, host_class,
+smoke)`` group via the same code path as
+``python -m crdt_tpu.obs bench --compare``.
+
+Exit code is the verdict's, unchanged:
+
+- ``0`` — every measured metric within its noise budget;
+- ``1`` — regression (some metric outside budget);
+- ``2`` — nothing comparable: first run on this host class, or the
+  series is empty. Deliberately NOT success (unmeasured != passed);
+  CI that wants to bootstrap a fresh host seeds the baseline with one
+  accepted run and keeps 2 as failure thereafter.
+
+Usage::
+
+    python benchmarks/smoke_gate.py                 # sync smoke gate
+    python benchmarks/smoke_gate.py --mode ingest
+    python benchmarks/smoke_gate.py --trajectory /tmp/t.jsonl --budget 0.4
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import subprocess
+import sys
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _REPO)
+
+from crdt_tpu.obs.trajectory import TRAJECTORY_PATH, bench_main
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="run a smoke bench and gate it against the "
+                    "trajectory floors")
+    ap.add_argument("--mode", default="sync",
+                    help="bench.py mode to run (default sync)")
+    ap.add_argument("--trajectory", default=TRAJECTORY_PATH,
+                    help="trajectory jsonl to append to and gate "
+                         "against")
+    ap.add_argument("--budget", type=float, default=None,
+                    help="per-metric noise budget fraction override")
+    ap.add_argument("--pool", type=int, default=None,
+                    help="fastest-of-N baseline pool override")
+    ap.add_argument("--full", action="store_true",
+                    help="run the full-size bench instead of --smoke")
+    args = ap.parse_args(argv)
+
+    cmd = [sys.executable, os.path.join(_REPO, "bench.py"),
+           "--mode", args.mode, "--trajectory", args.trajectory]
+    if not args.full:
+        cmd.append("--smoke")
+    rc = subprocess.run(cmd, cwd=_REPO).returncode
+    if rc != 0:
+        print(f"smoke_gate: bench run failed (rc={rc})",
+              file=sys.stderr)
+        return rc
+
+    gate_args = ["--compare", args.trajectory]
+    if args.budget is not None:
+        gate_args += ["--budget", str(args.budget)]
+    if args.pool is not None:
+        gate_args += ["--pool", str(args.pool)]
+    return bench_main(gate_args)
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -130,6 +130,66 @@ def _merkle_completeness(target_names) -> List:
     return out
 
 
+# Device entry points the default run must find registered with the
+# dispatch ledger (obs.device): every jit/shard_map program a model or
+# transport path can dispatch. An uninstrumented kernel is invisible to
+# the compile census and breaks the zero-dispatch invariant probes
+# (docs/OBSERVABILITY.md, device plane).
+_LEDGER_REQUIRED = (
+    # ops/dense.py — XLA executors, scatters, pack masks
+    "dense.fanin_step", "dense.fanin_stream", "dense.sparse_fanin_step",
+    "dense.wire_join_step", "dense.merge_repack_step",
+    "dense.delta_mask", "dense.range_delta_mask",
+    "dense.max_logical_time", "dense.put_scatter",
+    "dense.record_scatter", "dense.delete_scatter",
+    "dense.ingest_scatter",
+    # ops/digest.py — the merkle reduction
+    "digest.digest_tree_device",
+    # ops/pallas_scatter.py + ops/pallas_merge.py — Mosaic routes
+    "pallas.ingest_scatter_tiles",
+    "pallas.model_fanin_batch", "pallas.model_fanin_split",
+    "pallas.pipelined_model_step", "pallas.pipelined_model_step_split",
+    # semantics/kernels.py — the typed fan-in family
+    "semantics.typed_wire_join_step", "semantics.typed_sparse_join_step",
+    "semantics.typed_fanin_step",
+    # parallel/fanin.py — shard_map programs
+    "parallel.sharded_fanin", "parallel.sharded_pallas_fanin",
+    "parallel.sharded_ingest", "parallel.sharded_digest",
+    "parallel.sharded_delta_mask", "parallel.sharded_max_logical_time",
+)
+
+
+def _ledger_completeness(registered=None) -> List:
+    """The dispatch-ledger CI gate: every device entry point must have
+    declared itself to the ledger at module import — an uninstrumented
+    kernel dispatches invisibly and fails the default run."""
+    from .findings import Finding
+    if registered is None:
+        # Importing the instrumented modules runs their register()
+        # calls; nothing is dispatched.
+        from .. import parallel  # noqa: F401
+        from ..obs.device import default_ledger
+        from ..ops import (dense, digest, pallas_merge,  # noqa: F401
+                           pallas_scatter)
+        from ..semantics import kernels  # noqa: F401
+        registered = default_ledger().registered_kernels()
+    names = set(registered)
+    out = []
+    for req in _LEDGER_REQUIRED:
+        if req not in names:
+            out.append(Finding(
+                rule="dispatch-ledger-unregistered",
+                path="crdt_tpu/obs/device.py", line=0,
+                message=f"device entry point {req!r} is not "
+                        "registered with the dispatch ledger",
+                detail="instrument its host wrapper with "
+                       "obs.device.record(...) and register the name "
+                       "at module import so dispatch counts, the "
+                       "compile census and the zero-dispatch probes "
+                       "cover it (docs/OBSERVABILITY.md)"))
+    return out
+
+
 def main(argv=None) -> int:
     parser = argparse.ArgumentParser(
         prog="python -m crdt_tpu.analysis",
@@ -198,6 +258,7 @@ def main(argv=None) -> int:
             names = tuple(t.name for t in targets)
             findings.extend(_fastpath_completeness(names))
             findings.extend(_merkle_completeness(names))
+            findings.extend(_ledger_completeness())
             reports, audit_findings = audit_all(targets)
             findings.extend(audit_findings)
 

@@ -254,6 +254,10 @@ class WriteCombiner:
         dt = time.perf_counter() - t0
         self.last_phase_seconds = {"stamp": t_stamp - t0,
                                    "scatter": t_scatter - t_stamp}
+        # Store-bytes census: lane nbytes is array metadata, no device
+        # work — the commit is where the store's footprint last moved.
+        from ..obs import device as _obs_device
+        _obs_device.census(owner._store)
         flushes_c, rows_c, groups_c, seconds_h = _metrics()
         flushes_c.inc(trigger=trigger, node=node)
         rows_c.inc(d, node=node)

@@ -32,6 +32,17 @@ machine-readable SLO verdict (``python -m crdt_tpu.obs fleet``); the
 so initiator sync spans and responder merge spans correlate in one
 JSONL sink.
 
+Device plane (PR 12): :mod:`~crdt_tpu.obs.device` is the **dispatch
+ledger** — every jit-cached device entry point (ops + parallel)
+reports per-kernel dispatch counts, wall-time histograms, a
+(kernel, pow2-bucket) compile census, donation-violation checks, and
+a store-bytes gauge, turning the fast-path zero-dispatch invariants
+into runtime-observable metrics; :mod:`~crdt_tpu.obs.trajectory`
+normalizes every ``bench.py`` run into one
+``benchmarks/history/trajectory.jsonl`` record and verdicts the
+newest run against fastest-of-N floors (``python -m crdt_tpu.obs
+bench --compare``), the CI regression gate.
+
 Exposition: :func:`~crdt_tpu.obs.render.render_prometheus` renders a
 snapshot as Prometheus text; ``python -m crdt_tpu.obs`` polls a live
 node's ``metrics`` op or summarizes a trace JSONL into a per-phase
@@ -47,6 +58,9 @@ from .lag import health_status, lag_entry, lag_millis
 from .probe import CanaryProbe, canary_observed
 from .fleet import (evaluate_slo, format_matrix, lag_matrix,
                     poll_fleet, render_federation)
+from .device import DispatchLedger, default_ledger, pow2_bucket
+from .trajectory import (append_record, compare, load_trajectory,
+                         normalize_record)
 from .render import (format_phase_table, render_prometheus,
                      render_summary, summarize_trace)
 
@@ -65,6 +79,8 @@ __all__ = [
     "CanaryProbe", "canary_observed",
     "poll_fleet", "lag_matrix", "evaluate_slo", "render_federation",
     "format_matrix",
+    "DispatchLedger", "default_ledger", "pow2_bucket",
+    "normalize_record", "append_record", "load_trajectory", "compare",
     "render_prometheus", "render_summary", "summarize_trace",
     "format_phase_table",
 ]

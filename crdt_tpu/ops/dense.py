@@ -50,9 +50,22 @@ import jax
 import jax.numpy as jnp
 
 from .merge import recv_guards
+from ..obs import device as _obs_device
 
 _NEG = -(2 ** 62)
 _I32_NEG = -(2 ** 31)
+
+# Dispatch-ledger registration (docs/OBSERVABILITY.md device plane):
+# every host wrapper below reports its device dispatches; declaring the
+# names at import time is what the crdtlint
+# `dispatch-ledger-unregistered` gate verifies.
+_obs_device.register(
+    "dense.fanin_step", "dense.fanin_stream", "dense.sparse_fanin_step",
+    "dense.wire_join_step", "dense.merge_repack_step",
+    "dense.delta_mask", "dense.range_delta_mask",
+    "dense.max_logical_time", "dense.put_scatter",
+    "dense.record_scatter", "dense.delete_scatter",
+    "dense.ingest_scatter")
 
 
 class DenseStore(NamedTuple):
@@ -163,18 +176,11 @@ def reduce_replicas(cs: DenseChangeset) -> Tuple[jax.Array, jax.Array,
 
 
 @jax.jit
-def fanin_step(store: DenseStore, cs: DenseChangeset,
-               canonical_lt: jax.Array, local_node: jax.Array,
-               wall_millis: jax.Array,
-               stamp_lt: Optional[jax.Array] = None
-               ) -> Tuple[DenseStore, FaninResult]:
-    """One fused R-replica fan-in lattice join. See module docstring.
-
-    ``stamp_lt`` overrides the ``modified`` stamp for winners (default:
-    this step's post-absorption canonical). Streaming executors pass the
-    whole stream's final canonical so chunked execution stays
-    bit-identical to the one-shot join (crdt.dart:86-87 stamps winners
-    with the canonical AFTER all records were absorbed)."""
+def _fanin_step_jit(store: DenseStore, cs: DenseChangeset,
+                    canonical_lt: jax.Array, local_node: jax.Array,
+                    wall_millis: jax.Array,
+                    stamp_lt: Optional[jax.Array] = None
+                    ) -> Tuple[DenseStore, FaninResult]:
     any_bad, first_bad, first_is_dup, canonical_at_fail = recv_guards(
         cs.lt, cs.node, cs.valid, canonical_lt, local_node, wall_millis)
 
@@ -211,12 +217,29 @@ def fanin_step(store: DenseStore, cs: DenseChangeset,
     )
 
 
+def fanin_step(store: DenseStore, cs: DenseChangeset,
+               canonical_lt: jax.Array, local_node: jax.Array,
+               wall_millis: jax.Array,
+               stamp_lt: Optional[jax.Array] = None
+               ) -> Tuple[DenseStore, FaninResult]:
+    """One fused R-replica fan-in lattice join. See module docstring.
+
+    ``stamp_lt`` overrides the ``modified`` stamp for winners (default:
+    this step's post-absorption canonical). Streaming executors pass the
+    whole stream's final canonical so chunked execution stays
+    bit-identical to the one-shot join (crdt.dart:86-87 stamps winners
+    with the canonical AFTER all records were absorbed)."""
+    with _obs_device.record("dense.fanin_step", dim=cs.lt.shape[0]):
+        return _fanin_step_jit(store, cs, canonical_lt, local_node,
+                               wall_millis, stamp_lt)
+
+
 @jax.jit
-def fanin_stream(store: DenseStore, chunks: DenseChangeset,
-                 canonical_lt: jax.Array, local_node: jax.Array,
-                 wall_millis: jax.Array,
-                 stamp_lt: Optional[jax.Array] = None
-                 ) -> Tuple[DenseStore, FaninResult]:
+def _fanin_stream_jit(store: DenseStore, chunks: DenseChangeset,
+                      canonical_lt: jax.Array, local_node: jax.Array,
+                      wall_millis: jax.Array,
+                      stamp_lt: Optional[jax.Array] = None
+                      ) -> Tuple[DenseStore, FaninResult]:
     """Streaming fan-in over [C, Rc, N] chunked changesets via lax.scan.
 
     Replica counts too large for one resident [R, N] batch stream
@@ -233,8 +256,8 @@ def fanin_stream(store: DenseStore, chunks: DenseChangeset,
 
     def step(carry, chunk):
         st, canon, offset, bad, fb, fd, caf, wins, winm = carry
-        st2, res = fanin_step(st, chunk, canon, local_node, wall_millis,
-                              stamp_lt)
+        st2, res = _fanin_step_jit(st, chunk, canon, local_node,
+                                   wall_millis, stamp_lt)
         # Keep the FIRST failure's diagnostics across chunks; first_bad
         # is reported as a GLOBAL flat r-major index across the whole
         # stream — int64: C*Rc*N exceeds int32 at exactly the scales
@@ -263,6 +286,20 @@ def fanin_stream(store: DenseStore, chunks: DenseChangeset,
     return st, FaninResult(new_canonical=canon, win_count=win_count,
                            win=winm, any_bad=bad, first_bad=fb,
                            first_is_dup=fd, canonical_at_fail=caf)
+
+
+def fanin_stream(store: DenseStore, chunks: DenseChangeset,
+                 canonical_lt: jax.Array, local_node: jax.Array,
+                 wall_millis: jax.Array,
+                 stamp_lt: Optional[jax.Array] = None
+                 ) -> Tuple[DenseStore, FaninResult]:
+    """See `_fanin_stream_jit` — this host wrapper only adds the
+    dispatch-ledger record (one dispatch per whole stream; the chunks
+    scan inside it is a single program)."""
+    with _obs_device.record("dense.fanin_stream",
+                            dim=chunks.lt.shape[0] * chunks.lt.shape[1]):
+        return _fanin_stream_jit(store, chunks, canonical_lt,
+                                 local_node, wall_millis, stamp_lt)
 
 
 def _sparse_fanin_body(store: DenseStore, slot: jax.Array,
@@ -371,8 +408,12 @@ def sparse_fanin_step(store: DenseStore, slot: jax.Array, lt: jax.Array,
     store buffers to XLA (caller must not reuse them); ``sharding``
     pins the output layout. Returns ``(new_store, win)`` with ``win``
     over the k entries."""
-    return _sparse_fanin_jit(donate, sharding)(
-        store, slot, lt, node, val, tomb, valid, stamp_lt, local_node)
+    with _obs_device.record("dense.sparse_fanin_step",
+                            dim=slot.shape[0],
+                            donated=store.lt if donate else None):
+        return _sparse_fanin_jit(donate, sharding)(
+            store, slot, lt, node, val, tomb, valid, stamp_lt,
+            local_node)
 
 
 def wire_join_step(store: DenseStore, lt: jax.Array, node: jax.Array,
@@ -394,8 +435,10 @@ def wire_join_step(store: DenseStore, lt: jax.Array, node: jax.Array,
     without touching the compare semantics. ``donate``/``sharding``
     follow `sparse_fanin_step`. Returns ``(new_store, win)`` with
     ``win`` over the N slots."""
-    return _wire_join_jit(donate, sharding)(
-        store, lt, node, val, tomb, valid, stamp_lt, local_node)
+    with _obs_device.record("dense.wire_join_step", dim=lt.shape[0],
+                            donated=store.lt if donate else None):
+        return _wire_join_jit(donate, sharding)(
+            store, lt, node, val, tomb, valid, stamp_lt, local_node)
 
 
 @_ft.lru_cache(maxsize=None)
@@ -428,16 +471,24 @@ def merge_repack_step(store: DenseStore, slot: jax.Array, lt: jax.Array,
     ``since_lt`` is the watermark the next outbound pack will be
     bounded by (inclusive, map_crdt.dart:44-45). Returns
     ``(new_store, win, mask)`` with ``mask`` over the N slots."""
-    return _merge_repack_jit(donate, sharding)(
-        store, slot, lt, node, val, tomb, valid, stamp_lt, local_node,
-        since_lt)
+    with _obs_device.record("dense.merge_repack_step",
+                            dim=slot.shape[0],
+                            donated=store.lt if donate else None):
+        return _merge_repack_jit(donate, sharding)(
+            store, slot, lt, node, val, tomb, valid, stamp_lt,
+            local_node, since_lt)
 
 
 @jax.jit
+def _delta_mask_jit(store: DenseStore, since_lt: jax.Array) -> jax.Array:
+    return store.occupied & (store.mod_lt >= since_lt)
+
+
 def dense_delta_mask(store: DenseStore, since_lt: jax.Array) -> jax.Array:
     """modifiedSince filter — INCLUSIVE bound on the modified lane
     (map_crdt.dart:44-45)."""
-    return store.occupied & (store.mod_lt >= since_lt)
+    with _obs_device.record("dense.delta_mask", dim=store.lt.shape[0]):
+        return _delta_mask_jit(store, since_lt)
 
 
 @_ft.lru_cache(maxsize=None)
@@ -463,13 +514,21 @@ def dense_range_delta_mask(store: DenseStore, since_lt: jax.Array,
     spans so the jit cache sees O(log) distinct shapes. Pass
     ``since_lt = 0`` for a clock-unbounded range scan (every occupied
     slot has ``mod_lt > 0``, so 0 never filters)."""
-    return _range_mask_jit()(store, since_lt, los, his)
+    with _obs_device.record("dense.range_delta_mask",
+                            dim=los.shape[0]):
+        return _range_mask_jit()(store, since_lt, los, his)
 
 
 @jax.jit
+def _max_logical_time_jit(store: DenseStore) -> jax.Array:
+    return jnp.max(jnp.where(store.occupied, store.lt, 0))
+
+
 def dense_max_logical_time(store: DenseStore) -> jax.Array:
     """refreshCanonicalTime's reduction (crdt.dart:114-121)."""
-    return jnp.max(jnp.where(store.occupied, store.lt, 0))
+    with _obs_device.record("dense.max_logical_time",
+                            dim=store.lt.shape[0]):
+        return _max_logical_time_jit(store)
 
 
 def pad_replica_rows(cs: DenseChangeset, multiple: int) -> DenseChangeset:
@@ -577,8 +636,10 @@ def put_scatter(store: DenseStore, slots, values, t, me, tombs=None,
     stamp (a mixed putAll, crdt.dart:46-54 + delete-as-put-None)."""
     if tombs is None:
         tombs = jnp.zeros(values.shape, bool)
-    return _put_scatter(donate, sharding)(store, slots, values, tombs,
-                                          t, me)
+    with _obs_device.record("dense.put_scatter", dim=slots.shape[0],
+                            donated=store.lt if donate else None):
+        return _put_scatter(donate, sharding)(store, slots, values,
+                                              tombs, t, me)
 
 
 def record_scatter(store: DenseStore, slots, lt, node, val, mod_lt,
@@ -587,15 +648,19 @@ def record_scatter(store: DenseStore, slots, lt, node, val, mod_lt,
     """Raw record writes preserving the given hlc/modified stamps —
     the putRecords storage primitive (crdt.dart:151-155): stores
     records verbatim, no LWW compare, no clock involvement."""
-    return _record_scatter(donate, sharding)(store, slots, lt, node,
-                                             val, mod_lt, mod_node,
-                                             tomb)
+    with _obs_device.record("dense.record_scatter", dim=slots.shape[0],
+                            donated=store.lt if donate else None):
+        return _record_scatter(donate, sharding)(store, slots, lt,
+                                                 node, val, mod_lt,
+                                                 mod_node, tomb)
 
 
 def delete_scatter(store: DenseStore, slots, t, me,
                    donate: bool = False, sharding=None) -> DenseStore:
     """Batch tombstone: scatter one shared HLC at ``slots``."""
-    return _delete_scatter(donate, sharding)(store, slots, t, me)
+    with _obs_device.record("dense.delete_scatter", dim=slots.shape[0],
+                            donated=store.lt if donate else None):
+        return _delete_scatter(donate, sharding)(store, slots, t, me)
 
 
 @_functools.lru_cache(maxsize=None)
@@ -629,5 +694,7 @@ def ingest_scatter(store: DenseStore, slots, lt, val, tomb, me,
     ships 4 lanes per flush instead of `record_scatter`'s 7. One jit
     per (donate, sharding) pair; ``sharding`` pins the output store's
     NamedSharding so sharded commits land rows shard-locally."""
-    return _ingest_scatter(donate, sharding)(store, slots, lt, val,
-                                             tomb, me)
+    with _obs_device.record("dense.ingest_scatter", dim=slots.shape[0],
+                            donated=store.lt if donate else None):
+        return _ingest_scatter(donate, sharding)(store, slots, lt, val,
+                                                 tomb, me)

@@ -37,6 +37,9 @@ import jax.numpy as jnp
 import numpy as np
 
 from .dense import DenseStore
+from ..obs import device as _obs_device
+
+_obs_device.register("digest.digest_tree_device")
 
 #: Slots folded into one leaf digest. The width trades walk traffic
 #: against re-ship amplification: a divergent slot re-ships its whole
@@ -166,9 +169,11 @@ def digest_tree_device(store: DenseStore, sem=None,
     """Digest-tree levels (root-first) for a dense store, computed on
     device. ``sem`` is the optional per-slot semantics tag column."""
     args = (store.lt, store.val, store.tomb, store.occupied)
-    if sem is not None:
-        return _digest_tree_jit(leaf_width, True)(*args, sem)
-    return _digest_tree_jit(leaf_width, False)(*args)
+    with _obs_device.record("digest.digest_tree_device",
+                            dim=store.lt.shape[0]):
+        if sem is not None:
+            return _digest_tree_jit(leaf_width, True)(*args, sem)
+        return _digest_tree_jit(leaf_width, False)(*args)
 
 
 class DigestTree(NamedTuple):

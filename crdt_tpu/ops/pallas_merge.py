@@ -43,7 +43,12 @@ from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
 from ..hlc import MAX_COUNTER, MAX_DRIFT, SHIFT
+from ..obs import device as _obs_device
 from .dense import DenseChangeset, DenseStore, _NEG
+
+_obs_device.register(
+    "pallas.model_fanin_batch", "pallas.model_fanin_split",
+    "pallas.pipelined_model_step", "pallas.pipelined_model_step_split")
 
 # Sentinel hi word of _NEG = -(2**62): anything real compares greater.
 # Plain ints (not jnp scalars): module-level concrete arrays would
@@ -747,9 +752,10 @@ def _launch_stream_grid(exact_guards, advance_clock, store, cs,
 
 @partial(jax.jit,
          static_argnames=("chunk_rows", "interpret", "value_width"))
-def model_fanin_batch(store, cs, canonical_lt, local_node, wall_millis,
-                      *, chunk_rows: int = 16, interpret: bool = False,
-                      value_width: int = 64):
+def _model_fanin_batch_jit(store, cs, canonical_lt, local_node,
+                           wall_millis, *, chunk_rows: int = 16,
+                           interpret: bool = False,
+                           value_width: int = 64):
     """The model layer's ONE-dispatch merge: wide `DenseStore` +
     `DenseChangeset` in, wide store out — split/convert, the batch
     kernel, and the re-join all inside a single jit. On remote-proxied
@@ -776,11 +782,28 @@ def model_fanin_batch(store, cs, canonical_lt, local_node, wall_millis,
     return join_store.__wrapped__(out), res, seen, val_overflow
 
 
+def model_fanin_batch(store, cs, canonical_lt, local_node, wall_millis,
+                      **kw):
+    """Ledger-recording host entry for `_model_fanin_batch_jit` (the
+    fused model merge; see its docstring for semantics)."""
+    with _obs_device.record("pallas.model_fanin_batch",
+                            dim=cs.valid.shape[0]):
+        return _model_fanin_batch_jit(store, cs, canonical_lt,
+                                      local_node, wall_millis, **kw)
+
+
+# Trace-time composition (`pipelined_model_step`) fuses through the
+# UN-jitted body, bypassing the ledger wrapper — in-jit calls are not
+# dispatches.
+model_fanin_batch.__wrapped__ = _model_fanin_batch_jit.__wrapped__
+
+
 @partial(jax.jit,
          static_argnames=("chunk_rows", "interpret", "value_width"))
-def model_fanin_split(store, cs, node_map, canonical_lt, local_node,
-                      wall_millis, *, chunk_rows: int = 16,
-                      interpret: bool = False, value_width: int = 64):
+def _model_fanin_split_jit(store, cs, node_map, canonical_lt,
+                           local_node, wall_millis, *,
+                           chunk_rows: int = 16, interpret: bool = False,
+                           value_width: int = 64):
     """`model_fanin_batch` for a PRE-SPLIT (optionally pre-tiled)
     changeset — the zero-conversion gossip path: peers exchange the
     kernel wire form (`DenseCrdt.export_split_delta`) and the merge
@@ -823,13 +846,27 @@ def model_fanin_split(store, cs, node_map, canonical_lt, local_node,
     return join_store.__wrapped__(out), res, seen, val_overflow
 
 
+def model_fanin_split(store, cs, node_map, canonical_lt, local_node,
+                      wall_millis, **kw):
+    """Ledger-recording host entry for `_model_fanin_split_jit` (the
+    pre-split fused model merge; see its docstring for semantics)."""
+    with _obs_device.record("pallas.model_fanin_split",
+                            dim=cs.hi.shape[0]):
+        return _model_fanin_split_jit(store, cs, node_map, canonical_lt,
+                                      local_node, wall_millis, **kw)
+
+
+model_fanin_split.__wrapped__ = _model_fanin_split_jit.__wrapped__
+
+
 @partial(jax.jit,
          static_argnames=("chunk_rows", "interpret", "value_width"))
-def pipelined_model_step(store, cs, canonical, any_bad, overflow,
-                         drift, val_ovf, first_idx, local_node,
-                         wall_merge, wall_send, merge_idx, *,
-                         chunk_rows: int = 16, interpret: bool = False,
-                         value_width: int = 64):
+def _pipelined_model_step_jit(store, cs, canonical, any_bad, overflow,
+                              drift, val_ovf, first_idx, local_node,
+                              wall_merge, wall_send, merge_idx, *,
+                              chunk_rows: int = 16,
+                              interpret: bool = False,
+                              value_width: int = 64):
     """One COARSE pipelined merge as a single dispatch: the fused
     model merge (`model_fanin_batch`) plus the window bookkeeping the
     model layer otherwise runs as separate eager ops — flag
@@ -852,14 +889,26 @@ def pipelined_model_step(store, cs, canonical, any_bad, overflow,
                            val_ovf, first_idx, merge_idx, wall_send)
 
 
+def pipelined_model_step(store, cs, *args, **kw):
+    """Ledger-recording host entry for `_pipelined_model_step_jit`
+    (the coarse pipelined merge; see its docstring for semantics)."""
+    with _obs_device.record("pallas.pipelined_model_step",
+                            dim=cs.valid.shape[0]):
+        return _pipelined_model_step_jit(store, cs, *args, **kw)
+
+
+pipelined_model_step.__wrapped__ = _pipelined_model_step_jit.__wrapped__
+
+
 @partial(jax.jit,
          static_argnames=("chunk_rows", "interpret", "value_width"))
-def pipelined_model_step_split(store, cs, node_map, canonical, any_bad,
-                               overflow, drift, val_ovf, first_idx,
-                               local_node, wall_merge, wall_send,
-                               merge_idx, *, chunk_rows: int = 16,
-                               interpret: bool = False,
-                               value_width: int = 64):
+def _pipelined_model_step_split_jit(store, cs, node_map, canonical,
+                                    any_bad, overflow, drift, val_ovf,
+                                    first_idx, local_node, wall_merge,
+                                    wall_send, merge_idx, *,
+                                    chunk_rows: int = 16,
+                                    interpret: bool = False,
+                                    value_width: int = 64):
     """`pipelined_model_step` for PRE-SPLIT changesets (`merge_split`
     in a coarse window) — the interchange path gets the same
     one-dispatch treatment, else fusing only the wide path would make
@@ -871,6 +920,19 @@ def pipelined_model_step_split(store, cs, node_map, canonical, any_bad,
     return _pipelined_tail(new_store, pres, seen, voverflow,
                            value_width, any_bad, overflow, drift,
                            val_ovf, first_idx, merge_idx, wall_send)
+
+
+def pipelined_model_step_split(store, cs, *args, **kw):
+    """Ledger-recording host entry for
+    `_pipelined_model_step_split_jit` (the pre-split coarse pipelined
+    merge; see its docstring for semantics)."""
+    with _obs_device.record("pallas.pipelined_model_step_split",
+                            dim=cs.hi.shape[0]):
+        return _pipelined_model_step_split_jit(store, cs, *args, **kw)
+
+
+pipelined_model_step_split.__wrapped__ = \
+    _pipelined_model_step_split_jit.__wrapped__
 
 
 def _pipelined_tail(new_store, pres, seen, voverflow, value_width,

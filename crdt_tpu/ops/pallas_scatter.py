@@ -41,6 +41,9 @@ from jax.experimental.pallas import tpu as pltpu
 from .dense import DenseStore
 from .pallas_merge import (_LANE, _SB, TILE, SplitStore, _join64, _split64,
                            join_store, split_store)
+from ..obs import device as _obs_device
+
+_obs_device.register("pallas.ingest_scatter_tiles")
 
 
 def prepare_tile_updates(slots: np.ndarray, lt: np.ndarray,
@@ -158,7 +161,10 @@ def ingest_scatter_tiles(store: DenseStore, slots: np.ndarray,
     tile_ids, valid, lt_d, val_d, tomb_d = prepare_tile_updates(
         np.asarray(slots, np.int64), np.asarray(lt, np.int64),
         np.asarray(val, np.int64), np.asarray(tomb), store.lt.shape[0])
-    return _scatter_jit(donate, interpret)(
-        store, jnp.asarray(tile_ids), jnp.asarray(valid),
-        jnp.asarray(lt_d), jnp.asarray(val_d), jnp.asarray(tomb_d),
-        jnp.full((1,), me, jnp.int32))
+    with _obs_device.record("pallas.ingest_scatter_tiles",
+                            dim=int(tile_ids.shape[0]),
+                            donated=store.lt if donate else None):
+        return _scatter_jit(donate, interpret)(
+            store, jnp.asarray(tile_ids), jnp.asarray(valid),
+            jnp.asarray(lt_d), jnp.asarray(val_d), jnp.asarray(tomb_d),
+            jnp.full((1,), me, jnp.int32))

@@ -45,6 +45,13 @@ import functools
 
 import jax
 import jax.numpy as jnp
+
+from ..obs import device as _obs_device
+
+_obs_device.register(
+    "parallel.sharded_fanin", "parallel.sharded_pallas_fanin",
+    "parallel.sharded_ingest", "parallel.sharded_digest",
+    "parallel.sharded_delta_mask", "parallel.sharded_max_logical_time")
 try:                                     # jax >= 0.5 re-exports P
     from jax import P
 except ImportError:                      # pragma: no cover
@@ -380,7 +387,25 @@ def make_sharded_pallas_fanin(mesh: Mesh, *, chunk_rows: int = 8,
         ),
         check_vma=False,
     )
-    return jax.jit(step)
+    return _record_step("parallel.sharded_pallas_fanin", jax.jit(step))
+
+
+def _record_step(kernel: str, jitted, *, donated_store: bool = False,
+                 dim_arg: int = 0):
+    """Wrap a factory-built jitted step in a ledger-recording closure.
+    ``dim_arg`` picks the positional arg whose store/batch leading dim
+    feeds the compile census; ``donated_store`` marks arg 0's ``lt``
+    lane for post-call donation checking."""
+
+    @functools.wraps(jitted)
+    def step(*args, **kw):
+        ref = args[dim_arg]
+        dim = ref.lt.shape[0] if hasattr(ref, "lt") else ref.shape[0]
+        donated = args[0].lt if donated_store else None
+        with _obs_device.record(kernel, dim=dim, donated=donated):
+            return jitted(*args, **kw)
+
+    return step
 
 
 def make_sharded_fanin(mesh: Mesh):
@@ -410,7 +435,7 @@ def make_sharded_fanin(mesh: Mesh):
         ),
         check_vma=False,
     )
-    return jax.jit(step)
+    return _record_step("parallel.sharded_fanin", jax.jit(step))
 
 
 @functools.lru_cache(maxsize=None)
@@ -453,7 +478,10 @@ def make_sharded_ingest(mesh: Mesh, donate: bool = False):
         out_specs=DenseStore(*([P(KEY_AXIS)] * len(DenseStore._fields))),
         check_vma=False,
     )
-    return jax.jit(step, donate_argnums=(0,) if donate else ())
+    return _record_step(
+        "parallel.sharded_ingest",
+        jax.jit(step, donate_argnums=(0,) if donate else ()),
+        donated_store=donate, dim_arg=1)
 
 
 def sharded_delta_mask(mesh: Mesh):
@@ -463,13 +491,13 @@ def sharded_delta_mask(mesh: Mesh):
     def _mask(store: DenseStore, since_lt: jax.Array) -> jax.Array:
         return store.occupied & (store.mod_lt >= since_lt)
 
-    return jax.jit(_shard_map(
+    return _record_step("parallel.sharded_delta_mask", jax.jit(_shard_map(
         _mask, mesh=mesh,
         in_specs=(DenseStore(*([P(KEY_AXIS)] * len(DenseStore._fields))),
                   P()),
         out_specs=P(KEY_AXIS),
         check_vma=False,
-    ))
+    )))
 
 
 @functools.lru_cache(maxsize=None)
@@ -510,7 +538,7 @@ def make_sharded_digest(mesh: Mesh, leaf_width: int, has_sem: bool):
     def step(store: DenseStore, *sem):
         return tree_levels_from_leaves(leaves(store, *sem))
 
-    return jax.jit(step)
+    return _record_step("parallel.sharded_digest", jax.jit(step))
 
 
 def sharded_max_logical_time(mesh: Mesh):
@@ -521,9 +549,12 @@ def sharded_max_logical_time(mesh: Mesh):
         local = jnp.max(jnp.where(store.occupied, store.lt, 0))
         return jax.lax.pmax(local, mesh.axis_names)
 
-    return jax.jit(_shard_map(
-        _max, mesh=mesh,
-        in_specs=(DenseStore(*([P(KEY_AXIS)] * len(DenseStore._fields))),),
-        out_specs=P(),
-        check_vma=False,
-    ))
+    return _record_step(
+        "parallel.sharded_max_logical_time",
+        jax.jit(_shard_map(
+            _max, mesh=mesh,
+            in_specs=(DenseStore(*([P(KEY_AXIS)]
+                                   * len(DenseStore._fields))),),
+            out_specs=P(),
+            check_vma=False,
+        )))

@@ -60,9 +60,14 @@ from typing import Optional, Tuple
 import jax
 import jax.numpy as jnp
 
+from ..obs import device as _obs_device
 from ..ops.dense import (DenseStore, DenseChangeset, FaninResult,
                          _NEG)
 from ..ops.merge import recv_guards
+
+_obs_device.register(
+    "semantics.typed_wire_join_step", "semantics.typed_sparse_join_step",
+    "semantics.typed_fanin_step")
 
 # Wire tags. LWW MUST be 0: a store with no semantics column is
 # all-zeros by construction, and the packed wire form omits the sem
@@ -202,8 +207,12 @@ def typed_wire_join_step(store: DenseStore, sem: jax.Array,
     lane. Clock absorption and recv guards stay the CALLER's job;
     ``stamp_lt`` stamps winners' ``modified`` lanes. For an all-zero
     ``sem`` lane the result is bit-identical to `wire_join_step`."""
-    return _typed_wire_join_jit(donate, sharding)(
-        store, sem, lt, node, val, tomb, valid, stamp_lt, local_node)
+    with _obs_device.record("semantics.typed_wire_join_step",
+                            dim=lt.shape[0],
+                            donated=store.lt if donate else None):
+        return _typed_wire_join_jit(donate, sharding)(
+            store, sem, lt, node, val, tomb, valid, stamp_lt,
+            local_node)
 
 
 @_ft.lru_cache(maxsize=None)
@@ -254,9 +263,12 @@ def typed_sparse_join_step(store: DenseStore, sem_rows: jax.Array,
     must be unique within one delta — the same contract as
     `sparse_fanin_step`, and why duplicate-index scatter order can
     never matter here."""
-    return _typed_sparse_join_jit(donate, sharding)(
-        store, sem_rows, slot, lt, node, val, tomb, valid, stamp_lt,
-        local_node)
+    with _obs_device.record("semantics.typed_sparse_join_step",
+                            dim=slot.shape[0],
+                            donated=store.lt if donate else None):
+        return _typed_sparse_join_jit(donate, sharding)(
+            store, sem_rows, slot, lt, node, val, tomb, valid,
+            stamp_lt, local_node)
 
 
 @_ft.lru_cache(maxsize=None)
@@ -313,9 +325,12 @@ def typed_fanin_step(store: DenseStore, sem: jax.Array,
     changed-vs-original mask. Purely elementwise, so a sharded model
     runs it under jit with its store sharding pinned — no collective
     dispatch needed."""
-    return _typed_fanin_jit(donate, sharding)(
-        store, sem, cs, canonical_lt, local_node, wall_millis,
-        stamp_lt)
+    with _obs_device.record("semantics.typed_fanin_step",
+                            dim=cs.lt.shape[0],
+                            donated=store.lt if donate else None):
+        return _typed_fanin_jit(donate, sharding)(
+            store, sem, cs, canonical_lt, local_node, wall_millis,
+            stamp_lt)
 
 
 def combine_wire_deltas(sem, a: dict, b: dict) -> dict:

@@ -492,6 +492,25 @@ def test_fastpath_completeness_gate_fails_on_missing_kernel():
          "pallas.ingest_scatter_tiles[interpret]"]) == []
 
 
+def test_ledger_completeness_gate_fails_on_missing_kernel():
+    from crdt_tpu.analysis.cli import (_LEDGER_REQUIRED,
+                                       _ledger_completeness)
+    missing = set(_LEDGER_REQUIRED) - {"dense.merge_repack_step"}
+    findings = _ledger_completeness(registered=missing)
+    assert [f.rule for f in findings] == ["dispatch-ledger-unregistered"]
+    assert "dense.merge_repack_step" in findings[0].message
+    # an unregistered extra never trips the gate; the full set is clean
+    assert _ledger_completeness(
+        registered=set(_LEDGER_REQUIRED) | {"extra.kernel"}) == []
+
+
+def test_ledger_completeness_gate_clean_on_shipped_tree():
+    # no `registered=`: the gate imports the instrumented modules and
+    # reads the live default ledger — exactly what the default run does
+    from crdt_tpu.analysis.cli import _ledger_completeness
+    assert _ledger_completeness() == []
+
+
 def test_cli_nonzero_with_counterexample_on_broken_fixture():
     proc = _run_cli("--law-fixture",
                     os.path.join(FIXTURES, "broken_merge.py"))

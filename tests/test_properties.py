@@ -8,6 +8,12 @@ the SURVEY §4 "what the reference lacks" layer.
 
 import string
 
+import pytest
+
+# Collection must not die on hosts without hypothesis (the tier-1
+# harness previously leaned on --continue-on-collection-errors here).
+pytest.importorskip("hypothesis")
+
 from hypothesis import given, settings, strategies as st
 
 from crdt_tpu import Hlc, MapCrdt, Record

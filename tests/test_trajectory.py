@@ -1,0 +1,190 @@
+"""Bench trajectory (benchmarks/README.md): the normalized record
+schema, fastest-of-N floors with `evaluate_slo` semantics (unmeasured
+!= passed), and the ``python -m crdt_tpu.obs bench --compare`` exit
+codes — including the planted-regression fixture the CI smoke gate is
+proven against: exit 1 on the regressed candidate, exit 0 on the clean
+one, exit 2 when nothing was comparable."""
+
+import io
+import json
+import os
+
+import pytest
+
+from crdt_tpu.obs.trajectory import (append_record, bench_main, compare,
+                                     flatten_metrics, load_trajectory,
+                                     metric_direction, normalize_record)
+
+pytestmark = pytest.mark.trajectory
+
+FIXTURES = os.path.join(os.path.dirname(__file__), "fixtures")
+BASELINE = os.path.join(FIXTURES, "trajectory_baseline.jsonl")
+REGRESSED = os.path.join(FIXTURES, "trajectory_regressed.jsonl")
+CLEAN = os.path.join(FIXTURES, "trajectory_clean.jsonl")
+
+
+def _rec(run_id, metrics, mode="sync", host="ci-fixture", smoke=True):
+    return {"run_id": run_id, "mode": mode, "git_sha": "f00",
+            "host_class": host, "smoke": smoke, "metrics": metrics,
+            "slo": None}
+
+
+# --- schema ----------------------------------------------------------
+
+def test_flatten_metrics_dotted_numeric_leaves():
+    flat = flatten_metrics({
+        "merge_ms": 3, "ok": True, "name": "x", "none": None,
+        "cold_peer": {"bytes_per_s": 9.5, "nested": {"depth_ms": 1}},
+        "list": [1, 2]})
+    assert flat == {"merge_ms": 3.0, "cold_peer.bytes_per_s": 9.5,
+                    "cold_peer.nested.depth_ms": 1.0}
+
+
+def test_metric_direction_heuristic():
+    assert metric_direction("merge_ms") == "lower"
+    assert metric_direction("cold_peer.fetch_latency") == "lower"
+    assert metric_direction("merges_per_sec") == "higher"
+    assert metric_direction("pooled_speedup") == "higher"
+    # config echoes, counts and self-gated metrics never auto-compare
+    assert metric_direction("rounds") is None
+    assert metric_direction("n_slots") is None
+    assert metric_direction("merkle_bytes") is None
+    assert metric_direction("ledger_overhead_budget_frac") is None
+    assert metric_direction("ledger_overhead_frac") is None
+
+
+def test_normalize_record_schema_and_slo():
+    rec = normalize_record(
+        "sync", {"merge_ms": 2.5, "slo": {"checks": {}, "ok": True}},
+        run_id="r1", sha="abc", host="h", smoke=True, source="SRC")
+    assert rec["run_id"] == "r1"
+    assert rec["mode"] == "sync"
+    assert rec["git_sha"] == "abc"
+    assert rec["host_class"] == "h"
+    assert rec["smoke"] is True
+    assert rec["metrics"] == {"merge_ms": 2.5}
+    assert rec["slo"] == {"checks": {}, "ok": True}
+    assert rec["source"] == "SRC"
+
+
+def test_append_and_load_roundtrip_skips_torn_lines(tmp_path):
+    path = str(tmp_path / "t.jsonl")
+    append_record(_rec("a", {"merge_ms": 1.0}), path)
+    with open(path, "a") as f:
+        f.write('{"torn": \n')          # torn append
+        f.write("not json either\n")
+    append_record(_rec("b", {"merge_ms": 2.0}), path)
+    recs = load_trajectory(path)
+    assert [r["run_id"] for r in recs] == ["a", "b"]
+
+
+# --- compare semantics ----------------------------------------------
+
+def _baseline():
+    return [_rec("b1", {"merge_ms": 10.2, "merges_per_sec": 980.0}),
+            _rec("b2", {"merge_ms": 10.0, "merges_per_sec": 1000.0}),
+            _rec("b3", {"merge_ms": 10.5, "merges_per_sec": 950.0})]
+
+
+def test_compare_fastest_of_n_floors():
+    v = compare(_baseline(), _rec("c", {"merge_ms": 10.4,
+                                        "merges_per_sec": 990.0}))
+    assert v["ok"] is True
+    assert v["checks"]["merge_ms"]["baseline"] == 10.0       # min
+    assert v["checks"]["merges_per_sec"]["baseline"] == 1000.0  # max
+    assert v["compared"] == 2
+
+
+def test_compare_flags_regression_outside_budget():
+    v = compare(_baseline(), _rec("c", {"merge_ms": 20.0,
+                                        "merges_per_sec": 990.0}))
+    assert v["ok"] is False
+    assert v["checks"]["merge_ms"]["ok"] is False
+    assert v["checks"]["merges_per_sec"]["ok"] is True
+
+
+def test_compare_unmeasured_is_not_passed():
+    # candidate metric absent from every baseline run -> unmeasured
+    v = compare(_baseline(), _rec("c", {"fresh_ms": 1.0}))
+    assert v["ok"] is None          # zero measured checks: NOT ok
+    assert v["compared"] == 0
+    assert v["unmeasured"] == 1
+
+
+def test_compare_groups_never_cross_hosts():
+    v = compare(_baseline(), _rec("c", {"merge_ms": 99.0},
+                                  host="other-host"))
+    assert v["baseline_runs"] == []
+    assert v["ok"] is None
+
+
+def test_compare_zero_floor_is_unmeasured_not_regressed():
+    base = [_rec("b", {"warm_ms": 0.0})]
+    v = compare(base, _rec("c", {"warm_ms": 0.031}))
+    assert v["checks"]["warm_ms"]["ok"] is None
+    assert v["ok"] is None
+
+
+def test_compare_explicit_metric_list_surfaces_unclassifiable():
+    v = compare(_baseline(), _rec("c", {"rounds": 64.0}),
+                metrics=["rounds"])
+    assert v["checks"]["rounds"]["ok"] is None
+    assert v["unmeasured"] == 1
+
+
+# --- the CI gate (exit codes over the planted fixtures) -------------
+
+def test_gate_exits_nonzero_on_planted_regression():
+    out = io.StringIO()
+    rc = bench_main(["--compare", BASELINE, "--candidate", REGRESSED],
+                    out)
+    assert rc == 1
+    assert "REGRESSED" in out.getvalue()
+    assert "merge_ms" in out.getvalue()
+
+
+def test_gate_exits_zero_on_clean_rerun():
+    out = io.StringIO()
+    rc = bench_main(["--compare", BASELINE, "--candidate", CLEAN], out)
+    assert rc == 0
+    assert "REGRESSED" not in out.getvalue()
+
+
+def test_gate_self_trajectory_mode(tmp_path):
+    # append-then-gate: the series' own last record is the candidate
+    path = str(tmp_path / "t.jsonl")
+    for rec in load_trajectory(BASELINE):
+        append_record(rec, path)
+    append_record(json.load(open(REGRESSED)), path)
+    assert bench_main(["--compare", path], io.StringIO()) == 1
+    path2 = str(tmp_path / "t2.jsonl")
+    for rec in load_trajectory(BASELINE):
+        append_record(rec, path2)
+    append_record(json.load(open(CLEAN)), path2)
+    assert bench_main(["--compare", path2], io.StringIO()) == 0
+
+
+def test_gate_exit_2_when_nothing_comparable(tmp_path):
+    empty = str(tmp_path / "empty.jsonl")
+    open(empty, "w").close()
+    assert bench_main(["--compare", empty], io.StringIO()) == 2
+    # a lone record has no baseline pool: unmeasured != passed
+    lone = str(tmp_path / "lone.jsonl")
+    append_record(_rec("only", {"merge_ms": 1.0}), lone)
+    assert bench_main(["--compare", lone], io.StringIO()) == 2
+
+
+def test_gate_json_output():
+    out = io.StringIO()
+    rc = bench_main(["--compare", BASELINE, "--candidate", REGRESSED,
+                     "--json"], out)
+    payload = json.loads(out.getvalue())
+    assert rc == 1
+    assert payload["candidate"] == "fix-cand-regressed"
+    assert payload["verdict"]["ok"] is False
+
+
+def test_gate_budget_override_loosens_the_floor():
+    rc = bench_main(["--compare", BASELINE, "--candidate", REGRESSED,
+                     "--budget", "2.0"], io.StringIO())
+    assert rc == 0
