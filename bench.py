@@ -1213,6 +1213,395 @@ def bench_serve(sessions: int = 10000, rate_hz: float = 1.0,
     }
 
 
+def bench_federate(sessions: int = 100000, partitions: int = 4,
+                   rate_hz: float = 0.25, duration: float = 10.0,
+                   warmup: float = 3.0, n_slots: int = 1 << 14,
+                   flush_interval: float = 0.002,
+                   connect_batch: int = 500,
+                   split_at_frac: float = 0.4,
+                   offered_cap_ops_s: float = 1000.0,
+                   recovery_s: float = 3.0) -> dict:
+    """Federated serving under a live partition split
+    (docs/FEDERATION.md): ``sessions`` open-loop client sessions
+    spread across ``partitions`` ServeTier partitions behind one
+    `FederatedTier`, each session pinned to one slot and connected to
+    that slot's owner. Mid-run (``split_at_frac`` of the way through
+    the measured window) the hot partition is split live: the donor
+    streams the migrating range while writes keep flowing, then the
+    routing epoch flips and every affected session absorbs one
+    ``moved`` redirect and reconnects to the new owner.
+
+    Sessions are federation-aware (hello cap), so a redirect is a
+    typed retry, never a drop: the acceptance gate is attempts ==
+    acked with zero session errors across the flip, and STEADY-STATE
+    post-split ack p99 (acks later than ``recovery_s`` after the
+    flip) within the SERVE_r01 envelope (14.6 ms). Latencies are
+    measured from the op's SCHEDULED time (open loop), so redirect
+    and reconnect cost lands in the percentiles instead of being
+    coordinated-omission'd away — which is also why the flip
+    transient is reported separately: an epoch flip hands every
+    session one `moved` inside one round-trip window, and that burst
+    is a real, bounded cost the full post-split percentile would
+    otherwise smear over the steady state.
+
+    The nominal shape is 4x25k sessions; like bench_serve, the run
+    downsizes honestly to the host's fd ceiling — and further to the
+    host's measured serving envelope (``offered_cap_ops_s``; this
+    host class saturates near 1k ops/s once five tiers, the fleet
+    child, and the split streaming share one core) — and reports
+    both the requested and the seated counts."""
+    import asyncio
+    import resource
+    import struct as _struct
+    import zlib as _zlib
+    from crdt_tpu import FederatedTier
+    from crdt_tpu.obs.fleet import evaluate_slo
+    from crdt_tpu.obs.registry import default_registry
+
+    # fd budget: the parent holds ONE server-side fd per session
+    # (spread across the partition tiers, which all live in this
+    # process); the forked fleet child holds the client side against
+    # its own limit. Redirect reconnects close-then-open, so the
+    # split does not move the high-water mark.
+    need = sessions + 1024
+    soft, hard = resource.getrlimit(resource.RLIMIT_NOFILE)
+    if soft < need:
+        try:
+            resource.setrlimit(resource.RLIMIT_NOFILE,
+                               (min(need, hard), hard))
+        except (ValueError, OSError):
+            pass
+    soft, _ = resource.getrlimit(resource.RLIMIT_NOFILE)
+    requested = sessions
+    if soft < need:
+        sessions = max(1, soft - 1024)
+    # Host-envelope cap, applied after the fd cap: the SERVE_r01 host
+    # class (single core) tops out near 2.5k acked ops/s through the
+    # serving path — offering more measures the host's own saturation
+    # (a seconds-deep open-loop backlog that also keeps the migrating
+    # range too hot for a split to ever settle), not the federation.
+    # Both the requested and the seated counts are reported.
+    cap = max(1, int(offered_cap_ops_s / max(rate_hz, 1e-9)))
+    sessions = min(sessions, cap)
+
+    head = _struct.Struct(">I")
+
+    async def _recv(reader, tagged):
+        hd = await reader.readexactly(4)
+        body = await reader.readexactly(head.unpack(hd)[0])
+        if tagged:
+            tag, body = body[:1], body[1:]
+            if tag == b"\x01":
+                body = _zlib.decompress(body)
+        return json.loads(body)
+
+    async def _send(writer, obj, tagged):
+        body = json.dumps(obj).encode()
+        if tagged:
+            body = b"\x00" + body
+        writer.write(head.pack(len(body)) + body)
+        await writer.drain()
+
+    async def _dial(addr):
+        host, _, port = addr.rpartition(":")
+        reader, writer = await asyncio.open_connection(host, int(port))
+        # Federation-aware session: the hello cap is what turns a
+        # cross-partition op into a `moved` redirect instead of a
+        # server-side proxy hop.
+        await _send(writer, {"op": "hello",
+                             "caps": ["federation", "semantics"]},
+                    tagged=False)
+        await _recv(reader, tagged=False)   # pre-codec hello reply
+        return reader, writer
+
+    async def _hangup(writer):
+        try:
+            writer.close()
+            await writer.wait_closed()
+        except Exception:
+            pass
+
+    async def session(k, conn, start, warm_end, end, lats, counters,
+                      interval, n_sess, route):
+        loop = asyncio.get_running_loop()
+        # Stride the fleet across the WHOLE keyspace — k % n_slots
+        # would pile every session into the low partition whenever
+        # sessions < n_slots and the "federation" under test would
+        # secretly be one tier plus idle bystanders.
+        slot = (k * n_slots) // max(1, n_sess)
+        owner = route["table"].owner_of(slot)
+        epoch = route["table"].epoch
+        t0 = start + (k / max(1, n_sess)) * interval
+        reader, writer = conn
+        i = 0
+        try:
+            while True:
+                sched = t0 + i * interval
+                if sched >= end:
+                    return
+                now = loop.time()
+                if sched > now:
+                    await asyncio.sleep(sched - now)
+                counters["attempts"] += 1
+                tries = 0
+                while True:
+                    tries += 1
+                    if tries > 64:
+                        counters["errors"] += 1
+                        return
+                    try:
+                        if writer is None:
+                            reader, writer = await _dial(owner)
+                        await _send(writer,
+                                    {"op": "put", "slot": slot,
+                                     "value": i, "epoch": epoch},
+                                    tagged=True)
+                        reply = await _recv(reader, tagged=True)
+                    except (ConnectionError, OSError,
+                            asyncio.IncompleteReadError):
+                        counters["reconnects"] += 1
+                        if writer is not None:
+                            await _hangup(writer)
+                        writer = None
+                        await asyncio.sleep(0.01)
+                        continue
+                    if reply.get("ok"):
+                        counters["acked"] += 1
+                        break
+                    code = reply.get("code")
+                    if code == "moved":
+                        # Typed redirect: the reply names this slot's
+                        # owner under the fresh epoch — exactly what a
+                        # single-slot session needs; no table re-fetch
+                        # round trip. An epoch flip sends every
+                        # session one moved, but only sessions whose
+                        # range actually migrated change owner — the
+                        # rest retry on the SAME connection with the
+                        # new epoch, so the flip is not a reconnect
+                        # herd.
+                        counters["moved"] += 1
+                        new_owner = reply.get("owner") or owner
+                        epoch = reply.get("epoch", epoch)
+                        if new_owner != owner:
+                            owner = new_owner
+                            await _hangup(writer)
+                            writer = None
+                    elif code == "busy":
+                        counters["busy"] += 1
+                        await asyncio.sleep(0.01)
+                    else:
+                        counters["errors"] += 1
+                        return
+                ack_t = loop.time()
+                if sched >= warm_end:
+                    lats.append((ack_t, ack_t - sched))
+                i += 1
+        finally:
+            try:
+                if writer is not None:
+                    writer.close()
+            except Exception:
+                pass
+
+    async def fleet(seed_addr, n_sess, rate, warm, dur, started):
+        loop = asyncio.get_running_loop()
+        from crdt_tpu.routing import RoutingTable
+        # One pre-hello route fetch seeds every session's owner map.
+        r, w = await asyncio.open_connection(
+            *seed_addr.rpartition(":")[::2])
+        await _send(w, {"op": "route"}, tagged=False)
+        rep = await _recv(r, tagged=False)
+        w.close()
+        route = {"table": RoutingTable.from_json(rep["routing"])}
+        interval = 1.0 / rate
+        lats: list = []
+        counters = {"attempts": 0, "acked": 0, "moved": 0, "busy": 0,
+                    "reconnects": 0, "errors": 0,
+                    "connect_failures": 0}
+
+        # Dial (and hello) in bounded batches BEFORE the schedule
+        # starts, like bench_serve — an all-at-once 19k dial storm
+        # puts the fleet seconds behind its own open-loop schedule
+        # and the catch-up flood poisons every percentile.
+        async def _dial_k(k):
+            try:
+                return await _dial(route["table"].owner_of(
+                    (k * n_slots) // max(1, n_sess)))
+            except OSError:
+                return None
+        conns: list = []
+        for base in range(0, n_sess, connect_batch):
+            res = await asyncio.gather(
+                *(_dial_k(k)
+                  for k in range(base,
+                                 min(base + connect_batch, n_sess))),
+                return_exceptions=True)
+            for r in res:
+                if r is None or isinstance(r, BaseException):
+                    counters["connect_failures"] += 1
+                    conns.append(None)
+                else:
+                    conns.append(r)
+        start = loop.time() + 1.0
+        warm_end = start + warm
+        end = warm_end + dur
+        # Monotonic clocks are system-wide: the parent uses this
+        # timestamp to fire the split mid-window and to segment the
+        # latency series into pre/post-flip populations.
+        started.put(start)
+        await asyncio.gather(*(
+            session(k, conn, start, warm_end, end, lats, counters,
+                    interval, n_sess, route)
+            for k, conn in enumerate(conns) if conn is not None))
+        connected = n_sess - counters["connect_failures"]
+        return lats, counters, connected
+
+    def pct_ms(xs, p):
+        if not xs:
+            return None
+        return round(xs[min(len(xs) - 1,
+                            int(p * (len(xs) - 1)))] * 1e3, 3)
+
+    fed = FederatedTier(n_slots, partitions=partitions,
+                        flush_interval=flush_interval,
+                        max_sessions=sessions + 64)
+    with fed:
+        # Pre-warm the padded-commit jit buckets once — the cache is
+        # process-global, so one tier's warm pass covers every
+        # partition AND the split recipient spawned mid-run (a
+        # first-contact compile inside the measured window would read
+        # as a fake post-split p99 spike).
+        tier0 = fed.tiers[0]
+        with tier0.lock:
+            for sz in (1, 2, 4, 8, 16, 32, 64, 128, 256, 512, 1024,
+                       2048, 4096):
+                sz = min(sz, n_slots)
+                tier0.crdt.put_batch(list(range(sz)), [0] * sz)
+                tier0.crdt.drain_ingest()
+        # ... and the pack/merge buckets the split's range streaming
+        # hits (donor pack_since under its lock, recipient
+        # merge_packed): a first-contact compile while the donor lock
+        # is held stalls every in-flight ack behind the split.
+        from crdt_tpu import DenseCrdt as _DC
+        wa = _DC("warm-a", n_slots=n_slots)
+        wb = _DC("warm-b", n_slots=n_slots)
+        for sz in (1, 2, 4, 8, 16, 32, 64, 128, 256, 512, 1024,
+                   2048, 4096):
+            sz = min(sz, n_slots)
+            wa.put_batch(list(range(sz)), [1] * sz)
+            wa.drain_ingest()
+            packed, ids = wa.pack_since(None, sem_mode="include",
+                                        ranges=((0, n_slots),))
+            wb.merge_packed(packed, ids)
+        del wa, wb
+
+        import multiprocessing
+        ctx = multiprocessing.get_context("fork")
+        rq = ctx.SimpleQueue()
+        sq = ctx.SimpleQueue()
+        seed = fed.addrs()[0]
+
+        def _fleet_child():
+            try:
+                import gc
+                gc.freeze()
+                # Refcounting covers the fleet's per-op churn; a gen2
+                # cycle pass over 4k live session coroutines is a
+                # multi-ms stop-the-world that lands straight in an
+                # open-loop percentile.
+                gc.disable()
+                rq.put(asyncio.run(fleet(seed, sessions, rate_hz,
+                                         warmup, duration, sq)))
+            except BaseException as e:  # surfaced in the parent
+                rq.put({"error": f"{type(e).__name__}: {e}"})
+
+        proc = ctx.Process(target=_fleet_child, daemon=True)
+        proc.start()
+        start_t = sq.get()
+        if isinstance(start_t, dict):  # child died before the signal
+            raise RuntimeError(f"federate fleet failed: "
+                               f"{start_t['error']}")
+        target = start_t + warmup + duration * split_at_frac
+        delay = target - time.monotonic()
+        if delay > 0:
+            time.sleep(delay)
+        t_arm = time.monotonic()
+        split = fed.split_hot()
+        t_flip = time.monotonic()
+        res = rq.get()
+        proc.join(timeout=60)
+        if isinstance(res, dict):
+            raise RuntimeError(f"federate fleet failed: "
+                               f"{res['error']}")
+        lats, counters, connected = res
+        partitions_after = len(fed.tiers)
+        shed = sum(t.shed_count for t in fed.tiers)
+        dropped = sum(t.dropped_sessions for t in fed.tiers)
+
+    pre = sorted(l for (t, l) in lats if t < t_arm)
+    post = sorted(l for (t, l) in lats if t >= t_flip)
+    steady = sorted(l for (t, l) in lats
+                    if t >= t_flip + recovery_s)
+    allv = sorted(l for (_, l) in lats)
+    steady_p99 = pct_ms(steady, 0.99)
+    zero_dropped = (counters["errors"] == 0
+                    and counters["acked"] == counters["attempts"])
+    return {
+        "metric": "federate_live_split", "unit": "ops/s",
+        "platform": jax.devices()[0].platform,
+        "sessions": requested, "sessions_connected": connected,
+        "partitions": partitions, "partitions_after": partitions_after,
+        "rate_per_session_hz": rate_hz,
+        "flush_interval_ms": flush_interval * 1e3,
+        "n_slots": n_slots,
+        "warmup_s": warmup, "duration_s": duration,
+        "ops_s": round(len(allv) / duration, 1),
+        "ops_attempted": counters["attempts"],
+        "ops_acked": counters["acked"],
+        "moved_redirects": counters["moved"],
+        "busy_retries": counters["busy"],
+        "reconnects": counters["reconnects"],
+        "session_errors": counters["errors"],
+        "connect_failures": counters["connect_failures"],
+        "shed_count": shed,
+        "dropped_sessions": dropped,
+        "zero_dropped_writes": zero_dropped,
+        "p50_ms": pct_ms(allv, 0.50), "p99_ms": pct_ms(allv, 0.99),
+        "pre_split_p50_ms": pct_ms(pre, 0.50),
+        "pre_split_p99_ms": pct_ms(pre, 0.99),
+        # Full post-flip population (includes the one-round-trip
+        # moved burst every session absorbs at the epoch flip) vs the
+        # steady state the tier settles back into.
+        "post_split_p50_ms": pct_ms(post, 0.50),
+        "post_split_p99_ms": pct_ms(post, 0.99),
+        "recovery_window_s": recovery_s,
+        "post_split_steady_p50_ms": pct_ms(steady, 0.50),
+        "post_split_steady_p99_ms": steady_p99,
+        "split": {
+            "src": split.get("src"), "range": split.get("range"),
+            "rounds": split.get("rounds"),
+            "rows_migrated": split.get("migrated_rows"),
+            "seconds": split.get("seconds"),
+            "epoch": split.get("epoch"),
+        },
+        # SERVE_r01 envelope: the single-tier 10k-session run acked
+        # at p99 14.6 ms on this host class; a live split must
+        # settle the post-flip steady state back inside the same
+        # envelope with zero dropped writes.
+        "post_split_ack_p99_budget_ms": 14.6,
+        "within_budget": (zero_dropped and steady_p99 is not None
+                          and steady_p99 <= 14.6),
+        # SLO over this process's registry, with the ack budget set
+        # to the federate envelope (14.6 ms, SERVE_r01's p99): the
+        # histogram includes every redirect-burst ack around the
+        # flip, which the single-tier 4.25 ms steady-state budget was
+        # never meant to cover.
+        "_slo": evaluate_slo(
+            {"federation": default_registry().snapshot()},
+            ack_p99_budget_s=0.0146),
+    }
+
+
 def bench_ingest(n_slots: int = 1 << 14, rows: int = 1024,
                  batches: int = 64, repeats: int = 24) -> dict:
     """Write-path fast lane: staged ingest() vs unbatched put_batch.
@@ -1478,7 +1867,7 @@ def main() -> None:
     ap.add_argument("--mode",
                     choices=("stream", "distinct", "e2e", "e2e-kernel",
                              "sync", "ingest", "types", "antientropy",
-                             "serve"),
+                             "serve", "federate"),
                     default="stream",
                     help="stream: write-stream replay (chunk replayed "
                          "with +1ms offsets); distinct: HBM-resident "
@@ -1502,10 +1891,18 @@ def main() -> None:
                          "serving-tier load — --sessions concurrent "
                          "client sessions multiplexed onto one "
                          "ServeTier, p50/p99 write-ack latency and "
-                         "acked ops/s")
+                         "acked ops/s; federate: the serve fleet "
+                         "spread over --partitions consistent-hash "
+                         "partitions behind a FederatedTier, with a "
+                         "live hot-partition split fired mid-run — "
+                         "zero-dropped-writes and post-split ack p99 "
+                         "are the gates")
     ap.add_argument("--sessions", type=int, default=None,
-                    help="serve mode: concurrent client sessions "
-                         "(default 10000, smoke 200)")
+                    help="serve/federate mode: concurrent client "
+                         "sessions (serve default 10000, federate "
+                         "100000 nominal — both fd-capped; smoke 200)")
+    ap.add_argument("--partitions", type=int, default=4,
+                    help="federate mode: initial partition count")
     ap.add_argument("--rows", type=int, default=128,
                     help="distinct mode: replica rows resident in HBM")
     ap.add_argument("--trajectory", metavar="JSONL", default=None,
@@ -1544,6 +1941,17 @@ def main() -> None:
             rate_hz=2.0 if args.smoke else 0.25,
             duration=2.0 if args.smoke else 10.0,
             warmup=1.0 if args.smoke else 3.0,
+            n_slots=1 << 10 if args.smoke else 1 << 14)
+    elif args.mode == "federate":
+        # Nominal shape: 4 partitions x 25k sessions. The bench
+        # downsizes to the host's fd ceiling and records both counts.
+        result = bench_federate(
+            sessions=args.sessions or (200 if args.smoke else 100000),
+            partitions=2 if args.smoke else args.partitions,
+            rate_hz=2.0 if args.smoke else 0.25,
+            duration=3.0 if args.smoke else 12.0,
+            warmup=1.0 if args.smoke else 3.0,
+            recovery_s=1.0 if args.smoke else 3.0,
             n_slots=1 << 10 if args.smoke else 1 << 14)
     elif args.mode == "types":
         result = bench_types(n_slots=1 << 10,

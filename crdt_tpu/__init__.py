@@ -34,12 +34,14 @@ from .models.keyed_dense import KeyedDenseCrdt
 from .models.sqlite_crdt import SqliteCrdt
 from .sync import sync, sync_json, sync_merkle, sync_packed
 from .net import (FrameCodec, PeerConnection, SyncError,
-                  SyncProtocolError, SyncServer, SyncTransportError,
-                  WireTally, fetch_metrics, sync_dense_over_conn,
-                  sync_dense_over_tcp, sync_merkle_over_conn,
-                  sync_over_conn, sync_over_tcp,
+                  SyncProtocolError, SyncRedirectError, SyncServer,
+                  SyncTransportError, WireTally, fetch_metrics,
+                  sync_dense_over_conn, sync_dense_over_tcp,
+                  sync_merkle_over_conn, sync_over_conn, sync_over_tcp,
                   sync_packed_over_conn)
 from .serve import ServeTier
+from .routing import PartitionRouter, RoutingTable
+from .federation import FederatedClient, FederatedTier
 from .ops.packing import PackedDelta
 from .obs import (MetricsRegistry, TraceRing, default_registry,
                   metrics_snapshot, tracer)
@@ -63,8 +65,11 @@ __all__ = [
     "PeerConnection", "FrameCodec", "PackedDelta",
     "sync_over_conn", "sync_dense_over_conn", "sync_packed_over_conn",
     "sync_merkle_over_conn",
-    "SyncError", "SyncTransportError", "SyncProtocolError", "WireTally",
+    "SyncError", "SyncTransportError", "SyncProtocolError",
+    "SyncRedirectError", "WireTally",
     "fetch_metrics", "ServeTier",
+    "RoutingTable", "PartitionRouter", "FederatedTier",
+    "FederatedClient",
     "GossipNode", "Peer", "RetryPolicy", "BreakerPolicy", "CircuitBreaker",
     "load_dense", "load_json", "save_dense", "save_json",
     "load_gossip_state", "save_gossip_state",

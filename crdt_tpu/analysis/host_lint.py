@@ -65,6 +65,12 @@ reproduced bugs):
   foreign exporters; a per-key label value mints one time series per
   key — unbounded cardinality that melts the registry
   (docs/OBSERVABILITY.md).
+- ``router-epoch-bypass`` — in a class carrying a partition router
+  (``self.router`` assigned in ``__init__``), a keyspace-op enqueue
+  (``self._q.append``) with no router consultation lexically before
+  it; such a write skips the ``moved``/stale-epoch admission gate and
+  can land on a partition that no longer owns the slot mid-split
+  (docs/FEDERATION.md).
 
 The linter is purely lexical/AST — no imports of the linted code — so
 it runs on broken or unimportable files (the self-test fixtures).
@@ -98,6 +104,7 @@ RULES = (
     "merkle-digest-host-hash",
     "async-blocking-call",
     "metric-name-unprefixed",
+    "router-epoch-bypass",
     "suppression-without-reason",
 )
 
@@ -703,6 +710,79 @@ def _check_metric_names(tree: ast.AST, path: str) -> List[Finding]:
     return out
 
 
+# --- rule: router-epoch-bypass ---
+
+# Lexical evidence that a method admits keyspace ops through the
+# partition router before enqueueing: it touches self.router, or it
+# calls the tier's route-verdict helper.
+_ROUTER_GATE_CALLS = {"_route_verdict", "check"}
+
+
+def _check_router_bypass(tree: ast.AST, path: str) -> List[Finding]:
+    """In a class that carries a partition router (``self.router``
+    assigned in ``__init__``), every method that enqueues a keyspace
+    op (``self._q.append``) must consult the router FIRST — an
+    enqueue lexically before any router reference is a write the
+    `moved`/stale-epoch protocol never saw, which silently violates
+    partition ownership during a live split (docs/FEDERATION.md)."""
+    out: List[Finding] = []
+    for cls in ast.walk(tree):
+        if not isinstance(cls, ast.ClassDef):
+            continue
+        routed = False
+        for fn in cls.body:
+            if isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)) \
+                    and fn.name == "__init__":
+                for n in ast.walk(fn):
+                    if isinstance(n, ast.Attribute) \
+                            and n.attr == "router" \
+                            and isinstance(n.value, ast.Name) \
+                            and n.value.id == "self" \
+                            and isinstance(n.ctx, ast.Store):
+                        routed = True
+        if not routed:
+            continue
+        for fn in cls.body:
+            if not isinstance(fn, (ast.FunctionDef,
+                                   ast.AsyncFunctionDef)) \
+                    or fn.name == "__init__":
+                continue
+            gate_line = None
+            appends: List[ast.Call] = []
+            for n in ast.walk(fn):
+                if isinstance(n, ast.Attribute) \
+                        and n.attr == "router" \
+                        and isinstance(n.value, ast.Name) \
+                        and n.value.id == "self":
+                    if gate_line is None or n.lineno < gate_line:
+                        gate_line = n.lineno
+                if isinstance(n, ast.Call) \
+                        and isinstance(n.func, ast.Attribute) \
+                        and n.func.attr in _ROUTER_GATE_CALLS:
+                    if gate_line is None or n.lineno < gate_line:
+                        gate_line = n.lineno
+                if isinstance(n, ast.Call) \
+                        and isinstance(n.func, ast.Attribute) \
+                        and n.func.attr == "append":
+                    tgt = _dotted(n.func.value)
+                    if tgt == "self._q":
+                        appends.append(n)
+            for call in appends:
+                if gate_line is None or call.lineno < gate_line:
+                    out.append(Finding(
+                        rule="router-epoch-bypass", path=path,
+                        line=call.lineno,
+                        message=f"{fn.name}() enqueues a keyspace op "
+                                "(self._q.append) without first "
+                                "consulting self.router — the op "
+                                "bypasses the moved/stale-epoch "
+                                "admission gate and can land on a "
+                                "partition that no longer owns the "
+                                "slot mid-split "
+                                "(docs/FEDERATION.md)"))
+    return out
+
+
 _ALL_CHECKS = (
     _check_sockets,
     _check_lock_discipline,
@@ -715,6 +795,7 @@ _ALL_CHECKS = (
     _check_digest_host_hash,
     _check_async_blocking,
     _check_metric_names,
+    _check_router_bypass,
 )
 
 

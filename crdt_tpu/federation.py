@@ -1,0 +1,582 @@
+"""Federated serving: N `ServeTier` partitions behind one keyspace.
+
+`ServeTier` multiplexes 10k sessions onto ONE replica (SERVE_r01);
+this module composes N of them into a single logical front door
+(ROADMAP item 1). Each tier owns a consistent-hash share of the slot
+space (`routing.RoutingTable`); cross-partition ops answer `moved`
+(or are proxied for pre-federation sessions), and the table travels
+on the hello/route/metrics surfaces so clients and tiers agree on
+ownership by epoch.
+
+The load-bearing piece is the **live split** (`split_hot`): when a
+partition runs hot — ranked from its serve ack phases and dispatch-
+ledger counts (PR 12) — half of its widest range is migrated to a new
+tier while writes keep flowing:
+
+1. pick the donor range ``[lo, hi)`` and midpoint ``mid``;
+2. stream ``[mid, hi)`` to the recipient in watermark rounds:
+   each round packs ``pack_since(mark, ranges=((mid, hi),))`` under
+   the donor's lock and ships it over the recipient's ordinary
+   ``push_packed`` op (PR 8 machinery, so a `FaultProxy` can sit on
+   the wire and the rows are idempotent lattice joins — kill, retry,
+   re-ship, nothing double-applies);
+3. when a round ships few rows the backlog is small: flip the routing
+   epoch (`RoutingTable.split`), publish the new table to every tier;
+4. drain: writes accepted by the donor *before* the flip may still be
+   sitting in its combiner — wait out the donor's flush tick, then
+   ship one final ranged round so the recipient holds everything;
+5. clients racing the flip are refused with `moved` (stale epoch),
+   refetch the table, and replay at the recipient — the `moved` retry
+   loop IS the consistency mechanism; no write is dropped because no
+   write is ever acked by a tier that did not commit it.
+
+Geometry: every partition replica is built with the GLOBAL n_slots.
+A partition's store is sparsely occupied outside its ranges, which is
+exactly what makes range streaming, Merkle walks and `merge_packed`
+work unchanged across partitions — a slot means the same thing
+everywhere (docs/FEDERATION.md).
+
+`FederatedClient` is the reference routed client: fetches the table,
+sessions per owner, sends the epoch on every op, absorbs `moved` by
+refetching and replaying, and can hold watch subscriptions
+(`watch`/`next_event`) against any partition.
+"""
+
+from __future__ import annotations
+
+import socket
+import threading
+import time
+from typing import Any, Dict, List, Optional, Tuple
+
+from .net import (FrameCodec, WireTally, _pack_for_peer, recv_frame,
+                  recv_bytes_frame, send_bytes_frame, send_frame)
+from .routing import PartitionRouter, RoutingTable
+from .serve import ServeTier
+
+__all__ = ["FederatedTier", "FederatedClient"]
+
+# Streaming rounds stop chasing the write stream once a round ships
+# this few rows — the leftover is the final post-flip drain's job.
+_SETTLE_ROWS = 64
+_MAX_ROUNDS = 64
+
+
+def _metrics():
+    from .obs.registry import default_registry
+    reg = default_registry()
+    return (
+        reg.gauge("crdt_tpu_federation_epoch",
+                  "current routing-table epoch"),
+        reg.gauge("crdt_tpu_federation_partitions",
+                  "live partitions behind the federated front door"),
+        reg.counter("crdt_tpu_federation_splits_total",
+                    "completed live partition splits"),
+        reg.counter("crdt_tpu_federation_migrated_rows_total",
+                    "rows streamed to recipients during live splits"),
+        reg.histogram("crdt_tpu_federation_split_seconds",
+                      "live split wall time (first stream round to "
+                      "post-flip drain)"),
+    )
+
+
+class _Upstream:
+    """Blocking control-plane connection to one tier (federation
+    caps negotiated) used by the split engine and the routed client:
+    plain request/reply framing on the caller's thread — control
+    traffic, never the serving hot path."""
+
+    def __init__(self, addr: str, timeout: float = 30.0,
+                 caps: Tuple[str, ...] = ("zlib", "packed",
+                                          "semantics", "federation")):
+        host, _, port = addr.rpartition(":")
+        self.addr = addr
+        self.sock = socket.create_connection((host, int(port)),
+                                             timeout=timeout)
+        self.sock.settimeout(timeout)
+        self.tally = WireTally()
+        send_frame(self.sock, {"op": "hello", "proto": 1,
+                               "caps": list(caps)}, self.tally)
+        reply = recv_frame(self.sock, tally=self.tally)
+        if not (isinstance(reply, dict) and reply.get("ok")):
+            raise ConnectionError(
+                f"hello to {addr} failed: {reply!r}")
+        agreed = set(reply.get("caps") or ())
+        self.caps = frozenset(agreed)
+        self.codec = FrameCodec(compress="zlib" in agreed)
+        self.routing_epoch = reply.get("routing_epoch")
+
+    def request(self, msg: dict) -> Any:
+        send_frame(self.sock, msg, self.tally, self.codec)
+        return recv_frame(self.sock, tally=self.tally,
+                          codec=self.codec)
+
+    def request_with_blob(self, msg: dict, bufs) -> Any:
+        send_frame(self.sock, msg, self.tally, self.codec)
+        send_bytes_frame(self.sock, bufs, self.tally, self.codec)
+        return recv_frame(self.sock, tally=self.tally,
+                          codec=self.codec)
+
+    def recv(self) -> Any:
+        return recv_frame(self.sock, tally=self.tally,
+                          codec=self.codec)
+
+    def recv_blob(self) -> Optional[bytes]:
+        return recv_bytes_frame(self.sock, tally=self.tally,
+                                codec=self.codec)
+
+    def close(self) -> None:
+        try:
+            send_frame(self.sock, {"op": "bye"}, self.tally,
+                       self.codec)
+        except (OSError, ValueError):
+            pass
+        try:
+            self.sock.close()
+        except OSError:
+            pass
+
+
+class FederatedTier:
+    """N consistent-hash partitions of one keyspace, each a
+    `ServeTier` over its own replica, sharing one epoch-versioned
+    `RoutingTable`.
+
+    ``make_crdt(partition_index)`` builds each partition's replica
+    (global ``n_slots`` geometry — see the module docstring); the
+    default builds a CPU-backed `DenseCrdt`. ``layout="even"`` (the
+    bench default) gives equal contiguous shares; ``layout="hash"``
+    places consistent-hash tokens (`RoutingTable.build`).
+    """
+
+    def __init__(self, n_slots: int, partitions: int = 4,
+                 host: str = "127.0.0.1",
+                 flush_interval: float = 0.002,
+                 max_sessions: int = 12000,
+                 make_crdt=None, layout: str = "even",
+                 vnodes: int = 8, **tier_kw):
+        if partitions < 1:
+            raise ValueError(
+                f"partitions must be >= 1; got {partitions}")
+        self.n_slots = int(n_slots)
+        self.host = host
+        self.flush_interval = flush_interval
+        self.max_sessions = max_sessions
+        self._layout = layout
+        self._vnodes = vnodes
+        self._tier_kw = dict(tier_kw)
+        self._make_crdt = make_crdt if make_crdt is not None \
+            else self._default_crdt
+        self._n_initial = partitions
+        self.tiers: List[ServeTier] = []
+        self.table: Optional[RoutingTable] = None
+        self.last_split: Optional[dict] = None
+        # Serializes splits and table publication against each other;
+        # the serving hot path never takes it.
+        self._control = threading.Lock()
+
+    def _default_crdt(self, index: int):
+        from .models.dense_crdt import DenseCrdt
+        return DenseCrdt(f"fed-p{index}", self.n_slots)
+
+    # --- lifecycle ---
+
+    def _spawn_tier(self, index: int) -> ServeTier:
+        tier = ServeTier(
+            self._make_crdt(index), host=self.host, port=0,
+            max_sessions=self.max_sessions,
+            flush_interval=self.flush_interval,
+            router=PartitionRouter(), **self._tier_kw)
+        tier.start()
+        tier.router.bind(f"{tier.host}:{tier.port}")
+        return tier
+
+    def start(self) -> "FederatedTier":
+        try:
+            for i in range(self._n_initial):
+                self.tiers.append(self._spawn_tier(i))
+            owners = [t.router.addr for t in self.tiers]
+            if self._layout == "hash":
+                table = RoutingTable.build(self.n_slots, owners,
+                                           vnodes=self._vnodes)
+            else:
+                table = RoutingTable.even(self.n_slots, owners)
+            self.publish(table)
+        except BaseException:
+            self.stop()
+            raise
+        return self
+
+    def stop(self) -> None:
+        tiers, self.tiers = self.tiers, []
+        for tier in tiers:
+            try:
+                tier.stop()
+            except Exception:
+                pass
+
+    def __enter__(self) -> "FederatedTier":
+        return self.start()
+
+    def __exit__(self, *exc) -> None:
+        self.stop()
+
+    def publish(self, table: RoutingTable) -> None:
+        """Install ``table`` on every tier (epoch-guarded, so an older
+        table never rolls a tier back) and refresh the fleet gauges.
+        The in-process analogue of the gossip path pre-federation
+        clients use (`GossipNode.attach_router`)."""
+        for tier in self.tiers:
+            tier.router.install(table)
+        self.table = table
+        g_epoch, g_parts, _, _, _ = _metrics()
+        g_epoch.set(float(table.epoch))
+        g_parts.set(float(len(self.tiers)))
+
+    def addrs(self) -> List[str]:
+        return [t.router.addr for t in self.tiers]
+
+    def tier_at(self, addr: str) -> ServeTier:
+        for tier in self.tiers:
+            if tier.router.addr == addr:
+                return tier
+        raise KeyError(f"no tier at {addr}")
+
+    # --- hot-partition detection (serve ack phases + PR 12 ledger) ---
+
+    def hot_partition(self) -> Tuple[int, dict]:
+        """Rank partitions by committed write rows (the serve ack
+        pipeline's volume signal) and return (index, evidence). The
+        evidence dict records the per-partition rows plus the process
+        dispatch-ledger ingest-scatter counts, so a split decision is
+        auditable in the trace record."""
+        from .obs.device import default_ledger
+        rows = []
+        for tier in self.tiers:
+            wc = tier._wc
+            rows.append(0 if wc is None else int(wc.rows_committed))
+        hot = max(range(len(rows)), key=lambda i: rows[i])
+        led = default_ledger()
+        evidence = {
+            "rows_committed": rows,
+            "hot_index": hot,
+            "ledger_ingest_dispatches": {
+                k: v for k, v in led.as_dict().items()
+                if "ingest" in k or "put_scatter" in k},
+        }
+        return hot, evidence
+
+    # --- the live split state machine ---
+
+    def split_hot(self, src: Optional[int] = None,
+                  dst_addr_override: Optional[str] = None,
+                  settle_rows: int = _SETTLE_ROWS) -> dict:
+        """Split the hot partition live: spawn a recipient tier,
+        stream the migrating half-range to it while writes keep
+        flowing, flip the routing epoch, drain the donor's last tick.
+        Returns the split stats dict (also kept as ``last_split``).
+
+        ``dst_addr_override`` routes the *stream* through a different
+        address than the recipient's own (tests interpose a
+        `FaultProxy` there to kill mid-handoff); the routing table
+        always names the recipient's real address.
+        """
+        with self._control:
+            return self._split_locked(src, dst_addr_override,
+                                      settle_rows)
+
+    def _split_locked(self, src, dst_addr_override, settle_rows):
+        if self.table is None:
+            raise RuntimeError("federation not started")
+        t0 = time.perf_counter()
+        if src is None:
+            src, evidence = self.hot_partition()
+        else:
+            evidence = {"hot_index": src, "forced": True}
+        donor = self.tiers[src]
+        donor_addr = donor.router.addr
+        spans = self.table.ranges_of(donor_addr)
+        if not spans:
+            raise ValueError(f"partition {src} owns no ranges")
+        lo, hi = max(spans, key=lambda r: r[1] - r[0])
+        if hi - lo < 2:
+            raise ValueError(
+                f"range [{lo}, {hi}) too narrow to split")
+        mid = (lo + hi) // 2
+
+        recipient = self._spawn_tier(len(self.tiers))
+        self.tiers.append(recipient)
+        dst_addr = recipient.router.addr
+        stream_addr = dst_addr_override or dst_addr
+
+        # Pre-flip: recipient must already believe the CURRENT table
+        # (it is not an owner yet, so forwarded/foreign ops answer
+        # moved instead of enqueueing) before any client can find it.
+        recipient.router.install(self.table)
+
+        rounds = 0
+        migrated = 0
+        mark = None
+        up = _Upstream(stream_addr)
+        try:
+            while rounds < _MAX_ROUNDS:
+                rounds += 1
+                shipped, mark = self._ship_range(
+                    donor, up, mark, (mid, hi))
+                migrated += shipped
+                if shipped <= settle_rows:
+                    break
+            # Flip: one epoch bump, published everywhere. Writes the
+            # donor acked before this instant are covered by the
+            # post-flip drain; writes arriving after it answer moved.
+            table = self.table.split(lo, mid, dst_addr)
+            self.publish(table)
+            flip_at = time.perf_counter()
+            # Drain: anything the donor enqueued pre-flip commits
+            # within one flush tick; wait it out, then ship the final
+            # watermark round so the recipient holds every acked row.
+            time.sleep(max(donor.flush_interval * 4, 0.01))
+            shipped, mark = self._ship_range(donor, up, mark,
+                                             (mid, hi))
+            migrated += shipped
+            rounds += 1
+        finally:
+            up.close()
+
+        _, _, c_splits, c_rows, h_secs = _metrics()
+        c_splits.inc()
+        c_rows.inc(migrated)
+        dt = time.perf_counter() - t0
+        h_secs.observe(dt)
+        self.last_split = {
+            "src": src, "src_addr": donor_addr, "dst_addr": dst_addr,
+            "range": [lo, hi], "split_at": mid,
+            "rounds": rounds, "migrated_rows": migrated,
+            "epoch": self.table.epoch, "seconds": dt,
+            "drain_rows": shipped,
+            "flip_to_drain_seconds": time.perf_counter() - flip_at,
+            "evidence": evidence,
+        }
+        return self.last_split
+
+    def _ship_range(self, donor: ServeTier, up: _Upstream, mark,
+                    span: Tuple[int, int]):
+        """One streaming round: pack the donor's rows in ``span``
+        modified at-or-after ``mark`` (under the donor's lock, with
+        the watermark taken in the SAME hold so no commit can fall
+        between pack and mark), ship via push_packed, return
+        (rows, new_mark). Transport faults retry on a fresh
+        connection — the rows are idempotent lattice joins."""
+        from .ops.packing import pack_rows
+        with donor.lock:
+            wm = donor.crdt.canonical_time
+            packed, ids = _pack_for_peer(donor.crdt, mark, True,
+                                         ranges=(span,))
+        if not packed.k:
+            return 0, wm
+        meta, bufs = pack_rows(packed)
+        msg = {"op": "push_packed", "meta": meta,
+               "node_ids": list(ids)}
+        for attempt in range(8):
+            try:
+                reply = up.request_with_blob(msg, bufs)
+                if isinstance(reply, dict) and reply.get("ok"):
+                    return packed.k, wm
+                raise ConnectionError(
+                    f"push_packed refused: {reply!r}")
+            except (ConnectionError, OSError, ValueError) as e:
+                # Kill-and-restart mid-handoff (FaultProxy drops the
+                # link, the recipient restarts): reconnect and replay
+                # the SAME pack — merge_packed is idempotent.
+                last = e
+                try:
+                    up.close()
+                except Exception:
+                    pass
+                time.sleep(0.05 * (attempt + 1))
+                try:
+                    up.__init__(up.addr)
+                except (ConnectionError, OSError) as e2:
+                    last = e2
+                    continue
+        raise ConnectionError(
+            f"range stream to {up.addr} failed after retries: {last!r}")
+
+
+class FederatedClient:
+    """Routed synchronous client: one hello'd session per owner,
+    table-aware, epoch-stamped ops, `moved`-driven retry.
+
+    The retry loop is the protocol: on ``moved`` (or a routing-flux
+    ``busy``) the client refetches the table from any live tier and
+    replays the op at the new owner. An op is reported successful
+    ONLY on a positive ack from the tier that committed it — which is
+    what makes "zero dropped writes" measurable from the client side.
+    """
+
+    def __init__(self, seeds: List[str], timeout: float = 30.0,
+                 max_redirects: int = 8):
+        if not seeds:
+            raise ValueError("need at least one seed address")
+        self._seeds = list(seeds)
+        self._timeout = timeout
+        self._max_redirects = max_redirects
+        self._sessions: Dict[str, _Upstream] = {}
+        self.table: Optional[RoutingTable] = None
+        self.moved_redirects = 0
+        self.busy_retries = 0
+        self.refresh()
+
+    # --- plumbing ---
+
+    def _session(self, addr: str) -> _Upstream:
+        up = self._sessions.get(addr)
+        if up is None:
+            up = self._sessions[addr] = _Upstream(
+                addr, timeout=self._timeout)
+        return up
+
+    def _drop_session(self, addr: str) -> None:
+        up = self._sessions.pop(addr, None)
+        if up is not None:
+            up.close()
+
+    def refresh(self) -> RoutingTable:
+        """Fetch the newest routing table from any reachable tier
+        (seeds first, then every known owner)."""
+        candidates = list(dict.fromkeys(
+            self._seeds + (list(self.table.owners())
+                           if self.table is not None else [])))
+        last: Optional[BaseException] = None
+        for addr in candidates:
+            try:
+                reply = self._session(addr).request({"op": "route"})
+            except (ConnectionError, OSError, ValueError) as e:
+                self._drop_session(addr)
+                last = e
+                continue
+            if isinstance(reply, dict) and reply.get("ok") \
+                    and isinstance(reply.get("routing"), dict):
+                table = RoutingTable.from_json(reply["routing"])
+                self.table = RoutingTable.newest(self.table, table)
+                return self.table
+        raise ConnectionError(
+            f"no tier answered a route request: {last!r}")
+
+    # --- keyspace ops ---
+
+    def _keyspace(self, msg: dict, slot: int,
+                  want_field: str = "ok") -> dict:
+        if self.table is None:
+            self.refresh()
+        for _ in range(self._max_redirects):
+            owner = self.table.owner_of(slot)
+            msg["epoch"] = self.table.epoch
+            try:
+                reply = self._session(owner).request(msg)
+            except (ConnectionError, OSError, ValueError):
+                self._drop_session(owner)
+                time.sleep(0.01)
+                self.refresh()
+                continue
+            if isinstance(reply, dict) and reply.get("ok"):
+                return reply
+            code = reply.get("code") if isinstance(reply, dict) \
+                else None
+            if code == "moved":
+                # The typed redirect: adopt the owner's epoch view
+                # and replay. (PeerConnection maps this same reply to
+                # SyncRedirectError; here we stay dict-level.)
+                self.moved_redirects += 1
+                self.refresh()
+                continue
+            if code == "busy":
+                self.busy_retries += 1
+                time.sleep(0.01)
+                continue
+            raise ValueError(f"op {msg.get('op')!r} rejected: "
+                             f"{reply!r}")
+        raise ConnectionError(
+            f"op {msg.get('op')!r} on slot {slot} still redirecting "
+            f"after {self._max_redirects} attempts")
+
+    def put(self, slot: int, value: int) -> None:
+        self._keyspace({"op": "put", "slot": int(slot),
+                        "value": int(value)}, slot)
+
+    def delete(self, slot: int) -> None:
+        self._keyspace({"op": "delete", "slot": int(slot)}, slot)
+
+    def get(self, slot: int):
+        return self._keyspace({"op": "get", "slot": int(slot)},
+                              slot).get("value")
+
+    # --- watch ---
+
+    def watch(self, addr: str, slots=None) -> "_WatchSession":
+        """Subscribe on one tier; returns a dedicated event session
+        (`next_event` decodes one pushed pack into [(slot, value),
+        ...] with typed lanes decoded — docs/FEDERATION.md)."""
+        return _WatchSession(addr, slots, timeout=self._timeout)
+
+    def close(self) -> None:
+        for addr in list(self._sessions):
+            self._drop_session(addr)
+
+
+class _WatchSession:
+    """One watch subscription riding its own connection (events are
+    server-pushed; multiplexing them with request/reply frames on one
+    socket would interleave streams)."""
+
+    def __init__(self, addr: str, slots, timeout: float = 30.0):
+        self._up = _Upstream(addr, timeout=timeout)
+        # The server's WatchIndex routes by INTEREST but ships the
+        # shared tick pack (zero-copy fan-out: one pack, N writers);
+        # slot-scoped subscriptions filter here, client-side.
+        self._filter = (None if slots is None
+                        else frozenset(int(s) for s in slots))
+        msg: dict = {"op": "watch"}
+        if slots is not None:
+            msg["slots"] = [int(s) for s in slots]
+        reply = self._up.request(msg)
+        if not (isinstance(reply, dict) and reply.get("ok")):
+            self._up.close()
+            raise ConnectionError(f"watch refused: {reply!r}")
+        self.since = reply.get("since")
+
+    def next_event(self, timeout: Optional[float] = None
+                   ) -> List[Tuple[int, Any]]:
+        """Block for one pushed event pack; returns decoded
+        (slot, value) pairs (None value = tombstone; typed lanes
+        decode through their registered semantics)."""
+        from .ops.packing import unpack_rows
+        from .semantics import by_tag
+        if timeout is not None:
+            self._up.sock.settimeout(timeout)
+        meta_msg = self._up.recv()
+        if not (isinstance(meta_msg, dict)
+                and meta_msg.get("op") == "event"):
+            raise ConnectionError(
+                f"watch stream broke: {meta_msg!r}")
+        blob = self._up.recv_blob()
+        if blob is None:
+            raise ConnectionError("watch stream EOF mid-event")
+        packed = unpack_rows(meta_msg["meta"], blob)
+        out: List[Tuple[int, Any]] = []
+        sem = packed.sem
+        for i in range(packed.k):
+            slot = int(packed.slots[i])
+            if self._filter is not None and slot not in self._filter:
+                continue
+            if packed.tomb[i]:
+                out.append((slot, None))
+                continue
+            lane = int(packed.val[i])
+            tag = int(sem[i]) if sem is not None else 0
+            out.append((slot,
+                        lane if tag == 0 else by_tag(tag).decode(lane)))
+        return out
+
+    def close(self) -> None:
+        self._up.close()

@@ -315,6 +315,10 @@ class GossipNode:
         # Fleet canary probe (obs/probe.py): enabled explicitly via
         # enable_canary — user stores must never lose slots silently.
         self._canary = None
+        # Federated routing view (routing.PartitionRouter): attached
+        # via attach_router so the routing table + epoch gossip on the
+        # metrics/health surfaces pre-federation clients already poll.
+        self._router = None
 
     # --- topology ---
 
@@ -728,12 +732,24 @@ class GossipNode:
         peers = self.lag_snapshot(include_pending=include_pending)
         with self.server.lock:
             head = self.crdt.canonical_time
-        return {"node_id": str(self.crdt.node_id),
-                "hlc_head": str(head),
-                "head_millis": head.millis,
-                "status": health_status(peers,
-                                        stale_after_ms=stale_after_ms),
-                "peers": peers}
+        out = {"node_id": str(self.crdt.node_id),
+               "hlc_head": str(head),
+               "head_millis": head.millis,
+               "status": health_status(peers,
+                                       stale_after_ms=stale_after_ms),
+               "peers": peers}
+        router = self._router
+        if router is not None and router.epoch is not None:
+            out["routing_epoch"] = router.epoch
+        return out
+
+    def attach_router(self, router) -> None:
+        """Bind a `routing.PartitionRouter` so this node's metrics op
+        and `health()` carry the federated routing table/epoch — the
+        gossip leg of table distribution: any peer or poller that
+        already fetches metrics learns the newest table without a
+        federation-aware session (docs/FEDERATION.md)."""
+        self._router = router
 
     def _metrics_extra(self) -> Dict[str, Any]:
         """Folded into the server's ``metrics`` op reply (called
@@ -744,6 +760,9 @@ class GossipNode:
         extra = {"node": node, "lag": self.lag_snapshot()}
         if self._canary is not None:
             extra["canary"] = self._canary.snapshot()
+        router = self._router
+        if router is not None and router.table is not None:
+            extra["routing"] = router.table.to_json()
         return extra
 
     # --- fleet canary (obs/probe.py) ---

@@ -902,6 +902,19 @@ class DenseCrdt:
         the kernel — SURVEY.md §7 hard part 6)."""
         return self._hub.stream(slot)
 
+    def _watch_decode(self, slot, value):
+        """Decode one committed lane value for a watch event: typed
+        slots (counter/orset/mvreg) must emit what their reads return
+        — `spec.decode(lane)` — never the packed raw lane a subscriber
+        cannot interpret. Untyped replicas pay a single None check."""
+        if value is None or self._sem is None:
+            return value
+        tag = int(self._sem[slot])
+        if tag == 0:
+            return value
+        from ..semantics import by_tag
+        return by_tag(tag).decode(int(value))
+
     def _emit_put(self, slots, values, tombs=None) -> None:
         if not self._hub.active:
             return  # no subscribers: bulk path stays device-only
@@ -912,9 +925,11 @@ class DenseCrdt:
         val_arr = np.asarray(values)
 
         def pairs():
+            sl = [int(x) for x in slot_arr]
             vals = [None if (tombs is not None and bool(tombs[i]))
-                    else int(val_arr[i]) for i in range(len(slot_arr))]
-            return [int(x) for x in slot_arr], vals
+                    else self._watch_decode(sl[i], int(val_arr[i]))
+                    for i in range(len(slot_arr))]
+            return sl, vals
 
         def get(k):
             if not isinstance(k, (int, np.integer)):
@@ -924,7 +939,9 @@ class DenseCrdt:
                 return False, None
             i = int(hit[-1])
             deleted = tombs is not None and bool(tombs[i])
-            return True, None if deleted else int(val_arr[i])
+            return True, (None if deleted
+                          else self._watch_decode(int(k),
+                                                  int(val_arr[i])))
 
         # A raw slot array may repeat a slot; keyed streams must then
         # see every occurrence (add_batch's per-pair contract), so the
@@ -955,13 +972,16 @@ class DenseCrdt:
 
         def pairs():
             return ([int(s) for s in widx],
-                    [None if tomb[s] else int(val[s]) for s in widx])
+                    [None if tomb[s]
+                     else self._watch_decode(int(s), int(val[s]))
+                     for s in widx])
 
         def get(k):
             if not (isinstance(k, (int, np.integer))
                     and 0 <= k < win.shape[0] and win[k]):
                 return False, None
-            return True, None if tomb[k] else int(val[k])
+            return True, (None if tomb[k]
+                          else self._watch_decode(int(k), int(val[k])))
 
         # crdtlint: disable=add-batch-unique-keys -- widx comes from np.nonzero(win): a slot mask cannot repeat a slot, so the batch is unique by construction
         self._hub.add_batch(pairs, get)
@@ -1049,7 +1069,9 @@ class DenseCrdt:
         if self._hub.active:
             for slot, rec in record_map.items():
                 self._hub.add(int(slot),
-                              None if rec.is_deleted else int(rec.value))
+                              None if rec.is_deleted
+                              else self._watch_decode(int(slot),
+                                                      int(rec.value)))
 
     def _delta_mask(self, modified_since: Optional[Hlc]) -> np.ndarray:
         if modified_since is None:

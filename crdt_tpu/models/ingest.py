@@ -276,12 +276,21 @@ class WriteCombiner:
         """Change events fire AT COMMIT, with the winning post-dedup
         value per slot — a slot staged twice in the window emits once,
         with the value the store actually holds (docs/INGEST.md)."""
-        hub = self._owner._hub
+        owner = self._owner
+        hub = owner._hub
         if not hub.active:
             return
-        svals = [None if t else int(v)
-                 for v, t in zip(vals.tolist(), tombs.tolist())]
         sl = [int(s) for s in slots.tolist()]
+        if owner._has_typed:
+            # Typed lanes (counter/orset/mvreg) carry packed encodings;
+            # subscribers must see the decoded committed value, same as
+            # the unbatched emit paths (docs/TYPES.md).
+            svals = [None if t else owner._watch_decode(s, int(v))
+                     for s, v, t in zip(sl, vals.tolist(),
+                                        tombs.tolist())]
+        else:
+            svals = [None if t else int(v)
+                     for v, t in zip(vals.tolist(), tombs.tolist())]
         pos = {s: i for i, s in enumerate(sl)}
         # crdtlint: disable=add-batch-unique-keys -- slots are deduplicated last-wins by flush() before reaching here, so the batch repeats no key
         hub.add_batch(lambda: (sl, svals),

@@ -115,6 +115,23 @@ class SyncTransportError(SyncError):
     with backoff."""
 
 
+class SyncRedirectError(SyncTransportError):
+    """The PEER is not the slot's owner: a federated tier answered a
+    keyspace op with ``moved``, naming the owning tier's address and
+    the routing epoch it routed by (docs/FEDERATION.md). A transport
+    subclass on purpose — like ``busy`` (PR 9), a redirect is
+    retryable-by-construction (refetch the routing table, replay at
+    the owner; the lattice join is idempotent) and must NEVER
+    downgrade the session to the legacy protocol or mark the peer
+    rejected."""
+
+    def __init__(self, message: str, owner: Optional[str] = None,
+                 epoch: Optional[int] = None):
+        super().__init__(message)
+        self.owner = owner
+        self.epoch = epoch
+
+
 class SyncProtocolError(SyncError):
     """The PEER rejected the round: a clock guard tripped, the op is
     unknown, or the dense wire form is unsupported/incompatible.
@@ -1022,6 +1039,15 @@ def _check_reply(what: str, reply: Any, want_field: str) -> None:
         # class, so retry/backoff machinery handles it — never a
         # protocol rejection, never a mode downgrade.
         raise SyncTransportError(f"{what}: peer busy ({reply!r})")
+    if isinstance(reply, dict) and reply.get("code") == "moved":
+        # Federation redirect: the slot lives on another tier. Typed
+        # and retryable (replay at reply["owner"] after refetching the
+        # routing table) — like busy, never a protocol rejection,
+        # never a mode downgrade (docs/FEDERATION.md).
+        raise SyncRedirectError(
+            f"{what}: moved to {reply.get('owner')!r} "
+            f"(epoch {reply.get('epoch')})",
+            owner=reply.get("owner"), epoch=reply.get("epoch"))
     if isinstance(reply, dict) and ("error" in reply
                                     or reply.get("ok") is False):
         raise SyncProtocolError.from_reply(what, reply)
@@ -1166,6 +1192,18 @@ class PeerConnection:
                 raise SyncTransportError(
                     f"peer {self.host}:{self.port} at capacity "
                     f"(busy): {reply.get('error')!r}")
+            elif isinstance(reply, dict) \
+                    and reply.get("code") == "moved":
+                # Federation redirect at hello: a modern server naming
+                # the owning tier. Typed and retryable — like busy,
+                # NOT the legacy signal (docs/FEDERATION.md).
+                sock.close()
+                raise SyncRedirectError(
+                    f"peer {self.host}:{self.port} redirected to "
+                    f"{reply.get('owner')!r} "
+                    f"(epoch {reply.get('epoch')})",
+                    owner=reply.get("owner"),
+                    epoch=reply.get("epoch"))
             elif isinstance(reply, dict) and ("error" in reply
                                               or reply.get("ok")
                                               is False):

@@ -137,6 +137,71 @@ async def write_json_async(writer: asyncio.StreamWriter, obj: Any,
                             codec, tally)
 
 
+# A watcher whose transport buffer exceeds this is shed at fan-out
+# time: watch delivery is at-least-once over idempotent lattice rows,
+# so a shed subscriber resubscribes and catches up via the watermark —
+# unbounded buffering for a stalled reader is the one outcome the tier
+# must never choose.
+_WATCH_BUFFER_CAP = 1 << 22
+
+
+class _OwnerProxy:
+    """One pooled upstream connection to an owning tier, forwarding
+    keyspace ops on behalf of pre-federation sessions (the `moved`
+    fallback negotiated away by the missing hello cap). Speaks the
+    pre-hello untagged framing — the upstream tier treats it as one
+    more legacy session — and serializes in-flight requests under an
+    asyncio lock, so one connection serves every proxied op this tier
+    sends that owner. Loop-confined, like the sessions it serves."""
+
+    def __init__(self, addr: str, timeout: float):
+        host, _, port = addr.rpartition(":")
+        self.host, self.port = host, int(port)
+        self._timeout = timeout
+        self._lock = asyncio.Lock()
+        self._reader: Optional[asyncio.StreamReader] = None
+        self._writer: Optional[asyncio.StreamWriter] = None
+
+    async def request(self, msg: dict) -> Any:
+        async with self._lock:
+            last: Optional[BaseException] = None
+            for attempt in range(2):
+                try:
+                    if self._writer is None:
+                        self._reader, self._writer = \
+                            await asyncio.wait_for(
+                                asyncio.open_connection(
+                                    self.host, self.port),
+                                timeout=self._timeout)
+                    await write_json_async(self._writer, msg)
+                    reply = await asyncio.wait_for(
+                        read_frame_async(self._reader),
+                        timeout=self._timeout)
+                    if reply is None:
+                        raise ConnectionError(
+                            "upstream closed mid-request")
+                    return reply
+                except (ConnectionError, OSError, ValueError,
+                        asyncio.TimeoutError) as e:
+                    # A dead pooled connection retries ONCE on a fresh
+                    # one; forwarded writes are idempotent lattice
+                    # writes, so the replay is safe.
+                    last = e
+                    await self.close()
+            raise last if last is not None else ConnectionError(
+                "proxy request failed")
+
+    async def close(self) -> None:
+        w, self._writer = self._writer, None
+        self._reader = None
+        if w is not None:
+            try:
+                w.close()
+                await w.wait_closed()
+            except (ConnectionError, OSError):
+                pass
+
+
 class ServeTier:
     """Serve one replica to thousands of concurrent client sessions.
 
@@ -176,9 +241,17 @@ class ServeTier:
                  io_timeout: float = 30.0,
                  key_encoder=None, value_encoder=None,
                  key_decoder=None, value_decoder=None,
-                 lock: Optional[threading.RLock] = None):
+                 lock: Optional[threading.RLock] = None,
+                 router=None):
         self.crdt = crdt
         self.lock = lock if lock is not None else threading.RLock()
+        # Federation: an attached `PartitionRouter` (routing.py) makes
+        # this tier one partition of a federated keyspace — keyspace
+        # ops are admitted through router.check() before they may
+        # enqueue (the crdtlint router-epoch-bypass contract), foreign
+        # slots answer `moved` (or proxy for pre-federation sessions),
+        # and the `federation` hello cap is advertised.
+        self.router = router
         self.host = host
         self.port: Optional[int] = None
         self._want_port = port
@@ -223,6 +296,20 @@ class ServeTier:
             "to tick pickup), stamp (HLC send_batch), scatter (device "
             "commit dispatch), ack_write (residual tick work + ack "
             "fan-out)")
+        self._m_moved = reg.counter(
+            "crdt_tpu_serve_moved_total",
+            "keyspace ops redirected with the moved reply (federated "
+            "routing)")
+        self._m_proxied = reg.counter(
+            "crdt_tpu_serve_proxied_total",
+            "keyspace ops forwarded to the owning tier for "
+            "pre-federation sessions")
+        self._m_watchers = reg.gauge(
+            "crdt_tpu_serve_watchers",
+            "live watch subscriptions on the serve loop")
+        self._m_fanout = reg.counter(
+            "crdt_tpu_serve_watch_fanout_total",
+            "watch event frames fanned out at flush ticks")
 
         # Loop-confined state (touched only from the tier's event
         # loop, so no lock): the pending write queue, live sessions,
@@ -234,6 +321,17 @@ class ServeTier:
         self.dropped_sessions = 0
         self.idle_closed_sessions = 0
         self._cold_inflight = 0
+        # Watch fan-out state: slot-interest index + per-watcher codec
+        # (both loop-confined); the pack watermark `_watch_mark` is
+        # shared with executor threads and only touched under `lock`.
+        from .watch import WatchIndex
+        self._watch = WatchIndex()
+        self._watch_codec: dict = {}
+        self._watch_mark: Optional[Hlc] = None
+        self.watch_shed_sessions = 0
+        # Upstream connections for the proxy fallback, keyed by owner
+        # address (loop-confined).
+        self._proxies: dict = {}
 
         # One replica executor serializes every warm-path replica
         # touch; the cold lane gets its own single worker so a digest
@@ -335,6 +433,9 @@ class ServeTier:
             # breath to write their replies, then cut the transports.
             await self._flush_tick()
             await asyncio.sleep(0)
+            for proxy in self._proxies.values():
+                await proxy.close()
+            self._proxies.clear()
             for w in list(self._writers):
                 try:
                     w.close()
@@ -387,6 +488,10 @@ class ServeTier:
     async def _flush_tick(self) -> None:
         if not self._q:
             self._m_depth.set(0, node=self._node)
+            # Quiet ticks still fan out: merges (push_packed from a
+            # migration, gossip) advance the store without touching
+            # this tier's write queue, and watchers must see them.
+            await self._fanout_tick()
             return
         q, self._q = self._q, []
         self._m_depth.set(0, node=self._node)
@@ -431,6 +536,7 @@ class ServeTier:
                                           node=self._node)
                 self._m_ack_phase.observe(ack_write, phase="ack_write",
                                           node=self._node)
+        await self._fanout_tick()
 
     def _commit(self, slots: np.ndarray, vals: np.ndarray,
                 tombs: np.ndarray) -> dict:
@@ -441,6 +547,102 @@ class ServeTier:
                 wc.flush("tick")
                 return dict(wc.last_phase_seconds)
         return {}
+
+    # --- watch fan-out: one pack per flush tick, pushed to every
+    # watcher of a touched slot (docs/FEDERATION.md) ---
+
+    async def _fanout_tick(self) -> None:
+        if self._watch.empty:
+            return
+        try:
+            out = await self._loop.run_in_executor(
+                self._replica_pool, self._watch_pack)
+        except Exception:
+            return   # a pack failure must never kill the flusher
+        if out is None:
+            return
+        meta_msg, bufs, touched = out
+        targets = self._watch.touched(touched)
+        if not targets:
+            return
+        # Frame pieces are built ONCE per codec flavor (raw vs zlib)
+        # and the SAME memoryviews are vectored to every watcher —
+        # the zero-copy fan-out: 10k watchers cost 10k writelines,
+        # not 10k serializations.
+        flavors: dict = {}
+        meta_raw = [json.dumps(meta_msg).encode()]
+        for w in list(targets):
+            codec = self._watch_codec.get(w)
+            key = codec is not None and codec.compress
+            cached = flavors.get(key)
+            if cached is None:
+                head = frame_pieces(meta_raw, codec)
+                body = frame_pieces(bufs, codec)
+                nbytes = sum(getattr(p, "nbytes", len(p))
+                             for p in head + body)
+                cached = flavors[key] = (head, body, nbytes)
+            head, body, nbytes = cached
+            transport = w.transport
+            if (transport is None or transport.is_closing()
+                    or transport.get_write_buffer_size()
+                    > _WATCH_BUFFER_CAP):
+                # Backpressure: a watcher that cannot keep up is shed
+                # (its session close deregisters it) rather than
+                # letting its transport buffer grow without bound.
+                self.watch_shed_sessions += 1
+                self._m_shed.inc(lane="watch", node=self._node)
+                self._drop_watcher(w)
+                try:
+                    w.close()
+                except Exception:
+                    pass
+                continue
+            try:
+                w.writelines(head)
+                w.writelines(body)
+            except (ConnectionError, OSError):
+                self._drop_watcher(w)
+                continue
+            self.tally.sent += nbytes
+            self._m_fanout.inc(node=self._node)
+
+    def _drop_watcher(self, writer) -> None:
+        self._watch.remove(writer)
+        self._watch_codec.pop(writer, None)
+        self._m_watchers.set(len(self._watch), node=self._node)
+
+    def _watch_arm(self) -> str:
+        """Register-time replica touch: the head stamp the reply
+        reports, also seeding the pack watermark so event streams
+        start at subscription time, not store birth."""
+        with self.lock:
+            head = self.crdt.canonical_time
+            if self._watch_mark is None:
+                self._watch_mark = head
+        return str(head)
+
+    def _watch_pack(self):
+        """One tick's event pack (executor thread, lock held): every
+        row modified at-or-after the watermark, tags included. The
+        inclusive bound means a row exactly AT the watermark can ship
+        twice across ticks — watch delivery is at-least-once, and the
+        rows are idempotent lattice states, so re-applying is safe."""
+        from .ops.packing import pack_rows
+        with self.lock:
+            head = self.crdt.canonical_time
+            if self._watch_mark is not None \
+                    and head == self._watch_mark:
+                return None
+            packed, ids = _pack_for_peer(self.crdt, self._watch_mark,
+                                         True)
+            self._watch_mark = head
+        if not packed.k:
+            return None
+        meta, bufs = pack_rows(packed)
+        touched = [int(s) for s in packed.slots]
+        return ({"op": "event", "meta": meta,
+                 "node_ids": list(ids), "k": packed.k},
+                bufs, touched)
 
     # --- replica helpers (executor threads, lock held) ---
 
@@ -462,6 +664,11 @@ class ServeTier:
         # replica surface needed, so it is advertised unconditionally
         # (same as SyncServer).
         caps.add("trace")
+        if self.router is not None:
+            # Advertised only by routed tiers: a client that agrees
+            # gets `moved` redirects; one that never asks is a
+            # pre-federation session and gets the proxy fallback.
+            caps.add("federation")
         return caps
 
     def _read_slot(self, slot: int):
@@ -580,6 +787,7 @@ class ServeTier:
             # genuinely stalled client).
             self.dropped_sessions += 1
         finally:
+            self._drop_watcher(writer)
             self._writers.discard(writer)
             self._sessions -= 1
             self._m_sessions.set(self._sessions, node=self._node)
@@ -594,8 +802,9 @@ class ServeTier:
             pass
 
     async def _read_op(self, reader: asyncio.StreamReader,
-                       codec: Optional[FrameCodec]):
-        if self.idle_timeout is None:
+                       codec: Optional[FrameCodec],
+                       idle_exempt: bool = False):
+        if self.idle_timeout is None or idle_exempt:
             return await read_frame_async(reader, codec, self.tally)
         try:
             return await asyncio.wait_for(
@@ -617,14 +826,59 @@ class ServeTier:
             read_bytes_frame_async(reader, codec, self.tally),
             timeout=self._io_timeout)
 
+    async def _route_verdict(self, msg: dict, slot: int,
+                             fed_ok: bool):
+        """Admission through the router for one keyspace op: None to
+        enqueue locally, else the reply dict to send instead. The
+        `moved`/proxy taxonomy lives in routing.PartitionRouter.check;
+        this adds the forwarded-op guard (a proxied op landing on a
+        non-owner means the table flipped mid-flight — shed retryably
+        rather than bounce between tiers) and the proxy hop itself."""
+        router = self.router
+        if router is None:
+            return None
+        from .routing import PROXY
+        verdict = router.check(slot, msg.get("epoch"), fed_ok)
+        if verdict is None:
+            return None
+        if msg.get("fwd"):
+            return {"ok": False, "code": "busy",
+                    "error": "routing flux: forwarded op landed on a "
+                             "non-owner (retry after table refresh)"}
+        if verdict is PROXY:
+            owner = router.table.owner_of(slot)
+            proxy = self._proxies.get(owner)
+            if proxy is None:
+                proxy = self._proxies[owner] = _OwnerProxy(
+                    owner, self._io_timeout)
+            fwd = dict(msg)
+            fwd["fwd"] = int(fwd.get("fwd", 0) or 0) + 1
+            fwd.pop("trace", None)
+            try:
+                reply = await proxy.request(fwd)
+            except (ConnectionError, OSError, ValueError,
+                    asyncio.TimeoutError):
+                return {"ok": False, "code": "busy",
+                        "error": f"owner {owner} unreachable (proxy)"}
+            self._m_proxied.inc(op=str(msg.get("op")),
+                                node=self._node)
+            return reply if isinstance(reply, dict) else {
+                "ok": False, "code": "busy",
+                "error": "owner returned garbage (proxy)"}
+        self._m_moved.inc(op=str(msg.get("op")), node=self._node)
+        return verdict
+
     async def _session_loop(self, reader: asyncio.StreamReader,
                             writer: asyncio.StreamWriter) -> None:
         loop = self._loop
         codec: Optional[FrameCodec] = None
         sem_ok = False
         trace_ok = False
+        fed_ok = False
+        watching = False
         while not self._stop_event.is_set():
-            msg = await self._read_op(reader, codec)
+            msg = await self._read_op(reader, codec,
+                                      idle_exempt=watching)
             if msg is None or not isinstance(msg, dict) \
                     or msg.get("op") == "bye":
                 return
@@ -648,6 +902,11 @@ class ServeTier:
                                  "error": "bad slot/value"},
                         codec, self.tally)
                     continue
+                routed = await self._route_verdict(msg, slot, fed_ok)
+                if routed is not None:
+                    await write_json_async(writer, routed, codec,
+                                           self.tally)
+                    continue
                 fut = loop.create_future()
                 self._q.append((slot, value, op == "delete", fut,
                                 time.perf_counter()))
@@ -670,6 +929,11 @@ class ServeTier:
                                  "error": "bad slot"},
                         codec, self.tally)
                     continue
+                routed = await self._route_verdict(msg, slot, fed_ok)
+                if routed is not None:
+                    await write_json_async(writer, routed, codec,
+                                           self.tally)
+                    continue
                 value = await loop.run_in_executor(
                     self._replica_pool, self._read_slot, slot)
                 await write_json_async(writer,
@@ -680,12 +944,57 @@ class ServeTier:
                 want = msg.get("caps")
                 want = set(want) if isinstance(want, list) else set()
                 agreed = sorted(want & self._caps())
-                await write_json_async(
-                    writer, {"ok": True, "proto": 1, "caps": agreed},
-                    codec, self.tally)
+                reply = {"ok": True, "proto": 1, "caps": agreed}
+                router = self.router
+                if router is not None and router.epoch is not None:
+                    # The epoch rides hello so long-lived sessions
+                    # notice a flip on reconnect without a route op.
+                    reply["routing_epoch"] = router.epoch
+                await write_json_async(writer, reply, codec,
+                                       self.tally)
                 codec = FrameCodec(compress="zlib" in agreed)
                 sem_ok = "semantics" in agreed
                 trace_ok = "trace" in agreed
+                fed_ok = "federation" in agreed
+
+            elif op == "route":
+                router = self.router
+                if router is None or router.table is None:
+                    await write_json_async(
+                        writer, {"ok": False, "code": "unrouted",
+                                 "error": "no routing table installed"},
+                        codec, self.tally)
+                else:
+                    await write_json_async(
+                        writer, {"ok": True,
+                                 "routing": router.table.to_json()},
+                        codec, self.tally)
+
+            elif op == "watch":
+                slots = msg.get("slots")
+                if slots is not None and (
+                        not isinstance(slots, list) or not slots
+                        or not all(_slot_ok(s, self._n_slots)
+                                   for s in slots)):
+                    await write_json_async(
+                        writer, {"ok": False, "code": "write_rejected",
+                                 "error": "bad watch slots"},
+                        codec, self.tally)
+                    continue
+                head = await loop.run_in_executor(
+                    self._replica_pool, self._watch_arm)
+                self._watch.add(writer, slots)
+                self._watch_codec[writer] = codec
+                self._m_watchers.set(len(self._watch),
+                                     node=self._node)
+                # A subscribed session is exempt from idle expiry —
+                # a silent watcher is the normal state, and the
+                # fan-out path owns its liveness (buffer-cap shed).
+                watching = True
+                await write_json_async(
+                    writer, {"ok": True, "mode": "watch",
+                             "since": head},
+                    codec, self.tally)
 
             elif op == "push":
                 try:

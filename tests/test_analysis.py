@@ -353,6 +353,63 @@ def test_metric_name_suppressible_with_reason():
     assert "suppression-without-reason" not in rules
 
 
+def test_router_bypass_ungated_enqueue_flagged():
+    src = (
+        "class Tier:\n"
+        "    def __init__(self, router=None):\n"
+        "        self.router = router\n"
+        "        self._q = []\n"
+        "    def handle(self, msg, slot, value):\n"
+        "        self._q.append((slot, value))\n")
+    findings = [f for f in lint_source(src, "snippet.py")
+                if f.rule == "router-epoch-bypass"]
+    assert len(findings) == 1
+    assert findings[0].line == 6
+    assert "handle()" in findings[0].message
+
+
+def test_router_bypass_enqueue_before_gate_flagged():
+    src = (
+        "class Tier:\n"
+        "    def __init__(self, router=None):\n"
+        "        self.router = router\n"
+        "        self._q = []\n"
+        "    def handle(self, msg, slot, value):\n"
+        "        self._q.append((slot, value))\n"
+        "        verdict = self.router.check(slot, msg.get('epoch'),"
+        " True)\n")
+    rules = {f.rule for f in lint_source(src, "snippet.py")}
+    assert "router-epoch-bypass" in rules
+
+
+def test_router_bypass_gated_enqueue_clean():
+    src = (
+        "class Tier:\n"
+        "    def __init__(self, router=None):\n"
+        "        self.router = router\n"
+        "        self._q = []\n"
+        "    async def handle(self, msg, slot, value):\n"
+        "        routed = await self._route_verdict(msg, slot, True)\n"
+        "        if routed is not None:\n"
+        "            return routed\n"
+        "        self._q.append((slot, value))\n")
+    rules = {f.rule for f in lint_source(src, "snippet.py")}
+    assert "router-epoch-bypass" not in rules
+
+
+def test_router_bypass_ignores_routerless_classes():
+    # a queue-owning class with no router carries no partition
+    # ownership contract — nothing to gate
+    src = (
+        "class Combiner:\n"
+        "    def __init__(self):\n"
+        "        self._q = []\n"
+        "    def push(self, item):\n"
+        "        self._q.append(item)\n")
+    rules = {f.rule for f in lint_source(src, "snippet.py")}
+    assert "router-epoch-bypass" not in rules
+
+
 def test_shipped_tree_lints_clean():
     from crdt_tpu.analysis.host_lint import lint_package
     import crdt_tpu

@@ -1,0 +1,174 @@
+"""Pure-host routing tests: `RoutingTable` construction/evolution and
+the `PartitionRouter.check` admission matrix. No sockets, no device —
+this is the half of federation that must be exhaustively cheap to
+test, since every serve-loop keyspace op rides through `check`."""
+
+import pytest
+
+from crdt_tpu.routing import PROXY, PartitionRouter, RoutingTable
+
+A, B, C = "10.0.0.1:7001", "10.0.0.2:7002", "10.0.0.3:7003"
+
+
+def _coverage_ok(table):
+    cursor = 0
+    for lo, hi, owner in table.ranges:
+        assert lo == cursor and hi > lo and owner
+        cursor = hi
+    assert cursor == table.n_slots
+
+
+class TestBuild:
+    def test_covers_keyspace_exactly(self):
+        t = RoutingTable.build(1 << 12, [A, B, C])
+        _coverage_ok(t)
+        assert t.epoch == 0
+
+    def test_every_owner_holds_slots(self):
+        t = RoutingTable.build(1 << 12, [A, B, C])
+        for owner in (A, B, C):
+            assert t.slots_of(owner) > 0
+        assert sum(t.slots_of(o) for o in t.owners()) == t.n_slots
+
+    def test_deterministic_across_calls_and_owner_order(self):
+        # Token placement is FNV-1a, not builtin hash(): the same
+        # owner set must yield the same table in every process.
+        t1 = RoutingTable.build(1 << 12, [A, B, C])
+        t2 = RoutingTable.build(1 << 12, [A, B, C])
+        assert t1 == t2
+
+    def test_adding_owner_moves_only_bisected_arcs(self):
+        # The consistent-hashing stability property: slots that do not
+        # move to the new owner keep their old owner.
+        small = RoutingTable.build(1 << 12, [A, B])
+        grown = RoutingTable.build(1 << 12, [A, B, C])
+        moved = stayed = 0
+        for slot in range(0, 1 << 12, 7):
+            before, after = small.owner_of(slot), grown.owner_of(slot)
+            if after == C:
+                moved += 1
+            else:
+                assert after == before
+                stayed += 1
+        assert moved > 0 and stayed > 0
+
+    def test_more_vnodes_smooths_shares(self):
+        t = RoutingTable.build(1 << 14, [A, B, C, "10.0.0.4:7004"],
+                               vnodes=64)
+        shares = [t.slots_of(o) for o in t.owners()]
+        assert max(shares) < 2.5 * (t.n_slots / len(shares))
+
+    def test_tiny_ring_falls_back_to_even(self):
+        # 4 slots can starve an owner of arcs; build() must still hand
+        # every started tier something to own.
+        t = RoutingTable.build(4, [A, B, C])
+        assert set(t.owners()) == {A, B, C}
+
+    def test_even_split(self):
+        t = RoutingTable.even(100, [A, B, C])
+        _coverage_ok(t)
+        assert t.ranges == ((0, 33, A), (33, 66, B), (66, 100, C))
+
+    def test_malformed_tables_rejected(self):
+        with pytest.raises(ValueError):
+            RoutingTable(8, 0, [(0, 4, A), (5, 8, B)])   # gap
+        with pytest.raises(ValueError):
+            RoutingTable(8, 0, [(0, 4, A), (3, 8, B)])   # overlap
+        with pytest.raises(ValueError):
+            RoutingTable(8, 0, [(0, 4, A)])              # short
+        with pytest.raises(ValueError):
+            RoutingTable(8, 0, [(0, 8, "")])             # empty owner
+        with pytest.raises(ValueError):
+            RoutingTable.build(8, [])
+
+
+class TestEvolution:
+    def test_split_bumps_epoch_and_reassigns_tail(self):
+        t = RoutingTable.even(100, [A, B])
+        lo, hi = t.ranges_of(A)[0]
+        s = t.split(lo, (lo + hi) // 2, C)
+        assert s.epoch == t.epoch + 1
+        _coverage_ok(s)
+        assert s.owner_of(lo) == A
+        assert s.owner_of((lo + hi) // 2) == C
+        assert s.owner_of(hi - 1) == C
+        assert s.owner_of(hi) == B
+        # The source table is immutable.
+        assert t.owner_of(hi - 1) == A and t.epoch == 0
+
+    def test_split_point_must_be_interior(self):
+        t = RoutingTable.even(100, [A, B])
+        with pytest.raises(ValueError):
+            t.split(0, 0, C)
+        with pytest.raises(ValueError):
+            t.split(0, 50, C)    # == range hi
+        with pytest.raises(ValueError):
+            t.split(7, 20, C)    # no range starts at 7
+
+    def test_newest_is_a_join(self):
+        t0 = RoutingTable.even(100, [A, B])
+        t1 = t0.split(0, 25, C)
+        assert RoutingTable.newest(t0, t1) is t1
+        assert RoutingTable.newest(t1, t0) is t1
+        assert RoutingTable.newest(None, t0) is t0
+        assert RoutingTable.newest(t0, None) is t0
+        assert RoutingTable.newest(None, None) is None
+
+    def test_json_round_trip(self):
+        t = RoutingTable.build(1 << 10, [A, B, C])
+        obj = t.to_json()
+        assert RoutingTable.from_json(obj) == t
+        # And survives an actual wire trip through json.
+        import json
+        assert RoutingTable.from_json(json.loads(json.dumps(obj))) == t
+
+
+class TestRouterCheck:
+    def _router(self):
+        t = RoutingTable.even(100, [A, B])
+        r = PartitionRouter()
+        r.bind(A, t)
+        return r, t
+
+    def test_owned_fresh_admits(self):
+        r, t = self._router()
+        assert r.check(10, t.epoch, fed_ok=True) is None
+        assert r.check(10, None, fed_ok=True) is None   # epoch-less op
+
+    def test_foreign_federated_gets_moved(self):
+        r, t = self._router()
+        verdict = r.check(60, t.epoch, fed_ok=True)
+        assert verdict["code"] == "moved"
+        assert verdict["owner"] == B
+        assert verdict["epoch"] == t.epoch
+        assert verdict["ok"] is False
+
+    def test_foreign_legacy_session_proxies(self):
+        # A session that never negotiated the federation cap cannot
+        # parse `moved`; the serve loop must forward on its behalf.
+        r, t = self._router()
+        assert r.check(60, None, fed_ok=False) is PROXY
+
+    def test_stale_epoch_refused_even_when_owned(self):
+        # The refusal that stops a client from racing a live split:
+        # its next write is blocked until it refetches the table.
+        r, t = self._router()
+        t1 = t.split(0, 25, C)
+        assert r.install(t1)
+        verdict = r.check(10, t.epoch, fed_ok=True)
+        assert verdict["code"] == "moved"
+        assert verdict["owner"] == A      # owner did not change...
+        assert verdict["epoch"] == t1.epoch  # ...but the epoch did
+
+    def test_install_refuses_rollback(self):
+        r, t = self._router()
+        t1 = t.split(0, 25, C)
+        assert r.install(t1)
+        assert not r.install(t)            # out-of-order gossip
+        assert r.table is t1
+        assert r.epoch == t1.epoch
+
+    def test_unbound_router_admits_everything(self):
+        r = PartitionRouter()
+        assert r.check(0, None, fed_ok=False) is None
+        assert r.check(99, 123, fed_ok=True) is None

@@ -1,0 +1,336 @@
+"""Federated serving suite (docs/FEDERATION.md): routed client ops
+across partitions, the `moved` wire protocol (shape, session
+survival, never-legacy classification), server-side proxying for
+pre-federation sessions, the stale-epoch refusal that fences live
+splits, watch fan-out end-to-end, and a kill-and-restart split under
+a write storm proving zero acked writes are lost.
+
+Named to sort AFTER test_obs.py (like test_serve.py): serve tiers
+observe acks into the process-global metrics registry, and the fleet
+poller's SLO test reads that registry — a serve suite running first
+would fail its verdict with this file's latency samples."""
+
+import socket
+import threading
+import time
+
+import pytest
+
+from crdt_tpu import (FederatedClient, FederatedTier, PeerConnection,
+                      SyncProtocolError, SyncRedirectError,
+                      SyncTransportError)
+from crdt_tpu.net import (FrameCodec, _check_reply, recv_frame,
+                          send_frame)
+from crdt_tpu.testing import FaultProxy, ScriptedSchedule
+
+pytestmark = pytest.mark.serve
+
+N_SLOTS = 256
+
+
+def _req(sock, obj, codec=None):
+    send_frame(sock, obj, None, codec)
+    return recv_frame(sock, deadline=time.monotonic() + 10.0,
+                      codec=codec)
+
+
+def _fed_session(tier):
+    """Raw federated session: hello with the federation cap, then the
+    post-hello codec (no zlib requested, so uncompressed tagged
+    frames)."""
+    sock = socket.create_connection((tier.host, tier.port),
+                                    timeout=10.0)
+    sock.settimeout(10.0)
+    reply = _req(sock, {"op": "hello", "proto": 1,
+                        "caps": ["federation"]})
+    assert reply["ok"] and "federation" in reply["caps"]
+    return sock, FrameCodec(compress=False)
+
+
+def _foreign_slot(fed, tier):
+    """A slot the given tier does NOT own."""
+    for slot in range(fed.table.n_slots):
+        if fed.table.owner_of(slot) != tier.router.addr:
+            return slot
+    raise AssertionError("single-owner table")
+
+
+def _owned_slot(fed, tier):
+    for slot in range(fed.table.n_slots):
+        if fed.table.owner_of(slot) == tier.router.addr:
+            return slot
+    raise AssertionError(f"{tier.router.addr} owns nothing")
+
+
+# --- routed client across partitions ---
+
+def test_client_put_get_across_partitions():
+    with FederatedTier(N_SLOTS, partitions=3,
+                       flush_interval=0.002) as fed:
+        assert len(set(fed.table.owners())) == 3
+        cli = FederatedClient(fed.addrs())
+        try:
+            # One write per partition plus range edges: every op must
+            # land regardless of which tier owns the slot.
+            slots = sorted({_owned_slot(fed, t) for t in fed.tiers}
+                           | {0, N_SLOTS // 2, N_SLOTS - 1})
+            for slot in slots:
+                cli.put(slot, 1000 + slot)
+            for slot in slots:
+                assert cli.get(slot) == 1000 + slot
+            cli.delete(slots[0])
+            assert cli.get(slots[0]) is None
+            # A well-routed client never needed a redirect.
+            assert cli.moved_redirects == 0
+        finally:
+            cli.close()
+
+
+# --- the moved wire protocol ---
+
+def test_moved_reply_shape_and_session_survives():
+    with FederatedTier(N_SLOTS, partitions=2,
+                       flush_interval=0.002) as fed:
+        tier = fed.tiers[0]
+        sock, codec = _fed_session(tier)
+        with sock:
+            foreign = _foreign_slot(fed, tier)
+            reply = _req(sock, {"op": "put", "slot": foreign,
+                                "value": 1, "epoch": fed.table.epoch},
+                         codec)
+            assert reply["ok"] is False
+            assert reply["code"] == "moved"
+            assert reply["owner"] == fed.table.owner_of(foreign)
+            assert reply["epoch"] == fed.table.epoch
+            # The redirect carries everything a single-slot client
+            # needs — and the session is NOT torn down by it.
+            owned = _owned_slot(fed, tier)
+            assert _req(sock, {"op": "put", "slot": owned,
+                               "value": 7,
+                               "epoch": fed.table.epoch},
+                        codec) == {"ok": True}
+            assert _req(sock, {"op": "get", "slot": owned,
+                               "epoch": fed.table.epoch},
+                        codec)["value"] == 7
+            send_frame(sock, {"op": "bye"}, None, codec)
+
+
+def test_pre_federation_session_is_proxied():
+    """A session that never negotiated the federation cap cannot
+    parse `moved`; the server must forward the op to the owner and
+    relay the ack — pre-federation clients keep working unchanged."""
+    with FederatedTier(N_SLOTS, partitions=2,
+                       flush_interval=0.002) as fed:
+        tier = fed.tiers[0]
+        foreign = _foreign_slot(fed, tier)
+        with socket.create_connection((tier.host, tier.port),
+                                      timeout=10.0) as sock:
+            sock.settimeout(10.0)
+            # No hello at all: the oldest client generation.
+            assert _req(sock, {"op": "put", "slot": foreign,
+                               "value": 9}) == {"ok": True}
+            assert _req(sock, {"op": "get",
+                               "slot": foreign})["value"] == 9
+            send_frame(sock, {"op": "bye"})
+        # The write really lives on the owning tier, not the proxy.
+        owner = fed.tier_at(fed.table.owner_of(foreign))
+        with socket.create_connection((owner.host, owner.port),
+                                      timeout=10.0) as sock:
+            sock.settimeout(10.0)
+            assert _req(sock, {"op": "get",
+                               "slot": foreign})["value"] == 9
+            send_frame(sock, {"op": "bye"})
+
+
+def test_stale_epoch_refused_even_on_owned_slot():
+    """After a split bumps the epoch, an op stamped with the old
+    epoch answers `moved` even when the slot's owner did not change —
+    the refusal that forces a table refetch before a write can race a
+    migrating range."""
+    with FederatedTier(N_SLOTS, partitions=2,
+                       flush_interval=0.002) as fed:
+        tier = fed.tiers[0]
+        sock, codec = _fed_session(tier)
+        with sock:
+            assert _req(sock, {"op": "put", "slot": 3, "value": 1,
+                               "epoch": 0}, codec) == {"ok": True}
+            split = fed.split_hot(src=0)
+            assert split["epoch"] == 1
+            # Slot 3 sits in the donor's KEPT half: same owner, new
+            # epoch. The stale stamp must still be refused.
+            assert fed.table.owner_of(3) == tier.router.addr
+            reply = _req(sock, {"op": "put", "slot": 3, "value": 2,
+                                "epoch": 0}, codec)
+            assert reply["code"] == "moved"
+            assert reply["owner"] == tier.router.addr
+            assert reply["epoch"] == 1
+            # Re-stamped with the new epoch, the same op lands.
+            assert _req(sock, {"op": "put", "slot": 3, "value": 2,
+                               "epoch": 1}, codec) == {"ok": True}
+            send_frame(sock, {"op": "bye"}, None, codec)
+
+
+# --- client-side classification: moved is typed, never legacy ---
+
+def test_check_reply_moved_raises_typed_redirect():
+    reply = {"ok": False, "code": "moved", "owner": "10.0.0.2:7002",
+             "epoch": 5, "error": "slot 9 owned elsewhere"}
+    with pytest.raises(SyncRedirectError) as exc:
+        _check_reply("put", reply, "ok")
+    assert exc.value.owner == "10.0.0.2:7002"
+    assert exc.value.epoch == 5
+    # Retryable-by-construction: transport class, not a protocol
+    # rejection (a protocol error would poison the peer forever).
+    assert isinstance(exc.value, SyncTransportError)
+    assert not isinstance(exc.value, SyncProtocolError)
+
+
+def test_hello_moved_does_not_demote_to_legacy():
+    """A `moved` at hello must raise the typed redirect and leave the
+    connection un-demoted: the pre-hello fallback is for servers that
+    don't SPEAK hello, and a federated tier emphatically does."""
+    lsock = socket.create_server(("127.0.0.1", 0))
+    lsock.settimeout(10.0)
+    host, port = lsock.getsockname()[:2]
+
+    def serve_one():
+        conn, _ = lsock.accept()
+        with conn:
+            conn.settimeout(10.0)
+            recv_frame(conn, deadline=time.monotonic() + 10.0)
+            send_frame(conn, {"ok": False, "code": "moved",
+                              "owner": "10.0.0.9:7009", "epoch": 4})
+
+    t = threading.Thread(target=serve_one, daemon=True)
+    t.start()
+    conn = PeerConnection(host, port, timeout=5.0)
+    try:
+        with pytest.raises(SyncRedirectError) as exc:
+            conn.ensure()
+        assert exc.value.owner == "10.0.0.9:7009"
+        assert exc.value.epoch == 4
+        assert conn.legacy is False
+        assert conn.connected is False
+    finally:
+        conn.close()
+        t.join(timeout=10)
+        lsock.close()
+
+
+# --- watch fan-out ---
+
+def test_watch_fan_out_delivers_committed_writes():
+    with FederatedTier(N_SLOTS, partitions=2,
+                       flush_interval=0.002) as fed:
+        cli = FederatedClient(fed.addrs())
+        slot = _owned_slot(fed, fed.tiers[1])
+        owner = fed.table.owner_of(slot)
+        watch = cli.watch(owner, slots=[slot])
+        try:
+            cli.put(slot, 42)
+            deadline = time.monotonic() + 10.0
+            events = []
+            # Shared-tick packs are filtered client-side, so a pack
+            # carrying only other slots legally arrives empty.
+            while not events and time.monotonic() < deadline:
+                events = watch.next_event(timeout=10.0)
+            assert events == [(slot, 42)]
+            cli.delete(slot)
+            events = []
+            while not events and time.monotonic() < deadline:
+                events = watch.next_event(timeout=10.0)
+            assert events == [(slot, None)]
+        finally:
+            watch.close()
+            cli.close()
+
+
+# --- kill-and-restart split under a write storm ---
+
+class _ProxiedFed(FederatedTier):
+    """Arms a FaultProxy at the newly spawned recipient before the
+    split engine can dial it: `_spawn_tier` runs inside
+    `_split_locked` strictly before the `_Upstream(stream_addr)`
+    connect, so retargeting here cannot race the stream."""
+
+    def __init__(self, *args, proxy=None, **kw):
+        super().__init__(*args, **kw)
+        self._proxy = proxy
+
+    def _spawn_tier(self, index):
+        tier = super()._spawn_tier(index)
+        if self._proxy is not None and index >= self._n_initial:
+            self._proxy.target_port = tier.port
+        return tier
+
+
+def test_split_survives_mid_handoff_cut_with_zero_lost_writes():
+    """The acceptance drill: cut the migration stream mid-frame while
+    a write storm targets the migrating range. The split must retry
+    on a fresh connection (idempotent replay), complete, and every
+    acked write must read back — zero lost."""
+    sched = ScriptedSchedule([
+        # Connection 1 (the split engine's initial upstream): let the
+        # ~70-byte hello through, then cut the round-1 push mid-frame.
+        {"kind": "truncate", "after": 150},
+        # Connection 2+ (the retry): behave.
+        None,
+    ])
+    proxy = FaultProxy("127.0.0.1", 1, sched)   # retargeted at spawn
+    with proxy:
+        with _ProxiedFed(N_SLOTS, partitions=2,
+                         flush_interval=0.002, proxy=proxy) as fed:
+            cli = FederatedClient(fed.addrs())
+            # Seed the migrating half [64, 128) so round 1's pack is
+            # fat enough to trip the truncate.
+            for slot in range(64, 128):
+                cli.put(slot, slot)
+
+            storm_slots = (70, 90, 110, 127)
+            acked = {s: None for s in storm_slots}
+            stop = threading.Event()
+            failures = []
+
+            def storm():
+                scli = FederatedClient(fed.addrs())
+                v = 1000
+                try:
+                    while not stop.is_set():
+                        for s in storm_slots:
+                            v += 1
+                            scli.put(s, v)
+                            acked[s] = v
+                except Exception as e:     # pragma: no cover
+                    failures.append(e)
+                finally:
+                    scli.close()
+
+            t = threading.Thread(target=storm, daemon=True)
+            t.start()
+            try:
+                split = fed.split_hot(src=0, settle_rows=8,
+                                      dst_addr_override=(
+                                          f"{proxy.host}:{proxy.port}"))
+            finally:
+                stop.set()
+                t.join(timeout=30)
+
+            assert not failures, f"storm writes failed: {failures!r}"
+            assert proxy.counters.get("truncate", 0) >= 1, \
+                f"cut never fired: {proxy.counters}"
+            assert proxy.counters["connections"] >= 2   # reconnected
+            assert split["epoch"] == 1
+            assert split["migrated_rows"] >= 64
+            assert len(fed.tiers) == 3
+            assert fed.table.owner_of(64) == fed.tiers[2].router.addr
+
+            # Zero lost writes: per-slot values are monotone, so the
+            # last ACK is exactly what a read must return — from the
+            # NEW owner, post-migration.
+            cli.refresh()
+            for slot in range(64, 128):
+                want = acked.get(slot)
+                if want is None:
+                    want = slot            # seed value, never stormed
+                assert cli.get(slot) == want, f"slot {slot}"
+            cli.close()

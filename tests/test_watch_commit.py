@@ -1,0 +1,77 @@
+"""Watch staleness regressions (docs/FEDERATION.md "Waking watch"):
+change events must carry the COMMITTED, post-dedup, semantics-decoded
+value — never a raw int64 lane and never a staged write the combiner
+later collapsed. These pin the host-side fix that lets the serving
+tier's fan-out path reuse the same hub without re-deriving values."""
+
+from crdt_tpu import DenseCrdt
+from crdt_tpu.testing import FakeClock
+
+BASE = 1_600_000_000_000
+
+
+def _dense(name="a", start=BASE):
+    return DenseCrdt(name, n_slots=64,
+                     wall_clock=FakeClock(start=start))
+
+
+def test_ingest_lww_events_are_post_dedup():
+    # Two staged writes to one slot collapse last-wins in the
+    # combiner; the watcher must see ONE committed event with the
+    # winner, not an event per staged put (the pre-fix behavior
+    # leaked the intermediate value).
+    c = _dense()
+    s = c.watch().record()
+    with c.ingest():
+        c.put_batch([3], [1])
+        c.put_batch([3], [2])
+        c.put_batch([4], [7])
+    assert s.events == [(3, 2), (4, 7)]
+
+
+def test_counter_events_decode_not_raw_lanes():
+    # A pncounter lane packs (pos << 32) | neg; an event carrying the
+    # raw lane would hand a watcher a ~2**33 integer for a counter
+    # sitting at 2. Events must decode through the slot's semantics.
+    c = _dense()
+    c.set_semantics([5], "pncounter")
+    s = c.watch().record()
+    c.counter_add(5, 3)
+    c.counter_add(5, -1)
+    assert s.events == [(5, 3), (5, 2)]
+
+
+def test_ingest_counter_event_decodes_committed_value():
+    c = _dense()
+    c.set_semantics([5], "pncounter")
+    s = c.watch().record()
+    with c.ingest():
+        c.counter_add(5, 4)
+    assert s.events == [(5, 4)]
+
+
+def test_merge_counter_event_decodes():
+    # Merge-path winners go through the same decode: a replica's
+    # counter arriving over anti-entropy must surface its value, not
+    # its encoding.
+    a = _dense("ma")
+    b = _dense("mb", start=BASE + 5)
+    for c in (a, b):
+        c.set_semantics([6], "pncounter")
+    b.counter_add(6, 9)
+    s = a.watch().record()
+    a.merge(*b.export_delta())
+    assert s.events == [(6, 9)]
+
+
+def test_merge_tombstone_still_none_on_typed_slot():
+    a = _dense("ta")
+    b = _dense("tb", start=BASE + 5)
+    for c in (a, b):
+        c.set_semantics([7], "pncounter")
+    a.counter_add(7, 1)
+    b.counter_add(7, 2)
+    b.delete_batch([7])
+    s = a.watch(slot=7).record()
+    a.merge(*b.export_delta())
+    assert s.events[-1] == (7, None)
