@@ -81,6 +81,12 @@ def main() -> None:
     ap.add_argument("--keys", type=int, default=1 << 18)
     ap.add_argument("--rows", type=int, default=64)
     ap.add_argument("--out", default="MULTICHIP_SCALE_r05.json")
+    ap.add_argument("--trajectory", metavar="JSONL", default=None,
+                    help="trajectory file to append a normalized "
+                         "record to (default: the shared "
+                         "benchmarks/history series)")
+    ap.add_argument("--no-trajectory", action="store_true",
+                    help="skip the trajectory append")
     args = ap.parse_args()
     n, rows = args.keys, args.rows
 
@@ -291,6 +297,22 @@ def main() -> None:
     with open(args.out, "w") as f:
         json.dump(result, f, indent=1)
     print(json.dumps(result))
+
+    if not args.no_trajectory:
+        # One normalized trajectory record, under an honest host
+        # class: the "-virtualmesh" suffix marks every number as
+        # measured on xla_force_host_platform virtual devices
+        # time-slicing this host's core(s), so the series never
+        # compares it against (or gates) real-hardware runs.
+        from crdt_tpu.obs import trajectory as _traj
+        flat = dict(result)
+        flat["weak_scaling"] = {  # list -> flattenable per-width dict
+            f"d{row['devices']}": row for row in curve}
+        _traj.append_record(
+            _traj.normalize_record(
+                "multichip-scale", flat,
+                host=_traj.host_class() + "-virtualmesh"),
+            args.trajectory or _traj.TRAJECTORY_PATH)
 
 
 if __name__ == "__main__":

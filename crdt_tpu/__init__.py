@@ -32,7 +32,9 @@ from .models.dense_crdt import (DenseCrdt, PipelinedGuardError,
                                 ShardedDenseCrdt, sync_dense)
 from .models.keyed_dense import KeyedDenseCrdt
 from .models.sqlite_crdt import SqliteCrdt
-from .sync import sync, sync_json, sync_merkle, sync_packed
+from .sync import (sync, sync_collective, sync_json, sync_merkle,
+                   sync_packed)
+from .collective import CollectiveGroup, CollectiveJoinReport
 from .net import (FrameCodec, PeerConnection, SyncError,
                   SyncProtocolError, SyncRedirectError, SyncServer,
                   SyncTransportError, WireTally, fetch_metrics,
@@ -60,7 +62,9 @@ __all__ = [
     "ChangeStream", "MapCrdt", "TpuMapCrdt", "DenseCrdt",
     "ShardedDenseCrdt", "KeyedDenseCrdt", "PipelinedGuardError",
     "sync_dense", "SqliteCrdt",
-    "sync", "sync_json", "sync_packed", "sync_merkle", "SyncServer",
+    "sync", "sync_json", "sync_packed", "sync_merkle",
+    "sync_collective", "CollectiveGroup", "CollectiveJoinReport",
+    "SyncServer",
     "sync_dense_over_tcp", "sync_over_tcp",
     "PeerConnection", "FrameCodec", "PackedDelta",
     "sync_over_conn", "sync_dense_over_conn", "sync_packed_over_conn",

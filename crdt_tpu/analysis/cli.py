@@ -78,17 +78,25 @@ def _registry_completeness() -> List:
 _FASTPATH_REQUIRED = (
     "dense.merge_repack_step",
     "pallas.ingest_scatter_tiles[interpret]",
+    "parallel.collective_join[member2]",
 )
 
 
 def _fastpath_completeness(target_names) -> List:
-    """The fast-path CI gate: the fused merge+repack program and the
-    touched-tile ingest scatter must be registered audit targets — an
-    unregistered fast-path kernel fails the default run."""
+    """The fast-path CI gate: the fused merge+repack program, the
+    touched-tile ingest scatter and the pod-local collective join must
+    be registered audit targets — an unregistered fast-path kernel
+    fails the default run. The collective target needs a 2-device
+    member mesh, so on a single-device host it is exempt rather than
+    spuriously missing."""
     from .findings import Finding
     names = set(target_names)
     out = []
     for req in _FASTPATH_REQUIRED:
+        if req.startswith("parallel.collective_join"):
+            import jax
+            if len(jax.devices()) < 2:
+                continue
         if req not in names:
             out.append(Finding(
                 rule="fastpath-kernel-unregistered",
@@ -156,6 +164,8 @@ _LEDGER_REQUIRED = (
     "parallel.sharded_fanin", "parallel.sharded_pallas_fanin",
     "parallel.sharded_ingest", "parallel.sharded_digest",
     "parallel.sharded_delta_mask", "parallel.sharded_max_logical_time",
+    # parallel/collective.py — the pod-local group join
+    "parallel.collective_join",
 )
 
 

@@ -71,6 +71,13 @@ reproduced bugs):
   it; such a write skips the ``moved``/stale-epoch admission gate and
   can land on a partition that no longer owns the slot mid-split
   (docs/FEDERATION.md).
+- ``collective-socket-fallback-silent`` — in a class carrying a
+  pod-local replica group (``self._group`` assigned in ``__init__``),
+  a ``try`` that attempts the collective join with an except-handler
+  that neither counts the downgrade
+  (``crdt_tpu_collective_fallback_total`` / ``stats.fallbacks``) nor
+  re-raises; a co-located round silently landing on the socket path
+  is an invisible topology regression (docs/COLLECTIVE.md).
 
 The linter is purely lexical/AST — no imports of the linted code — so
 it runs on broken or unimportable files (the self-test fixtures).
@@ -105,6 +112,7 @@ RULES = (
     "async-blocking-call",
     "metric-name-unprefixed",
     "router-epoch-bypass",
+    "collective-socket-fallback-silent",
     "suppression-without-reason",
 )
 
@@ -783,6 +791,79 @@ def _check_router_bypass(tree: ast.AST, path: str) -> List[Finding]:
     return out
 
 
+# --- rule: collective-socket-fallback-silent ---
+
+# Lexical evidence that an except-handler counts the downgrade before
+# the round lands on sockets: the fallback counter's name, or a
+# fallbacks-stat bump.
+_COLLECTIVE_FALLBACK_EVIDENCE = ("collective_fallback", "fallbacks")
+
+
+def _check_collective_fallback(tree: ast.AST,
+                               path: str) -> List[Finding]:
+    """In a class that carries a pod-local replica group
+    (``self._group`` assigned in ``__init__``), a ``try`` that
+    attempts the collective lane (a ``.join()`` call on the group)
+    must count the downgrade in every handler that swallows the
+    failure — a co-located round silently landing on the socket path
+    is a topology regression no dashboard would ever show
+    (docs/COLLECTIVE.md). Handlers that re-raise are exempt: loud is
+    fine, silent is the finding."""
+    out: List[Finding] = []
+    for cls in ast.walk(tree):
+        if not isinstance(cls, ast.ClassDef):
+            continue
+        grouped = False
+        for fn in cls.body:
+            if isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)) \
+                    and fn.name == "__init__":
+                for n in ast.walk(fn):
+                    if isinstance(n, ast.Attribute) \
+                            and n.attr == "_group" \
+                            and isinstance(n.value, ast.Name) \
+                            and n.value.id == "self" \
+                            and isinstance(n.ctx, ast.Store):
+                        grouped = True
+        if not grouped:
+            continue
+        for fn in cls.body:
+            if not isinstance(fn, (ast.FunctionDef,
+                                   ast.AsyncFunctionDef)):
+                continue
+            for tr in ast.walk(fn):
+                if not isinstance(tr, ast.Try):
+                    continue
+                joins = [n for stmt in tr.body for n in ast.walk(stmt)
+                         if isinstance(n, ast.Call)
+                         and isinstance(n.func, ast.Attribute)
+                         and n.func.attr == "join"
+                         and "group" in (_dotted(n.func.value) or "")]
+                if not joins:
+                    continue
+                for handler in tr.handlers:
+                    body_src = ast.unparse(ast.Module(
+                        body=handler.body, type_ignores=[]))
+                    if any(isinstance(n, ast.Raise)
+                           for stmt in handler.body
+                           for n in ast.walk(stmt)):
+                        continue
+                    if any(ev in body_src
+                           for ev in _COLLECTIVE_FALLBACK_EVIDENCE):
+                        continue
+                    out.append(Finding(
+                        rule="collective-socket-fallback-silent",
+                        path=path, line=handler.lineno,
+                        message=f"{fn.name}() catches a failed "
+                                "collective join without counting the "
+                                "downgrade — the round lands on the "
+                                "socket path invisibly; increment "
+                                "crdt_tpu_collective_fallback_total "
+                                "(or peer.stats.fallbacks) in the "
+                                "handler, or re-raise "
+                                "(docs/COLLECTIVE.md)"))
+    return out
+
+
 _ALL_CHECKS = (
     _check_sockets,
     _check_lock_discipline,
@@ -796,6 +877,7 @@ _ALL_CHECKS = (
     _check_async_blocking,
     _check_metric_names,
     _check_router_bypass,
+    _check_collective_fallback,
 )
 
 

@@ -405,6 +405,25 @@ def builtin_targets(include_sharded: bool = True) -> List[AuditTarget]:
                       "_pallas_fanin_block); trace-only",
                 build=_build_sharded_pallas_fanin))
 
+    # The pod-local collective join only needs a 2-member mesh, so it
+    # audits on any multi-device (or virtual-device) host even where
+    # the 8-device fan-in meshes cannot build.
+    if include_sharded and len(jax.devices()) >= 2:
+        try:
+            from ..parallel import collective as _pc  # noqa: F401
+            have_collective = True
+        except ImportError:
+            have_collective = False
+        if have_collective:
+            targets.append(AuditTarget(
+                name="parallel.collective_join[member2]",
+                notes="pod-local group anti-entropy: shard_map lex-max "
+                      "clock join + typed-semantics collectives "
+                      "(gcounter/pncounter/orset pmax, mvreg "
+                      "all_gather union) + in-program digest leaves "
+                      "(parallel/collective.py, docs/COLLECTIVE.md)",
+                build=_build_collective_join))
+
     return targets
 
 
@@ -502,6 +521,32 @@ def _sharded_args(n_per_shard: int):
                         tomb=np.zeros((r, n), bool),
                         valid=np.zeros((r, n), bool))
     return mesh, store, cs
+
+
+def _build_collective_join():
+    import jax
+    import numpy as np
+    from ..ops.dense import DenseStore
+    from ..parallel import collective as pc
+
+    mesh = pc.make_collective_mesh(2)
+
+    def member_store():
+        return DenseStore(lt=np.zeros(_N, np.int64),
+                          node=np.zeros(_N, np.int32),
+                          val=np.zeros(_N, np.int64),
+                          mod_lt=np.zeros(_N, np.int64),
+                          mod_node=np.zeros(_N, np.int32),
+                          occupied=np.zeros(_N, bool),
+                          tomb=np.zeros(_N, bool))
+
+    # has_sem=True so the audit walks every typed join branch (the
+    # untyped program is a strict subset). Trace the jitted program
+    # itself — the host wrapper only adds ledger accounting.
+    step = pc.make_collective_join(mesh, True, 8, donate=False)
+    return jax.make_jaxpr(step.jitted)(
+        (member_store(), member_store()), np.zeros(_N, np.int8),
+        np.zeros(2, np.int64), np.zeros(2, np.int32), np.int64(0))
 
 
 def _build_sharded_fanin():

@@ -75,6 +75,14 @@ class Counter:
             items = list(self._values.items())
         return [{"labels": dict(k), "value": v} for k, v in items]
 
+    def _state(self) -> Dict[_LabelKey, float]:
+        with self._lock:
+            return dict(self._values)
+
+    def _restore(self, state: Dict[_LabelKey, float]) -> None:
+        with self._lock:
+            self._values = dict(state)
+
 
 class Gauge:
     """Point-in-time value with optional labels (set or add)."""
@@ -108,6 +116,14 @@ class Gauge:
         with self._lock:
             items = list(self._values.items())
         return [{"labels": dict(k), "value": v} for k, v in items]
+
+    def _state(self) -> Dict[_LabelKey, float]:
+        with self._lock:
+            return dict(self._values)
+
+    def _restore(self, state: Dict[_LabelKey, float]) -> None:
+        with self._lock:
+            self._values = dict(state)
 
 
 class Histogram:
@@ -158,6 +174,16 @@ class Histogram:
                  "overflow": counts[len(self.bounds)],
                  "count": count, "sum": total}
                 for k, (counts, count, total) in items]
+
+    def _state(self) -> Dict[_LabelKey, list]:
+        with self._lock:
+            return {k: [list(s[0]), s[1], s[2]]
+                    for k, s in self._series.items()}
+
+    def _restore(self, state: Dict[_LabelKey, list]) -> None:
+        with self._lock:
+            self._series = {k: [list(s[0]), s[1], s[2]]
+                            for k, s in state.items()}
 
 
 class MetricsRegistry:
@@ -263,6 +289,38 @@ class MetricsRegistry:
             out["stats"].setdefault(kind, []).append(
                 {"labels": labels, "values": values})
         return out
+
+
+    def state_snapshot(self) -> Tuple[Dict[str, Any], List]:
+        """Deep copy of every instrument's accumulated samples plus
+        the collector list — pair with :meth:`restore_state` to fence
+        a window of activity off from the rest of the process.
+
+        The intended consumer is test isolation: the registry is a
+        process-global, so (say) serve-tier ack latencies observed by
+        one test module would otherwise leak into another module's
+        fleet-poller SLO verdict, making outcomes depend on collection
+        order. Instruments themselves are never dropped on restore —
+        code holds direct references to them — only their sample state
+        is rolled back (instruments born inside the window restore to
+        empty)."""
+        with self._lock:
+            instruments = dict(self._instruments)
+            collectors = list(self._collectors)
+        return ({name: inst._state()
+                 for name, inst in instruments.items()}, collectors)
+
+    def restore_state(self, snap: Tuple[Dict[str, Any], List]) -> None:
+        """Roll every instrument back to a :meth:`state_snapshot`.
+        Instruments registered since the snapshot stay registered
+        (cached references elsewhere must keep working) but lose their
+        samples; collectors attached since are detached."""
+        inst_state, collectors = snap
+        with self._lock:
+            instruments = dict(self._instruments)
+            self._collectors = list(collectors)
+        for name, inst in instruments.items():
+            inst._restore(inst_state.get(name, {}))
 
 
 _DEFAULT = MetricsRegistry()

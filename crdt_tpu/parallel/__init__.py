@@ -1,7 +1,10 @@
 """Multi-device parallelism: replica fan-in and key-space sharding over
 a `jax.sharding.Mesh`, with XLA collectives riding ICI (DCN across
-slices). See `crdt_tpu.parallel.fanin` for the design."""
+slices). See `crdt_tpu.parallel.fanin` for the design and
+`crdt_tpu.parallel.collective` for the pod-local group join."""
 
+from .collective import (MEMBER_AXIS, CollectiveJoinResult,
+                         make_collective_join, make_collective_mesh)
 from .fanin import (KEY_AXIS, REPLICA_AXIS, SLICE_AXIS,
                     ShardedFaninResult, changeset_sharding,
                     make_fanin_mesh, make_multislice_fanin_mesh,
@@ -13,8 +16,10 @@ from .fanin import (KEY_AXIS, REPLICA_AXIS, SLICE_AXIS,
                     store_sharding)
 
 __all__ = [
-    "KEY_AXIS", "REPLICA_AXIS", "SLICE_AXIS", "ShardedFaninResult",
-    "changeset_sharding", "make_fanin_mesh",
+    "KEY_AXIS", "MEMBER_AXIS", "REPLICA_AXIS", "SLICE_AXIS",
+    "CollectiveJoinResult", "ShardedFaninResult",
+    "changeset_sharding", "make_collective_join",
+    "make_collective_mesh", "make_fanin_mesh",
     "make_multislice_fanin_mesh", "make_sharded_fanin",
     "make_sharded_ingest", "make_sharded_pallas_fanin",
     "replica_extent", "shard_changeset", "shard_store",

@@ -1,0 +1,246 @@
+"""Pod-local collective anti-entropy: the lattice join as ONE
+donated, jit-cached ``shard_map`` program over a 1-D member mesh.
+
+The socket path replicates co-located members the same way it
+replicates cross-pod peers: pack → frame → loopback → unpack → merge,
+once per peer pair. But the state-based merge discipline says the
+lattice join IS the whole protocol — so for N replicas that share a
+mesh, anti-entropy can be an all-reduce instead of N(N-1) wire
+exchanges. This module composes that all-reduce from the exact join
+rules the pairwise kernels apply:
+
+- **clock lanes** — the lexicographic ``(lt, node)`` max, built from
+  primitive collectives the way `fanin._fanin_block` does: ``pmax``
+  lt → masked ``pmax`` node → stable ``pmin`` flat-rank tie →
+  one-hot ``psum`` winner broadcast. Ties on identical HLCs pick the
+  lowest member rank (identical events carry identical payloads by
+  the uniqueness invariant, so the pick is payload-neutral).
+- **value lane** — per-tag G-ary joins matching a pairwise fold of
+  `semantics.kernels.typed_join_lanes`: LWW takes the clock winner's
+  payload; gcounter is a plain ``pmax`` (0 is the join identity);
+  pncounter ``pmax``es each 31-bit half; orset ``pmax``es all 16
+  causal-length nibbles in one stacked collective; mvreg
+  ``all_gather``s the packs of members holding the winning lt and
+  folds `_mvreg_union` over them (the empty pack 0 is its identity).
+- **tomb / occupied** — the clock winner's flag; presence is the
+  member-axis OR.
+
+The post-join digest-tree leaves are computed in the SAME program
+(the joined lanes are replicated across members by construction, so
+each member digests its own output block and the levels come out
+``P()``), and per-member ``mod`` stamps + repack masks ride along —
+one dispatch yields everything `CollectiveGroup.join` needs to
+pre-seed the pack and digest caches exactly like `merge_and_repack`.
+
+On a real pod the member axis rides ICI; on the 1-core virtual mesh
+(tests, `bench.py --mode collective`) the same program runs across
+virtual CPU devices — bit-identical results, honest-downscale timing.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import NamedTuple, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from ..obs import device as _obs_device
+
+_obs_device.register("parallel.collective_join")
+
+from ..ops.dense import DenseStore, _NEG, _I32_NEG
+from ..ops.digest import (fold_leaves, slot_digests,
+                          tree_levels_from_leaves)
+from ..semantics.kernels import (ORSET_UNIVERSE, SEM_GCOUNTER,
+                                 SEM_MVREG, SEM_ORSET, SEM_PNCOUNTER,
+                                 _PN_HALF, _mvreg_union)
+from .fanin import Mesh, P, _BIG_RANK, _make_mesh, _shard_map
+
+#: The 1-D replica-group axis. Distinct from fanin's REPLICA_AXIS on
+#: purpose: a fan-in mesh shards *changeset rows*; a collective mesh
+#: places one whole member replica per device.
+MEMBER_AXIS = "member"
+
+
+class CollectiveJoinResult(NamedTuple):
+    new_canonical: jax.Array        # int64 scalar, replicated
+    win: jax.Array                  # bool[G, N] per-member changed mask
+    repack: jax.Array               # bool[G, N] mod_lt >= since mask
+    levels: Tuple[jax.Array, ...]   # post-join digest levels, root-first
+
+
+def make_collective_mesh(n_members: int, devices=None) -> Mesh:
+    """A 1-D ``(member,)`` mesh over the first ``n_members`` devices
+    (or the given device list)."""
+    if devices is None:
+        devices = jax.devices()[:n_members]
+    return _make_mesh((n_members,), (MEMBER_AXIS,), devices)
+
+
+def _typed_group_val(sem, occ, l_lt, l_val, m1, w_val):
+    """G-ary value join by tag over the member axis. Equal to folding
+    `typed_join_lanes`'s pairwise value rule over the group in any
+    order — each branch is a commutative/associative join with 0 as
+    the absent-member identity, which is exactly what lets it ride
+    collectives instead of a fold."""
+    ax = MEMBER_AXIS
+    gmax = jax.lax.pmax(l_val, ax)
+    pos = jax.lax.pmax((l_val >> 32) & _PN_HALF, ax)
+    neg = jax.lax.pmax(l_val & _PN_HALF, ax)
+    pn = (pos << 32) | neg
+    # orset: all 16 causal-length nibbles in ONE stacked pmax.
+    nibs = jnp.stack([(l_val >> (4 * i)) & 0xF
+                      for i in range(ORSET_UNIVERSE)])
+    g_nibs = jax.lax.pmax(nibs, ax)
+    ors = jnp.zeros_like(l_val)
+    for i in range(ORSET_UNIVERSE):
+        ors = ors | (g_nibs[i] << (4 * i))
+    # mvreg: strictly newer lt wins outright, equal lt unions — so
+    # only members holding the winning lt contribute their pack, and
+    # the union folds over a static G-row gather (0 = empty pack is
+    # the `_mvreg_union` identity).
+    cand = jnp.where(occ & (l_lt == m1), l_val, 0)
+    packs = jax.lax.all_gather(cand, ax)
+    mv = packs[0]
+    for g in range(1, packs.shape[0]):
+        mv = _mvreg_union(mv, packs[g])
+    out = w_val
+    out = jnp.where(sem == SEM_GCOUNTER, gmax, out)
+    out = jnp.where(sem == SEM_PNCOUNTER, pn, out)
+    out = jnp.where(sem == SEM_ORSET, ors, out)
+    out = jnp.where(sem == SEM_MVREG, mv, out)
+    return out
+
+
+def _join_block(leaf_width: int, has_sem: bool, store: DenseStore,
+                *rest):
+    """Per-member body under shard_map: lanes arrive [1, N], scalars
+    per member arrive [1]; ``sem`` (when present) and ``canonical_in``
+    are replicated."""
+    if has_sem:
+        sem, since, me, canonical_in = rest
+    else:
+        since, me, canonical_in = rest
+        sem = None
+    lt, node, val = store.lt[0], store.node[0], store.val[0]
+    occ, tomb = store.occupied[0], store.tomb[0]
+    mod_lt, mod_node = store.mod_lt[0], store.mod_node[0]
+    since_i, me_i = since[0], me[0]
+
+    # Mask absent slots to the join identities so an unoccupied member
+    # can never win a lane (mirrors reduce_replicas' valid masking).
+    l_lt = jnp.where(occ, lt, _NEG)
+    l_node = jnp.where(occ, node, _I32_NEG)
+    l_val = jnp.where(occ, val, 0)
+    l_tomb = occ & tomb
+
+    # Lexicographic (lt, node) max over the group — the fanin block's
+    # collective composition, verbatim.
+    ax = MEMBER_AXIS
+    m1 = jax.lax.pmax(l_lt, ax)
+    node_cand = jnp.where(l_lt == m1, l_node, _I32_NEG)
+    m2 = jax.lax.pmax(node_cand, ax)
+    has = (l_lt == m1) & (l_node == m2)
+    rank = jax.lax.axis_index(ax)
+    winner_rank = jax.lax.pmin(jnp.where(has, rank, _BIG_RANK), ax)
+    mine = has & (rank == winner_rank)
+    w_val = jax.lax.psum(jnp.where(mine, l_val, 0), ax)
+    w_tomb = jax.lax.psum(jnp.where(mine & l_tomb, 1, 0)
+                          .astype(jnp.int32), ax) > 0
+    g_occ = jax.lax.pmax(occ.astype(jnp.int32), ax) > 0
+
+    if has_sem:
+        v = _typed_group_val(sem, occ, l_lt, l_val, m1, w_val)
+    else:
+        v = w_val
+
+    # Unoccupied-everywhere slots keep this member's own (zero) lanes
+    # — never the _NEG/_I32_NEG sentinels.
+    lt_out = jnp.where(g_occ, m1, lt)
+    node_out = jnp.where(g_occ, m2, node)
+    val_out = jnp.where(g_occ, v, val)
+    tomb_out = jnp.where(g_occ, w_tomb, tomb)
+    occ_out = occ | g_occ
+
+    # Per-member adoption = changed-vs-own-input (the typed kernels'
+    # `win` semantics; for LWW lanes it coincides with the strict
+    # take mask, since adoption always moves lt or node or presence).
+    win = ((lt_out != lt) | (node_out != node) | (val_out != val)
+           | (tomb_out != tomb) | (occ_out & ~occ))
+
+    new_canonical = jnp.maximum(
+        canonical_in,
+        jax.lax.pmax(jnp.max(jnp.where(occ, lt, _NEG)), ax))
+    mod_lt_out = jnp.where(win, new_canonical, mod_lt)
+    mod_node_out = jnp.where(win, me_i, mod_node)
+    repack = occ_out & (mod_lt_out >= since_i)
+
+    # Post-join digest leaves in the SAME program: the replicated
+    # lanes are identical across members by construction, so every
+    # member digests its own output block and the row is P().
+    h = slot_digests(lt_out, val_out, tomb_out, occ_out,
+                     sem=sem, idx_offset=None)
+    leaves = fold_leaves(h, leaf_width)
+
+    new_store = DenseStore(
+        lt=lt_out[None], node=node_out[None], val=val_out[None],
+        mod_lt=mod_lt_out[None], mod_node=mod_node_out[None],
+        occupied=occ_out[None], tomb=tomb_out[None])
+    return (new_store, win[None], repack[None], new_canonical, leaves)
+
+
+@functools.lru_cache(maxsize=None)
+def make_collective_join(mesh: Mesh, has_sem: bool, leaf_width: int,
+                         donate: bool = False):
+    """Build the jitted single-dispatch collective join for a member
+    mesh.
+
+    Returns ``step(stores, [sem,] since, me, canonical_in) ->
+    (stacked_store, CollectiveJoinResult)`` where ``stores`` is a
+    G-tuple of per-member `DenseStore`s (G = mesh extent), ``sem`` is
+    the shared [N] int8 tag column (only when ``has_sem``), ``since``
+    and ``me`` are [G] per-member watermark lts / node ordinals, and
+    ``canonical_in`` is the max of the members' pre-join canonical
+    lts. The returned store is stacked [G, N]; replicated lanes are
+    identical across members, ``mod`` lanes are per-member.
+    ``donate=True`` consumes the input store buffers (gate it off on
+    CPU, where XLA ignores donation with a warning)."""
+    g = mesh.shape[MEMBER_AXIS]
+    store_spec = DenseStore(*([P(MEMBER_AXIS)]
+                              * len(DenseStore._fields)))
+    in_specs = ((store_spec,)
+                + ((P(),) if has_sem else ())
+                + (P(MEMBER_AXIS), P(MEMBER_AXIS), P()))
+    join = _shard_map(
+        functools.partial(_join_block, leaf_width, has_sem),
+        mesh=mesh, in_specs=in_specs,
+        out_specs=(store_spec, P(MEMBER_AXIS), P(MEMBER_AXIS),
+                   P(), P()),
+        check_vma=False)
+
+    def _step(stores, *args):
+        stacked = jax.tree_util.tree_map(
+            lambda *xs: jnp.stack(xs), *stores)
+        out_store, win, repack, canonical, leaves = join(stacked, *args)
+        return out_store, CollectiveJoinResult(
+            new_canonical=canonical, win=win, repack=repack,
+            levels=tree_levels_from_leaves(leaves))
+
+    jitted = jax.jit(_step, donate_argnums=(0,) if donate else ())
+
+    def step(stores, *args):
+        if len(stores) != g:
+            raise ValueError(
+                f"collective join over a {g}-member mesh got "
+                f"{len(stores)} stores")
+        with _obs_device.record("parallel.collective_join",
+                                dim=stores[0].lt.shape[0],
+                                donated=(stores[0].lt if donate
+                                         else None)):
+            return jitted(stores, *args)
+
+    # The raw jitted program, for jaxpr tracing (analysis/jaxpr_audit)
+    # without the ledger accounting a real dispatch carries.
+    step.jitted = jitted
+    return step

@@ -20,6 +20,10 @@ Three transports:
   (`DenseCrdt.pack_since` / `merge_packed`), the in-process twin of
   `net.sync_packed_over_conn` — same one-watermark round shape, no
   sockets. Both replicas must speak the packed form.
+
+Plus one group form: :func:`sync_collective` joins a whole
+mesh-co-located `CollectiveGroup` in ONE device dispatch — no wire
+form at all (docs/COLLECTIVE.md).
 """
 
 from __future__ import annotations
@@ -132,6 +136,25 @@ def sync_packed(local, remote, since=_SAME_ROUND) -> Hlc:
             else:
                 local.merge_packed(pulled, pulled_ids)
     return watermark
+
+
+def sync_collective(group):
+    """One anti-entropy round over a whole mesh-co-located replica
+    group as a SINGLE device dispatch — the in-process twin of the
+    gossip fast lane's collective round, for benches and tests that
+    want the group shape without a `GossipNode`.
+
+    Where :func:`sync_packed` converges one replica *pair* per call
+    (N replicas need O(N²) rounds through a connected topology), one
+    ``sync_collective(group)`` call lands every member of the
+    `crdt_tpu.collective.CollectiveGroup` on the joined state at once:
+    zero bytes to any wire, zero pack-path copies, pack and digest
+    caches pre-seeded (docs/COLLECTIVE.md). Returns the group's
+    `CollectiveJoinReport`."""
+    # `CollectiveGroup.join` carries its own "collective_join" span
+    # (kind="sync", round id, member count) — the trace shape this
+    # module's pairwise rounds set, one level up.
+    return group.join()
 
 
 class MerkleSyncReport:

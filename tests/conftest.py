@@ -21,3 +21,24 @@ import jax  # noqa: E402
 jax.config.update("jax_platforms", "cpu")
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(autouse=True, scope="module")
+def _isolate_metrics_registry():
+    """Fence each test module's metrics off from every other module.
+
+    The default registry is a process-global: serve tiers observe ack
+    latencies into it, gossip nodes count rounds, the fleet poller's
+    SLO verdict reads whatever has accumulated. Without isolation,
+    outcomes depend on module collection order (test_serve_federation
+    once had to *sort after* test_obs.py). Snapshot on module entry,
+    restore on exit — samples recorded inside the module vanish,
+    instruments and cached references stay valid."""
+    from crdt_tpu.obs.registry import default_registry
+
+    reg = default_registry()
+    snap = reg.state_snapshot()
+    yield
+    reg.restore_state(snap)

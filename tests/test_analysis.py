@@ -147,6 +147,69 @@ def test_combiner_bypass_drain_gate_passes():
     assert "scatter-combiner-bypass" not in rules
 
 
+def test_collective_fallback_silent_flagged():
+    src = (
+        "class Node:\n"
+        "    def __init__(self, group):\n"
+        "        self._group = group\n"
+        "    def round(self):\n"
+        "        try:\n"
+        "            self._group.join()\n"
+        "        except Exception:\n"
+        "            return self.socket_round()\n")
+    findings = [f for f in lint_source(src, "snippet.py")
+                if f.rule == "collective-socket-fallback-silent"]
+    assert len(findings) == 1
+    assert "crdt_tpu_collective_fallback_total" in findings[0].message
+
+
+def test_collective_fallback_counted_passes():
+    src = (
+        "class Node:\n"
+        "    def __init__(self, group):\n"
+        "        self._group = group\n"
+        "    def round(self):\n"
+        "        try:\n"
+        "            self._group.join()\n"
+        "        except Exception:\n"
+        "            self.counter('crdt_tpu_collective_fallback_total')"
+        ".inc()\n"
+        "            return self.socket_round()\n")
+    rules = {f.rule for f in lint_source(src, "snippet.py")}
+    assert "collective-socket-fallback-silent" not in rules
+
+
+def test_collective_fallback_reraise_passes():
+    # Loud is fine: a handler that re-raises never hides the downgrade.
+    src = (
+        "class Node:\n"
+        "    def __init__(self, group):\n"
+        "        self._group = group\n"
+        "    def round(self):\n"
+        "        try:\n"
+        "            self._group.join()\n"
+        "        except Exception:\n"
+        "            raise\n")
+    rules = {f.rule for f in lint_source(src, "snippet.py")}
+    assert "collective-socket-fallback-silent" not in rules
+
+
+def test_collective_fallback_outside_grouped_class_not_flagged():
+    # Without a pod-local group on the class, a .join() in a try is
+    # unrelated (thread.join, path join on an object named group_dir).
+    src = (
+        "class Worker:\n"
+        "    def __init__(self):\n"
+        "        self._threads = []\n"
+        "    def stop(self):\n"
+        "        try:\n"
+        "            self.group_thread.join()\n"
+        "        except Exception:\n"
+        "            pass\n")
+    rules = {f.rule for f in lint_source(src, "snippet.py")}
+    assert "collective-socket-fallback-silent" not in rules
+
+
 def test_combiner_bypass_staging_branch_passes():
     # put_batch's shape: branch on the staging handle, fall through to
     # the direct scatter only when no window is open.
@@ -541,12 +604,31 @@ def test_cli_json_clean_on_shipped_tree():
 
 def test_fastpath_completeness_gate_fails_on_missing_kernel():
     from crdt_tpu.analysis.cli import _fastpath_completeness
-    findings = _fastpath_completeness(["dense.merge_repack_step"])
+    findings = _fastpath_completeness(
+        ["dense.merge_repack_step",
+         "parallel.collective_join[member2]"])
     assert [f.rule for f in findings] == ["fastpath-kernel-unregistered"]
     assert "ingest_scatter_tiles" in findings[0].message
     assert _fastpath_completeness(
         ["dense.merge_repack_step",
-         "pallas.ingest_scatter_tiles[interpret]"]) == []
+         "pallas.ingest_scatter_tiles[interpret]",
+         "parallel.collective_join[member2]"]) == []
+
+
+def test_fastpath_completeness_requires_collective_on_multidevice():
+    # The collective-join audit target only exists on >= 2 devices
+    # (the shard_map needs a member mesh); under the 8-virtual-device
+    # test platform its absence must be a finding like any other
+    # required kernel's.
+    import jax
+    if len(jax.devices()) < 2:
+        pytest.skip("single-device host: requirement is exempt")
+    from crdt_tpu.analysis.cli import _fastpath_completeness
+    findings = _fastpath_completeness(
+        ["dense.merge_repack_step",
+         "pallas.ingest_scatter_tiles[interpret]"])
+    assert [f.rule for f in findings] == ["fastpath-kernel-unregistered"]
+    assert "collective_join" in findings[0].message
 
 
 def test_ledger_completeness_gate_fails_on_missing_kernel():

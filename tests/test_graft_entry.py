@@ -9,6 +9,7 @@ subprocess re-exec fallback (env NOT preconfigured, as under the driver).
 import os
 import subprocess
 import sys
+import time
 
 import jax
 
@@ -34,13 +35,30 @@ def test_dryrun_multichip_subprocess_reexec():
     # Simulate the driver: a process whose backend is already live and
     # whose XLA_FLAGS lack the virtual-device count. dryrun_multichip
     # must re-exec itself in a correctly-configured child and succeed.
+    #
+    # JAX_PLATFORMS=cpu stays SET in the child: the re-exec trigger is
+    # the missing xla_force_host_platform_device_count flag, which this
+    # env still omits — but an unset JAX_PLATFORMS would send the
+    # child's `jax.devices()` probing for real accelerators, and on a
+    # TPU-capable host that probe blocks for minutes before falling
+    # back (the tier-1 ~8-minute stall this test once caused).
     env = {k: v for k, v in os.environ.items() if k != "XLA_FLAGS"}
-    env.pop("JAX_PLATFORMS", None)
+    env["JAX_PLATFORMS"] = "cpu"
     code = ("import jax; jax.devices(); "
             "import __graft_entry__ as g; g.dryrun_multichip(4); "
             "print('SUBPROC_GATE_OK')")
     here = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    start = time.monotonic()
     proc = subprocess.run([sys.executable, "-c", code], cwd=here, env=env,
                           capture_output=True, text=True, timeout=570)
+    elapsed = time.monotonic() - start
     assert proc.returncode == 0, proc.stderr[-2000:]
     assert "SUBPROC_GATE_OK" in proc.stdout
+    # Regression guard for the stall itself: with the platform pinned,
+    # the whole child+grandchild round trip is pure CPU compile work.
+    # Anything in the minutes range means a backend probe snuck back in
+    # and the tier-1 suite is blocking on device enumeration again.
+    assert elapsed < 120, (
+        f"dryrun re-exec took {elapsed:.0f}s — backend probing is "
+        "blocking the suite (JAX_PLATFORMS must stay pinned in every "
+        "child env)")

@@ -5,10 +5,11 @@ pre-federation sessions, the stale-epoch refusal that fences live
 splits, watch fan-out end-to-end, and a kill-and-restart split under
 a write storm proving zero acked writes are lost.
 
-Named to sort AFTER test_obs.py (like test_serve.py): serve tiers
-observe acks into the process-global metrics registry, and the fleet
-poller's SLO test reads that registry — a serve suite running first
-would fail its verdict with this file's latency samples."""
+Metrics recorded here stay here: the conftest registry-isolation
+fixture snapshots and restores the process-global registry around
+each module, so this suite's ack latency samples cannot leak into
+another module's fleet-poller SLO verdict (modules may run in any
+order)."""
 
 import socket
 import threading
