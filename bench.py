@@ -1733,6 +1733,261 @@ def bench_federate(sessions: int = 100000, partitions: int = 4,
     }
 
 
+def bench_failover(replicas: int = 3, ack_replicas: int = 1,
+                   writers: int = 8, slots_per_writer: int = 8,
+                   kills: int = 3, rate_hz: float = 100.0,
+                   n_slots: int = 1 << 10,
+                   flush_interval: float = 0.002,
+                   heartbeat_interval: float = 0.03,
+                   lease_misses: int = 3,
+                   mttr_budget_s: float = 2.5,
+                   settle_s: float = 8.0) -> dict:
+    """Chaos bench: kill the primary of a replica group under a
+    sustained client write storm, ``kills`` times in a row.
+
+    One `ReplicaGroup` (docs/REPLICATION.md) serves a single-arc
+    keyspace; ``writers`` client threads write monotone values to
+    disjoint slots through the routed `FederatedClient` retry loop.
+    Each cycle abruptly kills the live primary (RST, no drain),
+    measures client-observed MTTR (kill -> first acked write at a
+    bumped routing epoch), verifies every write acked before the
+    kill is still readable from the new primary, then rejoins the
+    corpse as a follower. Gates: zero acked writes lost, the routing
+    epoch advances on every failover, all MTTRs within budget, and
+    all replicas end digest-root convergent."""
+    import threading
+
+    from crdt_tpu import FederatedClient
+    from crdt_tpu.obs.fleet import evaluate_slo, poll_fleet
+    from crdt_tpu.obs.trajectory import host_class
+    from crdt_tpu.replication import ReplicaGroup
+
+    assert writers * slots_per_writer < n_slots - 1
+
+    # Pre-warm the jit caches every measured path hits (process-
+    # global, so one pass covers all replicas and every rejoin
+    # generation): padded-commit buckets for the flush tick,
+    # pack/merge for the write-concern barrier ship, digest_tree for
+    # election tie-breaks and the rejoin merkle walk. A first-contact
+    # compile inside a failover window would read as fake MTTR and a
+    # fake ack p99 spike.
+    from crdt_tpu import DenseCrdt as _DC
+    wa = _DC("warm-a", n_slots=n_slots)
+    wb = _DC("warm-b", n_slots=n_slots)
+    for sz in (1, 2, 4, 8, 16, 32, 64, 128, 256, 512, 1024):
+        sz = min(sz, n_slots)
+        wa.put_batch(list(range(sz)), [1] * sz)
+        wa.drain_ingest()
+        packed, ids = wa.pack_since(None, sem_mode="include",
+                                    ranges=((0, n_slots),))
+        wb.merge_packed(packed, ids)
+    int(wa.digest_tree().root)
+    int(wb.digest_tree().root)
+    del wa, wb
+
+    group = ReplicaGroup(
+        n_slots, replicas=replicas, ack_replicas=ack_replicas,
+        flush_interval=flush_interval,
+        heartbeat_interval=heartbeat_interval,
+        heartbeat_timeout=heartbeat_interval * 5,
+        lease_misses=lease_misses)
+    group.start()
+    seeds = group.member_addrs()
+
+    stop = threading.Event()
+    lock = threading.Lock()
+    acks: list = []           # (t_mono, routing_epoch) append-only
+    last_acked: dict = {}     # slot -> highest acked value
+    counters = {"attempted": 0, "acked": 0, "retried": 0}
+    writer_errors: list = []
+
+    def writer(w: int) -> None:
+        cli = FederatedClient(seeds, timeout=5.0)
+        my = [w * slots_per_writer + j
+              for j in range(slots_per_writer)]
+        interval = 1.0 / rate_hz
+        i = 0
+        try:
+            while not stop.is_set():
+                slot = my[i % len(my)]
+                val = i + 1
+                with lock:
+                    counters["attempted"] += 1
+                try:
+                    cli.put(slot, val)
+                except (ConnectionError, ValueError):
+                    # Retry budget exhausted mid-failover. The write
+                    # was never acked, so it is NOT counted as loss;
+                    # the storm just re-offers on the next loop.
+                    with lock:
+                        counters["retried"] += 1
+                    time.sleep(0.05)
+                    continue
+                now = time.monotonic()
+                with lock:
+                    counters["acked"] += 1
+                    last_acked[slot] = val
+                    acks.append((now, cli.table.epoch))
+                i += 1
+                time.sleep(interval)
+        except Exception as exc:  # pragma: no cover - surfaced below
+            writer_errors.append(
+                f"writer{w}: {type(exc).__name__}: {exc}")
+        finally:
+            cli.close()
+
+    def read_floor(check: dict, whom: str) -> int:
+        """Count acked writes no longer readable (the zero-loss
+        gate): every slot must read back >= its last acked value."""
+        reader = FederatedClient(seeds, timeout=5.0)
+        try:
+            lost = 0
+            for slot, val in check.items():
+                got = reader.get(slot)
+                if got is None or int(got) < val:
+                    lost += 1
+            return lost
+        finally:
+            reader.close()
+
+    cycles: list = []
+    lost_total = 0
+    converged = False
+    try:
+        threads = [threading.Thread(target=writer, args=(w,),
+                                    daemon=True)
+                   for w in range(writers)]
+        for t in threads:
+            t.start()
+        deadline = time.monotonic() + 10.0
+        while time.monotonic() < deadline:
+            with lock:
+                if counters["acked"] >= writers:
+                    break
+            time.sleep(0.01)
+
+        for cycle in range(kills):
+            epoch_before = group.table.epoch
+            with lock:
+                checkpoint = dict(last_acked)
+                scan_from = len(acks)
+            dead = group.kill_primary()
+            t_kill = time.monotonic()
+
+            # Client-observed MTTR: first ack whose routing epoch is
+            # newer than the table the dead primary owned.
+            t_rec = None
+            deadline = t_kill + 30.0
+            while t_rec is None and time.monotonic() < deadline:
+                with lock:
+                    tail = acks[scan_from:]
+                for t, epoch in tail:
+                    if epoch > epoch_before:
+                        t_rec = t
+                        break
+                if t_rec is None:
+                    time.sleep(0.01)
+            if t_rec is None:
+                raise RuntimeError(
+                    f"cycle {cycle}: no acked write at a new epoch "
+                    f"within 30s of killing {dead.name}")
+            mttr = t_rec - t_kill
+            lost = read_floor(checkpoint, dead.name)
+            lost_total += lost
+            epoch_after = group.table.epoch
+            group.rejoin(dead.index)
+            cycles.append({
+                "cycle": cycle, "killed": dead.name,
+                "mttr_s": round(mttr, 4),
+                "detect_promote_s": round(group.last_failover_s, 4),
+                "epoch_before": epoch_before,
+                "epoch_after": epoch_after,
+                "acked_writes_lost": lost,
+            })
+
+        stop.set()
+        for t in threads:
+            t.join(timeout=10.0)
+        lost_total += read_floor(dict(last_acked), "final")
+
+        # Convergence: nudge writes re-arm the flush tick so the
+        # replicator ships every follower to head, then all live
+        # replicas must agree on one digest root.
+        nudge = FederatedClient(seeds, timeout=5.0)
+        try:
+            deadline = time.monotonic() + settle_s
+            bump = 0
+            while time.monotonic() < deadline:
+                bump += 1
+                nudge.put(n_slots - 1, bump)
+                time.sleep(max(flush_interval * 4, 0.02))
+                roots = []
+                for m in group.members:
+                    tier = m.tier
+                    if m.role == "down" or tier is None or tier.killed:
+                        continue
+                    with tier.lock:
+                        roots.append(int(tier.crdt.digest_tree().root))
+                if len(roots) == replicas and len(set(roots)) == 1:
+                    converged = True
+                    break
+        finally:
+            nudge.close()
+
+        peers = []
+        for m in group.members:
+            if m.addr is not None and m.role != "down":
+                host, port = m.addr.rsplit(":", 1)
+                peers.append((m.name, host, int(port)))
+        snapshots = poll_fleet(peers)
+        # Chaos-envelope ack budget (0.5 s, one log2 bucket above the
+        # replicate timeout): the p99 window deliberately contains
+        # every kill and every rejoin, and a rejoin's full-range
+        # merkle walk is served under the primary's store lock — a
+        # brief ack stall is the design, losing the write would be
+        # the bug. The steady-state 14.6 ms federate budget was never
+        # meant to price a catch-up walk.
+        slo = evaluate_slo(snapshots, ack_p99_budget_s=0.5)
+    finally:
+        stop.set()
+        group.stop()
+
+    mttrs = [c["mttr_s"] for c in cycles]
+    epochs_advanced = all(c["epoch_after"] > c["epoch_before"]
+                          for c in cycles)
+    return {
+        "metric": "failover_mttr", "unit": "s",
+        "platform": jax.devices()[0].platform,
+        "replicas": replicas, "ack_replicas": ack_replicas,
+        "writers": writers, "rate_per_writer_hz": rate_hz,
+        "kills": kills, "failovers": group.failovers,
+        "ops_attempted": counters["attempted"],
+        "ops_acked": counters["acked"],
+        "ops_retried": counters["retried"],
+        "mttr_s": mttrs,
+        "mttr_max_s": max(mttrs),
+        "detect_promote_s": [c["detect_promote_s"] for c in cycles],
+        "epoch_final": group.table.epoch,
+        "epoch_advanced_each_kill": epochs_advanced,
+        "acked_writes_lost": lost_total,
+        "rejoined_convergent": converged,
+        "writer_errors": writer_errors,
+        "cycles": cycles,
+        "mttr_budget_s": mttr_budget_s,
+        "within_budget": (lost_total == 0 and epochs_advanced
+                          and converged and not writer_errors
+                          and max(mttrs) <= mttr_budget_s),
+        "_slo": slo,
+        # All replicas time-slice one host's cores over loopback —
+        # detection and promotion pay no real network RTT, so this
+        # MTTR never gates against a real multi-host deployment.
+        "_host_class": host_class() + "-colocated",
+        "downscale_caveat": (
+            "replica group colocated on one host (loopback, shared "
+            "cores); MTTR excludes real network + scheduling jitter"),
+    }
+
+
 def bench_ingest(n_slots: int = 1 << 14, rows: int = 1024,
                  batches: int = 64, repeats: int = 24) -> dict:
     """Write-path fast lane: staged ingest() vs unbatched put_batch.
@@ -1998,7 +2253,8 @@ def main() -> None:
     ap.add_argument("--mode",
                     choices=("stream", "distinct", "e2e", "e2e-kernel",
                              "sync", "ingest", "types", "antientropy",
-                             "serve", "federate", "collective"),
+                             "serve", "federate", "failover",
+                             "collective"),
                     default="stream",
                     help="stream: write-stream replay (chunk replayed "
                          "with +1ms offsets); distinct: HBM-resident "
@@ -2027,7 +2283,12 @@ def main() -> None:
                          "partitions behind a FederatedTier, with a "
                          "live hot-partition split fired mid-run — "
                          "zero-dropped-writes and post-split ack p99 "
-                         "are the gates; collective: pod-local "
+                         "are the gates; failover: chaos bench — "
+                         "kill a replica group's primary under a "
+                         "client write storm, >=3 cycles; gates are "
+                         "zero acked writes lost, epoch advance per "
+                         "failover, MTTR within budget, root-"
+                         "convergent rejoin; collective: pod-local "
                          "single-dispatch group join over a virtual "
                          "member mesh vs the same-host sync_packed "
                          "loopback — wall time, dispatches-per-round "
@@ -2088,6 +2349,16 @@ def main() -> None:
             duration=3.0 if args.smoke else 12.0,
             warmup=1.0 if args.smoke else 3.0,
             recovery_s=1.0 if args.smoke else 3.0,
+            n_slots=1 << 10 if args.smoke else 1 << 14)
+    elif args.mode == "failover":
+        # >=3 kill cycles even in smoke: the acceptance gate is
+        # consecutive failovers, not throughput.
+        result = bench_failover(
+            replicas=args.replicas or 3,
+            writers=4 if args.smoke else 8,
+            slots_per_writer=4 if args.smoke else 8,
+            kills=3 if args.smoke else 5,
+            rate_hz=50.0 if args.smoke else 100.0,
             n_slots=1 << 10 if args.smoke else 1 << 14)
     elif args.mode == "types":
         result = bench_types(n_slots=1 << 10,

@@ -44,6 +44,7 @@ from .net import (FrameCodec, PeerConnection, SyncError,
 from .serve import ServeTier
 from .routing import PartitionRouter, RoutingTable
 from .federation import FederatedClient, FederatedTier
+from .replication import ReplicaGroup, Replicator
 from .ops.packing import PackedDelta
 from .obs import (MetricsRegistry, TraceRing, default_registry,
                   metrics_snapshot, tracer)
@@ -73,7 +74,7 @@ __all__ = [
     "SyncRedirectError", "WireTally",
     "fetch_metrics", "ServeTier",
     "RoutingTable", "PartitionRouter", "FederatedTier",
-    "FederatedClient",
+    "FederatedClient", "ReplicaGroup", "Replicator",
     "GossipNode", "Peer", "RetryPolicy", "BreakerPolicy", "CircuitBreaker",
     "load_dense", "load_json", "save_dense", "save_json",
     "load_gossip_state", "save_gossip_state",

@@ -113,6 +113,7 @@ RULES = (
     "metric-name-unprefixed",
     "router-epoch-bypass",
     "collective-socket-fallback-silent",
+    "ack-before-replicate",
     "suppression-without-reason",
 )
 
@@ -864,6 +865,79 @@ def _check_collective_fallback(tree: ast.AST,
     return out
 
 
+# --- rule: ack-before-replicate ---
+
+
+def _check_ack_before_replicate(tree: ast.AST,
+                                path: str) -> List[Finding]:
+    """In a class that carries a write-concern replicator
+    (``self.replicator`` assigned in ``__init__`` — the primary
+    serving surface), any method that resolves a client ack future
+    (``set_result``) must consult the replicator FIRST: an ack
+    resolved lexically before any ``self.replicator`` read (or a
+    ``.barrier()`` call) can reach the client before the tick's delta
+    is confirmed on any follower, and a primary crash then loses an
+    ACKED write — the exact failure write concern exists to exclude
+    (docs/REPLICATION.md). Matching the bare ``set_result`` attribute
+    (not just calls) also catches the callback-passing form
+    (``call_soon_threadsafe(fut.set_result, ...)``)."""
+    out: List[Finding] = []
+    for cls in ast.walk(tree):
+        if not isinstance(cls, ast.ClassDef):
+            continue
+        replicated = False
+        for fn in cls.body:
+            if isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)) \
+                    and fn.name == "__init__":
+                for n in ast.walk(fn):
+                    if isinstance(n, ast.Attribute) \
+                            and n.attr == "replicator" \
+                            and isinstance(n.value, ast.Name) \
+                            and n.value.id == "self" \
+                            and isinstance(n.ctx, ast.Store):
+                        replicated = True
+        if not replicated:
+            continue
+        for fn in cls.body:
+            if not isinstance(fn, (ast.FunctionDef,
+                                   ast.AsyncFunctionDef)) \
+                    or fn.name == "__init__":
+                continue
+            gate_line = None
+            acks: List[ast.Attribute] = []
+            for n in ast.walk(fn):
+                if isinstance(n, ast.Attribute) \
+                        and n.attr == "replicator" \
+                        and isinstance(n.value, ast.Name) \
+                        and n.value.id == "self" \
+                        and isinstance(n.ctx, ast.Load):
+                    if gate_line is None or n.lineno < gate_line:
+                        gate_line = n.lineno
+                if isinstance(n, ast.Call) \
+                        and isinstance(n.func, ast.Attribute) \
+                        and n.func.attr == "barrier":
+                    if gate_line is None or n.lineno < gate_line:
+                        gate_line = n.lineno
+                if isinstance(n, ast.Attribute) \
+                        and n.attr == "set_result" \
+                        and isinstance(n.ctx, ast.Load):
+                    acks.append(n)
+            for ack in acks:
+                if gate_line is None or ack.lineno < gate_line:
+                    out.append(Finding(
+                        rule="ack-before-replicate", path=path,
+                        line=ack.lineno,
+                        message=f"{fn.name}() resolves a client ack "
+                                "(set_result) without first "
+                                "consulting self.replicator — the "
+                                "ack can land before the "
+                                "write-concern barrier confirmed the "
+                                "tick on any follower, so a primary "
+                                "crash loses an ACKED write "
+                                "(docs/REPLICATION.md)"))
+    return out
+
+
 _ALL_CHECKS = (
     _check_sockets,
     _check_lock_discipline,
@@ -878,6 +952,7 @@ _ALL_CHECKS = (
     _check_metric_names,
     _check_router_bypass,
     _check_collective_fallback,
+    _check_ack_before_replicate,
 )
 
 

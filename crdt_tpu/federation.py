@@ -147,6 +147,15 @@ class FederatedTier:
     default builds a CPU-backed `DenseCrdt`. ``layout="even"`` (the
     bench default) gives equal contiguous shares; ``layout="hash"``
     places consistent-hash tokens (`RoutingTable.build`).
+
+    ``replicas > 1`` backs every partition with a
+    `replication.ReplicaGroup` (docs/REPLICATION.md): ``tiers[i]``
+    then tracks partition *i*'s current PRIMARY (so every existing
+    consumer — splits, hot ranking, `tier_at` — keeps working), and
+    a group promotion swaps the entry and republishes the table
+    fleet-wide through `_on_promote`. With a custom ``make_crdt`` and
+    ``replicas > 1`` the builder is called as
+    ``make_crdt(partition, replica, generation)``.
     """
 
     def __init__(self, n_slots: int, partitions: int = 4,
@@ -154,7 +163,12 @@ class FederatedTier:
                  flush_interval: float = 0.002,
                  max_sessions: int = 12000,
                  make_crdt=None, layout: str = "even",
-                 vnodes: int = 8, **tier_kw):
+                 vnodes: int = 8, replicas: int = 1,
+                 ack_replicas: int = 1,
+                 heartbeat_interval: float = 0.05,
+                 heartbeat_timeout: float = 0.25,
+                 lease_misses: int = 4,
+                 replicate_timeout: float = 0.25, **tier_kw):
         if partitions < 1:
             raise ValueError(
                 f"partitions must be >= 1; got {partitions}")
@@ -165,10 +179,20 @@ class FederatedTier:
         self._layout = layout
         self._vnodes = vnodes
         self._tier_kw = dict(tier_kw)
+        self._user_make_crdt = make_crdt
         self._make_crdt = make_crdt if make_crdt is not None \
             else self._default_crdt
         self._n_initial = partitions
+        self.replicas = int(replicas)
+        self.ack_replicas = int(ack_replicas)
+        self.heartbeat_interval = float(heartbeat_interval)
+        self.heartbeat_timeout = float(heartbeat_timeout)
+        self.lease_misses = int(lease_misses)
+        self.replicate_timeout = float(replicate_timeout)
         self.tiers: List[ServeTier] = []
+        # Parallel to `tiers`: the ReplicaGroup backing partition i,
+        # or None when replicas == 1 (the zero-overhead layout).
+        self.groups: List[Optional[object]] = []
         self.table: Optional[RoutingTable] = None
         self.last_split: Optional[dict] = None
         # Serializes splits and table publication against each other;
@@ -178,6 +202,12 @@ class FederatedTier:
     def _default_crdt(self, index: int):
         from .models.dense_crdt import DenseCrdt
         return DenseCrdt(f"fed-p{index}", self.n_slots)
+
+    def _replica_crdt(self, pi: int, ri: int, gen: int):
+        if self._user_make_crdt is not None:
+            return self._user_make_crdt(pi, ri, gen)
+        from .models.dense_crdt import DenseCrdt
+        return DenseCrdt(f"fed-p{pi}-r{ri}.{gen}", self.n_slots)
 
     # --- lifecycle ---
 
@@ -191,17 +221,45 @@ class FederatedTier:
         tier.router.bind(f"{tier.host}:{tier.port}")
         return tier
 
+    def _spawn_partition(self, index: int):
+        """Spawn partition ``index``: a bare tier when ``replicas ==
+        1`` (the pre-replication layout, zero added moving parts),
+        else a started `ReplicaGroup` whose primary tier is what the
+        fleet routes to. Returns ``(primary_tier, group_or_None)``."""
+        if self.replicas == 1:
+            return self._spawn_tier(index), None
+        from .replication import ReplicaGroup
+        grp = ReplicaGroup(
+            self.n_slots, replicas=self.replicas,
+            ack_replicas=self.ack_replicas, host=self.host,
+            group=f"p{index}",
+            make_crdt=lambda ri, gen, pi=index:
+                self._replica_crdt(pi, ri, gen),
+            flush_interval=self.flush_interval,
+            heartbeat_interval=self.heartbeat_interval,
+            heartbeat_timeout=self.heartbeat_timeout,
+            lease_misses=self.lease_misses,
+            replicate_timeout=self.replicate_timeout,
+            on_promote=self._on_promote,
+            tier_kwargs={"max_sessions": self.max_sessions,
+                         **self._tier_kw})
+        grp.start()
+        return grp.primary.tier, grp
+
     def start(self) -> "FederatedTier":
         try:
             for i in range(self._n_initial):
-                self.tiers.append(self._spawn_tier(i))
+                tier, grp = self._spawn_partition(i)
+                self.tiers.append(tier)
+                self.groups.append(grp)
             owners = [t.router.addr for t in self.tiers]
             if self._layout == "hash":
                 table = RoutingTable.build(self.n_slots, owners,
                                            vnodes=self._vnodes)
             else:
                 table = RoutingTable.even(self.n_slots, owners)
-            self.publish(table)
+            with self._control:
+                self.publish(table)
         except BaseException:
             self.stop()
             raise
@@ -209,9 +267,14 @@ class FederatedTier:
 
     def stop(self) -> None:
         tiers, self.tiers = self.tiers, []
-        for tier in tiers:
+        groups, self.groups = self.groups, []
+        for i, tier in enumerate(tiers):
+            grp = groups[i] if i < len(groups) else None
             try:
-                tier.stop()
+                if grp is not None:
+                    grp.stop()
+                else:
+                    tier.stop()
             except Exception:
                 pass
 
@@ -222,16 +285,78 @@ class FederatedTier:
         self.stop()
 
     def publish(self, table: RoutingTable) -> None:
-        """Install ``table`` on every tier (epoch-guarded, so an older
-        table never rolls a tier back) and refresh the fleet gauges.
-        The in-process analogue of the gossip path pre-federation
-        clients use (`GossipNode.attach_router`)."""
-        for tier in self.tiers:
-            tier.router.install(table)
+        """Install ``table`` on every tier — every group MEMBER for
+        replicated partitions, so followers answer ``moved`` with the
+        same epoch the primary serves — and refresh the fleet gauges
+        (epoch-guarded installs, so an older table never rolls a tier
+        back). Callers hold ``_control``; `install_table` is lock-free
+        on the group side, which is what keeps the promote path
+        (group lock → control lock) cycle-free."""
+        for i, tier in enumerate(self.tiers):
+            grp = self.groups[i] if i < len(self.groups) else None
+            if grp is not None:
+                grp.install_table(table)
+            else:
+                tier.router.install(table)
         self.table = table
         g_epoch, g_parts, _, _, _ = _metrics()
         g_epoch.set(float(table.epoch))
         g_parts.set(float(len(self.tiers)))
+
+    def _on_promote(self, group, table) -> None:
+        """Failover driver: a group monitor elected a new primary and
+        hands us its proposed table flip. Swap the partition's `tiers`
+        entry to the new primary and publish fleet-wide. Runs on the
+        group's monitor thread AFTER it released the group lock (see
+        `ReplicaGroup._promote`), so taking ``_control`` here cannot
+        deadlock against a split holding ``_control`` while polling
+        the group."""
+        with self._control:
+            idx = next((i for i, g in enumerate(self.groups)
+                        if g is group), None)
+            if idx is None:
+                return        # group already detached (stop/abort)
+            old_tier = self.tiers[idx]
+            new_tier = group.primary.tier
+            self.tiers[idx] = new_tier
+            current = self.table
+            if table is not None and (
+                    current is None or table.epoch > current.epoch):
+                fresh = table
+            else:
+                # The group's flip raced a concurrent epoch bump (a
+                # split published while the election ran) and lost
+                # the tie — re-derive the ownership move against the
+                # CURRENT table so the dead primary's arcs still land
+                # on the winner.
+                fresh = current
+                if current is not None:
+                    old_addr = old_tier.router.addr
+                    if old_addr in current.owners():
+                        fresh = current.reassign(
+                            old_addr, new_tier.router.addr)
+            if fresh is not None:
+                self.publish(fresh)
+
+    def _await_failover(self, group, dead_tier: ServeTier,
+                        timeout: float = 5.0) -> ServeTier:
+        """Block until ``group`` promotes a replacement for
+        ``dead_tier`` and return the new primary's tier. Used by the
+        post-flip drain when the donor dies mid-split; safe to call
+        while holding ``_control`` because `ReplicaGroup.primary`
+        only takes the group lock, which `_promote` releases before
+        it calls back into `_on_promote`."""
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            m = group.primary
+            if m is not None and m.tier is not None \
+                    and m.tier is not dead_tier \
+                    and not m.tier.killed:
+                return m.tier
+            time.sleep(group.heartbeat_interval)
+        raise ConnectionError(
+            f"group {group.group}: no replacement primary within "
+            f"{timeout}s of donor death")
 
     def addrs(self) -> List[str]:
         return [t.router.addr for t in self.tiers]
@@ -294,6 +419,8 @@ class FederatedTier:
         else:
             evidence = {"hot_index": src, "forced": True}
         donor = self.tiers[src]
+        donor_group = self.groups[src] if src < len(self.groups) \
+            else None
         donor_addr = donor.router.addr
         spans = self.table.ranges_of(donor_addr)
         if not spans:
@@ -304,19 +431,25 @@ class FederatedTier:
                 f"range [{lo}, {hi}) too narrow to split")
         mid = (lo + hi) // 2
 
-        recipient = self._spawn_tier(len(self.tiers))
+        recipient, recipient_group = self._spawn_partition(
+            len(self.tiers))
         self.tiers.append(recipient)
+        self.groups.append(recipient_group)
         dst_addr = recipient.router.addr
         stream_addr = dst_addr_override or dst_addr
 
         # Pre-flip: recipient must already believe the CURRENT table
         # (it is not an owner yet, so forwarded/foreign ops answer
         # moved instead of enqueueing) before any client can find it.
-        recipient.router.install(self.table)
+        if recipient_group is not None:
+            recipient_group.install_table(self.table)
+        else:
+            recipient.router.install(self.table)
 
         rounds = 0
         migrated = 0
         mark = None
+        flipped = False
         up = _Upstream(stream_addr)
         try:
             while rounds < _MAX_ROUNDS:
@@ -331,15 +464,46 @@ class FederatedTier:
             # post-flip drain; writes arriving after it answer moved.
             table = self.table.split(lo, mid, dst_addr)
             self.publish(table)
+            flipped = True
             flip_at = time.perf_counter()
             # Drain: anything the donor enqueued pre-flip commits
             # within one flush tick; wait it out, then ship the final
             # watermark round so the recipient holds every acked row.
             time.sleep(max(donor.flush_interval * 4, 0.01))
-            shipped, mark = self._ship_range(donor, up, mark,
-                                             (mid, hi))
+            try:
+                shipped, mark = self._ship_range(donor, up, mark,
+                                                 (mid, hi))
+            except ConnectionError:
+                if donor_group is None or not donor.killed:
+                    raise
+                # Donor crashed AFTER the flip: the table already
+                # names the recipient, so aborting would strand
+                # [mid, hi). Hand off: wait for the donor's group to
+                # promote (write concern means every acked row is on
+                # the winner) and re-drain the full range from the
+                # new primary — mark=None, because the watermark was
+                # taken against the dead store's clock.
+                donor = self._await_failover(donor_group, donor)
+                shipped, mark = self._ship_range(donor, up, None,
+                                                 (mid, hi))
             migrated += shipped
             rounds += 1
+        except BaseException:
+            if not flipped:
+                # Pre-flip abort: no client ever saw the recipient
+                # (the table never named it), so unwinding it IS the
+                # clean abort — the donor's group fails over on its
+                # own and the split can simply be retried.
+                self.tiers.pop()
+                grp = self.groups.pop()
+                try:
+                    if grp is not None:
+                        grp.stop()
+                    else:
+                        recipient.stop()
+                except Exception:
+                    pass
+            raise
         finally:
             up.close()
 
@@ -366,8 +530,14 @@ class FederatedTier:
         the watermark taken in the SAME hold so no commit can fall
         between pack and mark), ship via push_packed, return
         (rows, new_mark). Transport faults retry on a fresh
-        connection — the rows are idempotent lattice joins."""
+        connection — the rows are idempotent lattice joins. A KILLED
+        donor raises instead of packing: its in-process store object
+        is still addressable, but a real crash would not be, and the
+        split's abort/handoff paths key off this honesty."""
         from .ops.packing import pack_rows
+        if donor.killed:
+            raise ConnectionError(
+                f"donor {donor.host}:{donor.port} killed mid-stream")
         with donor.lock:
             wm = donor.crdt.canonical_time
             packed, ids = _pack_for_peer(donor.crdt, mark, True,
@@ -412,10 +582,18 @@ class FederatedClient:
     replays the op at the new owner. An op is reported successful
     ONLY on a positive ack from the tier that committed it — which is
     what makes "zero dropped writes" measurable from the client side.
+
+    Retries back off exponentially (10 ms doubling, capped at
+    250 ms), and the default attempt budget is sized so the loop
+    rides out a full replica-group failover (~2 s of cumulative
+    sleep against a sub-second promote; docs/REPLICATION.md) —
+    mid-failover, every path can fail at once: the old owner drops
+    connections, a fenced primary answers ``busy``, and ``refresh``
+    itself may find no reachable tier for a beat.
     """
 
     def __init__(self, seeds: List[str], timeout: float = 30.0,
-                 max_redirects: int = 8):
+                 max_redirects: int = 12):
         if not seeds:
             raise ValueError("need at least one seed address")
         self._seeds = list(seeds)
@@ -440,6 +618,18 @@ class FederatedClient:
         up = self._sessions.pop(addr, None)
         if up is not None:
             up.close()
+
+    def _backoff(self, attempt: int) -> None:
+        time.sleep(min(0.25, 0.01 * (1 << attempt)))
+
+    def _try_refresh(self) -> None:
+        """Refresh, absorbing total unreachability: mid-failover the
+        fleet can briefly answer nothing at all, and the op retry
+        loop — not this probe — owns the failure budget."""
+        try:
+            self.refresh()
+        except ConnectionError:
+            pass
 
     def refresh(self) -> RoutingTable:
         """Fetch the newest routing table from any reachable tier
@@ -469,15 +659,19 @@ class FederatedClient:
                   want_field: str = "ok") -> dict:
         if self.table is None:
             self.refresh()
-        for _ in range(self._max_redirects):
+        for attempt in range(self._max_redirects):
             owner = self.table.owner_of(slot)
             msg["epoch"] = self.table.epoch
             try:
                 reply = self._session(owner).request(msg)
+                if reply is None:
+                    # EOF without a reply frame: an abrupt kill (RST
+                    # or half-close) reads as None, not an exception.
+                    raise ConnectionError(f"{owner} closed mid-op")
             except (ConnectionError, OSError, ValueError):
                 self._drop_session(owner)
-                time.sleep(0.01)
-                self.refresh()
+                self._backoff(attempt)
+                self._try_refresh()
                 continue
             if isinstance(reply, dict) and reply.get("ok"):
                 return reply
@@ -488,11 +682,16 @@ class FederatedClient:
                 # and replay. (PeerConnection maps this same reply to
                 # SyncRedirectError; here we stay dict-level.)
                 self.moved_redirects += 1
-                self.refresh()
+                self._try_refresh()
                 continue
             if code == "busy":
+                # Routing flux, a write-concern barrier miss, or a
+                # FENCED ex-primary serving out its lease: back off,
+                # then refetch the table — a fence usually means the
+                # epoch has moved (or is about to) under us.
                 self.busy_retries += 1
-                time.sleep(0.01)
+                self._backoff(attempt)
+                self._try_refresh()
                 continue
             raise ValueError(f"op {msg.get('op')!r} rejected: "
                              f"{reply!r}")

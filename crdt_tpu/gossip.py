@@ -335,6 +335,10 @@ class GossipNode:
         # via attach_router so the routing table + epoch gossip on the
         # metrics/health surfaces pre-federation clients already poll.
         self._router = None
+        # Replica-group membership view (replication.ReplicaGroup's
+        # ServeTier): attached via attach_replication so role/lease
+        # ride the same metrics surface (docs/REPLICATION.md).
+        self._replica_tier = None
 
     # --- topology ---
 
@@ -873,6 +877,14 @@ class GossipNode:
         federation-aware session (docs/FEDERATION.md)."""
         self._router = router
 
+    def attach_replication(self, tier) -> None:
+        """Bind a replica-group member `ServeTier` so this node's
+        metrics op carries its group/role/lease state — the gossip
+        leg of replica-health distribution: the fleet poller learns
+        which member is primary without a group-aware session
+        (docs/REPLICATION.md)."""
+        self._replica_tier = tier
+
     def _metrics_extra(self) -> Dict[str, Any]:
         """Folded into the server's ``metrics`` op reply (called
         WITHOUT the server lock held — lag_snapshot takes it)."""
@@ -885,6 +897,11 @@ class GossipNode:
         router = self._router
         if router is not None and router.table is not None:
             extra["routing"] = router.table.to_json()
+        tier = self._replica_tier
+        if tier is not None and tier.role is not None:
+            extra["replication"] = {
+                "group": tier.group_name, "role": tier.role,
+                "lease_ms": tier._lease_ms()}
         return extra
 
     # --- fleet canary (obs/probe.py) ---

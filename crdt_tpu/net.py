@@ -967,6 +967,32 @@ class SyncServer:
                     send_bytes_frame(conn, bufs, self.tally, codec)
                 except (OSError, ValueError):
                     return
+            elif op == "heartbeat":
+                # Liveness probe (docs/REPLICATION.md): works pre-hello
+                # on the untagged framing, so a monitor needs no
+                # capability negotiation to ask "are you serving?".
+                # ServeTier implements the full replica-group form
+                # (lease grants, role); here the reply is just the
+                # replica's durable head — enough for a health poller
+                # or an election probe against a gossip node.
+                try:
+                    state: dict = {"ok": True, "op": "heartbeat"}
+                    with self.lock:
+                        state["node"] = str(self.crdt.node_id)
+                        state["hlc"] = str(self.crdt.canonical_time)
+                        if msg.get("want_root") and callable(
+                                getattr(self.crdt, "digest_tree",
+                                        None)):
+                            state["root"] = int(
+                                self.crdt.digest_tree().root)
+                except Exception as e:
+                    self._reply(conn, {"code": "hb_failed",
+                                       "error": type(e).__name__,
+                                       "detail": str(e)},
+                                self.tally, codec)
+                    return
+                if not self._reply(conn, state, self.tally, codec):
+                    return
             elif op == "metrics":
                 # Registry snapshot + whatever the embedding runtime
                 # (GossipNode: per-peer HLC lag) contributes. The

@@ -10,13 +10,22 @@ fleet and derives what no single replica can know:
   observed(observer)[origin]) / 1000``. ``None`` marks a pair where
   the observer has never seen that origin's canary; ``complete`` is
   True only when every (origin, observer) pair has a value.
-- **SLO verdict** — a machine-readable pass/fail over three budgets:
+- **SLO verdict** — a machine-readable pass/fail over four budgets:
   serve ack p99 (`crdt_tpu_serve_ack_seconds`), worst convergence lag
-  (the matrix), and shed writes (`crdt_tpu_serve_shed_total` == 0).
-  Each check is ``{"value", "budget", "ok"}`` with ``ok=None`` when
-  the fleet exposes no data for it (not measured ≠ passed ≠ failed);
-  the top-level ``ok`` requires every *measured* check to pass. Bench
-  modes emit this verdict as a trailing JSON line; CI gates on it.
+  (the matrix), shed writes (`crdt_tpu_serve_shed_total` == 0), and
+  replica-group primary liveness (every group visible in any
+  snapshot's ``replication`` section must have a reachable member
+  claiming ``role == "primary"`` — a partition with no live primary
+  is DOWN for writes no matter how healthy its followers look;
+  docs/REPLICATION.md). Each check is ``{"value", "budget", "ok"}``
+  with ``ok=None`` when the fleet exposes no data for it (not
+  measured ≠ passed ≠ failed); the top-level ``ok`` requires every
+  *measured* check to pass. Bench modes emit this verdict as a
+  trailing JSON line; CI gates on it.
+- **Replica health** — per-group role/lease/head roll-up from the
+  ``replication`` sections (`replica_health`), rendered as a table in
+  the default output and as ``crdt_tpu_fleet_replica_primary`` in
+  the federation exposition.
 - **Federation output** — an aggregated Prometheus exposition of the
   fleet-level series (matrix, beats, per-instance SLO inputs), each
   labelled by ``instance`` so same-named per-replica series can't
@@ -139,6 +148,34 @@ def histogram_quantile(sample: Dict[str, Any], q: float
     return math.inf
 
 
+def replica_health(snapshots: Dict[str, dict]) -> Dict[str, Any]:
+    """Per-group replica roll-up from the ``replication`` sections of
+    scraped (or in-process) metrics snapshots: ``groups`` maps group
+    → instance → {role, lease_ms, hlc_head[, followers]}, and
+    ``groups_without_primary`` lists every group no reachable member
+    claims to lead. A killed primary scrapes as ``_scrape_error`` and
+    so cannot claim its group — the group shows up here through its
+    followers and counts as primaryless until the monitor promotes
+    one. Pure."""
+    groups: Dict[str, Dict[str, dict]] = {}
+    for name, snap in snapshots.items():
+        if not isinstance(snap, dict):
+            continue
+        rep = snap.get("replication")
+        if not isinstance(rep, dict):
+            continue
+        entry = {"role": rep.get("role"),
+                 "lease_ms": rep.get("lease_ms"),
+                 "hlc_head": rep.get("hlc_head")}
+        if isinstance(rep.get("followers"), dict):
+            entry["followers"] = rep["followers"]
+        groups.setdefault(str(rep.get("group")), {})[name] = entry
+    missing = sorted(g for g, members in groups.items()
+                     if not any(m.get("role") == "primary"
+                                for m in members.values()))
+    return {"groups": groups, "groups_without_primary": missing}
+
+
 def _check(value: Optional[float], budget: float,
            ok: Optional[bool] = None) -> Dict[str, Any]:
     if ok is None:
@@ -175,11 +212,22 @@ def evaluate_slo(snapshots: Dict[str, dict],
         conv_ok = bool(matrix.get("complete")
                        and conv is not None
                        and conv <= convergence_budget_s)
+    health = replica_health(snapshots)
+    missing = health["groups_without_primary"]
+    # Unmeasured ≠ passed: a fleet with no replication sections gets
+    # ok=None here, but a group whose members answer and none of whom
+    # is primary is a hard failure — that partition is down for
+    # writes regardless of every other number on this page.
+    primary_ok: Optional[bool] = (None if not health["groups"]
+                                  else not missing)
     checks = {
         "ack_p99_s": _check(ack_p99, ack_p99_budget_s),
         "convergence_lag_s": _check(conv, convergence_budget_s,
                                     ok=conv_ok),
         "shed_writes": _check(shed, 0.0),
+        "groups_without_primary": _check(
+            float(len(missing)) if health["groups"] else None, 0.0,
+            ok=primary_ok),
     }
     measured = [c["ok"] for c in checks.values()
                 if c["ok"] is not None]
@@ -189,7 +237,8 @@ def evaluate_slo(snapshots: Dict[str, dict],
     ok = bool(measured) and all(measured) and not scrape_errors
     return {"checks": checks, "matrix_complete":
             bool(matrix.get("complete")),
-            "scrape_errors": scrape_errors, "ok": ok}
+            "scrape_errors": scrape_errors,
+            "replication": health, "ok": ok}
 
 
 def render_federation(snapshots: Dict[str, dict],
@@ -245,7 +294,36 @@ def render_federation(snapshots: Dict[str, dict],
             lines.append(f"crdt_tpu_fleet_shed_total"
                          f"{_labels(dict(s['labels'], instance=name))}"
                          f" {_fmt(s['value'])}")
+    health = replica_health(snapshots)
+    if health["groups"]:
+        lines.append("# TYPE crdt_tpu_fleet_replica_primary gauge")
+        for g, members in sorted(health["groups"].items()):
+            for inst, m in sorted(members.items()):
+                lines.append(
+                    f"crdt_tpu_fleet_replica_primary"
+                    f"{_labels({'group': g, 'instance': inst})} "
+                    f"{int(m.get('role') == 'primary')}")
     return "\n".join(lines) + ("\n" if lines else "")
+
+
+def format_replicas(health: Dict[str, Any]) -> str:
+    """Human-readable per-group replica table (role, lease, head);
+    empty string when no snapshot carried a ``replication`` section."""
+    if not health["groups"]:
+        return ""
+    headers = ["group", "instance", "role", "lease_ms", "hlc_head"]
+    rows = []
+    for g, members in sorted(health["groups"].items()):
+        for inst, m in sorted(members.items()):
+            lease = m.get("lease_ms")
+            rows.append([g, inst, str(m.get("role")),
+                         "-" if lease is None else f"{lease:.0f}",
+                         str(m.get("hlc_head") or "-")])
+    text = "\n".join(_table(headers, rows)) + "\n"
+    missing = health["groups_without_primary"]
+    if missing:
+        text += ("NO LIVE PRIMARY: " + ", ".join(missing) + "\n")
+    return text
 
 
 def format_matrix(matrix: Dict[str, Any]) -> str:
@@ -308,6 +386,7 @@ def fleet_main(argv: Optional[List[str]] = None, out=None) -> int:
             out.write(render_federation(snapshots, matrix))
         else:
             out.write(format_matrix(matrix))
+            out.write(format_replicas(verdict["replication"]))
             out.write(f"slo ok={verdict['ok']} "
                       f"{json.dumps(verdict['checks'])}\n")
         out.flush()

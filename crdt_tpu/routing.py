@@ -218,6 +218,23 @@ class RoutingTable:
                 return RoutingTable(self.n_slots, self.epoch + 1, out)
         raise ValueError(f"no range starts at slot {lo}")
 
+    def reassign(self, old_owner: str,
+                 new_owner: str) -> "RoutingTable":
+        """New table (epoch + 1) with every range of ``old_owner``
+        handed to ``new_owner`` — the routing flip at the end of a
+        failover. Unlike `split` the range geometry is unchanged:
+        replica promotion moves *ownership of an arc*, never its
+        boundaries, so in-flight `pack_since(ranges=...)` bookkeeping
+        keyed on (lo, hi) stays valid across the flip."""
+        old, new = str(old_owner), str(new_owner)
+        if old not in self.owners():
+            raise ValueError(f"{old!r} owns no ranges at epoch "
+                             f"{self.epoch}")
+        out = [(lo, hi, new if o == old else o)
+               for lo, hi, o in self.ranges]
+        return RoutingTable(self.n_slots, self.epoch + 1,
+                            self._merge_adjacent(out))
+
     @staticmethod
     def newest(a: Optional["RoutingTable"],
                b: Optional["RoutingTable"]) -> Optional["RoutingTable"]:

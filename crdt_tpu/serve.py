@@ -252,6 +252,16 @@ class ServeTier:
         # slots answer `moved` (or proxy for pre-federation sessions),
         # and the `federation` hello cap is advertised.
         self.router = router
+        # Replication (docs/REPLICATION.md): a primary carries a
+        # `Replicator` (replication.py) — the flush tick's write-concern
+        # barrier — while followers carry None and learn their role
+        # from the group driver. The crdtlint `ack-before-replicate`
+        # rule holds the flush tick to "barrier before any ack".
+        self.replicator = None
+        self.role: Optional[str] = None
+        self.group_name: Optional[str] = None
+        self._lease: Optional[Tuple[str, float, int]] = None
+        self.killed = False
         self.host = host
         self.port: Optional[int] = None
         self._want_port = port
@@ -384,6 +394,31 @@ class ServeTier:
         self._replica_pool.shutdown(wait=True)
         self._cold_pool.shutdown(wait=True)
 
+    def kill(self) -> None:
+        """SIGKILL-equivalent teardown for fault injection: no final
+        flush tick, no ack resolution, transports aborted (RST, close
+        without FIN) — clients observe exactly what a crashed process
+        shows them. Queued-but-unacked writes die with the tier; acked
+        writes survive only to the extent the write-concern barrier
+        already replicated them, which is the property the failover
+        tests measure. The replica object is left as the crash image —
+        a restart must build a FRESH store and catch up via the merkle
+        walk, never reuse this one."""
+        thread = self._thread
+        if thread is None:
+            return
+        self.killed = True
+        loop, ev = self._loop, self._stop_event
+        if loop is not None and ev is not None:
+            try:
+                loop.call_soon_threadsafe(ev.set)
+            except RuntimeError:
+                pass
+        thread.join(timeout=60)
+        self._thread = None
+        self._replica_pool.shutdown(wait=True)
+        self._cold_pool.shutdown(wait=True)
+
     def __enter__(self) -> "ServeTier":
         return self.start()
 
@@ -429,22 +464,36 @@ class ServeTier:
                 await flusher
             except asyncio.CancelledError:
                 pass
-            # Resolve every queued ack, give the sessions one loop
-            # breath to write their replies, then cut the transports.
-            await self._flush_tick()
-            await asyncio.sleep(0)
-            for proxy in self._proxies.values():
-                await proxy.close()
-            self._proxies.clear()
-            for w in list(self._writers):
-                try:
-                    w.close()
-                except Exception:
-                    pass
-            deadline = self._loop.time() + 5.0
-            while self._sessions and self._loop.time() < deadline:
-                await asyncio.sleep(0.01)
-            self._close_ingest()
+            if self.killed:
+                # Crash fidelity (`kill()`): drop the queue unacked,
+                # RST every transport, leave the ingest window where
+                # the crash left it. Pending sessions are cancelled
+                # when asyncio.run tears the loop down.
+                for w in list(self._writers):
+                    transport = w.transport
+                    if transport is not None:
+                        try:
+                            transport.abort()
+                        except Exception:
+                            pass
+            else:
+                # Resolve every queued ack, give the sessions one loop
+                # breath to write their replies, then cut the
+                # transports.
+                await self._flush_tick()
+                await asyncio.sleep(0)
+                for proxy in self._proxies.values():
+                    await proxy.close()
+                self._proxies.clear()
+                for w in list(self._writers):
+                    try:
+                        w.close()
+                    except Exception:
+                        pass
+                deadline = self._loop.time() + 5.0
+                while self._sessions and self._loop.time() < deadline:
+                    await asyncio.sleep(0.01)
+                self._close_ingest()
 
     def _open_ingest(self) -> None:
         with self.lock:
@@ -498,13 +547,32 @@ class ServeTier:
         n = len(q)
         tick_t = time.perf_counter()
         phases: dict = {}
+        # Write concern (docs/REPLICATION.md): a primary may resolve
+        # this tick's acks only after its `Replicator` confirms the
+        # delta on `ack_replicas` followers, and only while it still
+        # holds a fresh lease — an expired lease means the group
+        # monitor may already have promoted someone else, so acking
+        # here could lose the write to the client's view. Both
+        # failures map to the retryable `busy` code (the local commit
+        # stands — it is an idempotent lattice join that will
+        # converge via gossip — but the CLIENT is told to retry, so
+        # its ack, when it finally lands, is backed by the group).
+        rep = self.replicator
         try:
             slots = np.fromiter((e[0] for e in q), np.int64, count=n)
             vals = np.fromiter((e[1] for e in q), np.int64, count=n)
             tombs = np.fromiter((e[2] for e in q), bool, count=n)
             phases = await self._loop.run_in_executor(
                 self._replica_pool, self._commit, slots, vals, tombs)
-            outcome: Any = True
+            if self._lease_expired():
+                outcome: Any = ("busy", "primary lease expired "
+                                        "(fenced; retry)")
+            elif rep is not None:
+                replicated, detail = await self._loop.run_in_executor(
+                    self._replica_pool, rep.barrier)
+                outcome = True if replicated else ("busy", detail)
+            else:
+                outcome = True
         except Exception as e:
             # The whole tick failed (e.g. a value-width guard): every
             # writer in it gets the rejection. Resolved via
@@ -669,6 +737,11 @@ class ServeTier:
             # gets `moved` redirects; one that never asks is a
             # pre-federation session and gets the proxy fallback.
             caps.add("federation")
+        if packed:
+            # heartbeat/lease/replicate — the group-membership wire
+            # surface (docs/REPLICATION.md); replicate needs the
+            # packed merge path.
+            caps.add("replication")
         return caps
 
     def _read_slot(self, slot: int):
@@ -746,7 +819,70 @@ class ServeTier:
                 snap["node"] = {
                     "node_id": str(self.crdt.node_id),
                     "hlc_head": str(self.crdt.canonical_time)}
+        if self.role is not None:
+            # Group membership state for the fleet poller: role +
+            # lease freshness is what `evaluate_slo`'s primary-
+            # liveness check reads (obs/fleet.py).
+            with self.lock:
+                head = str(self.crdt.canonical_time)
+            rep = self.replicator
+            info = {"group": self.group_name, "role": self.role,
+                    "hlc_head": head, "lease_ms": self._lease_ms()}
+            if rep is not None:
+                info["followers"] = rep.status()
+            snap["replication"] = info
         return snap
+
+    # --- replication surface (docs/REPLICATION.md) ---
+
+    def _lease_ms(self) -> Optional[float]:
+        lease = self._lease
+        if lease is None:
+            return None
+        return max(0.0, (lease[1] - time.monotonic()) * 1000.0)
+
+    def _lease_expired(self) -> bool:
+        lease = self._lease
+        return lease is not None and time.monotonic() > lease[1]
+
+    def _grant_lease(self, lease: dict) -> Optional[str]:
+        """Adopt a lease grant iff it is not older than the one held —
+        a stale monitor (e.g. orphaned by a group restart) must not
+        extend a fence a newer monitor already owns. Returns the
+        refusal reason, or None when adopted."""
+        try:
+            holder = str(lease["holder"])
+            ttl_ms = float(lease["ttl_ms"])
+            epoch = int(lease.get("epoch", 0))
+        except (KeyError, TypeError, ValueError):
+            return "malformed lease grant"
+        cur = self._lease
+        if cur is not None and epoch < cur[2]:
+            return f"stale lease epoch {epoch} < {cur[2]}"
+        self._lease = (holder, time.monotonic() + ttl_ms / 1000.0,
+                       epoch)
+        return None
+
+    def _hb_state(self, want_root: bool) -> dict:
+        """Heartbeat replica touch (executor thread, lock held): the
+        durable HLC head every beat, plus the digest root only when
+        asked — elections need the tie-break, per-beat probes must not
+        pay a tree build."""
+        with self.lock:
+            out = {"hlc": str(self.crdt.canonical_time)}
+            if want_root and callable(
+                    getattr(self.crdt, "digest_tree", None)):
+                out["root"] = int(self.crdt.digest_tree().root)
+        return out
+
+    def _replicate_in(self, meta, blob: bytes, ids,
+                      tctx=None) -> str:
+        """Follower half of the write-concern barrier: merge the
+        primary's tick pack, then report the durable head from the
+        SAME lock hold — the watermark the primary's ack rests on."""
+        with self.lock:
+            self._merge_packed(meta, blob, ids, tctx)
+            return str(self.crdt.canonical_time)
 
     # --- the session coroutine ---
 
@@ -915,6 +1051,14 @@ class ServeTier:
                 if outcome is True:
                     await write_json_async(writer, {"ok": True},
                                            codec, self.tally)
+                elif isinstance(outcome, tuple):
+                    # Retryable tick outcome (write-concern barrier
+                    # miss, lease fence): the client backs off and
+                    # retries, same contract as admission `busy`.
+                    await write_json_async(
+                        writer, {"ok": False, "code": outcome[0],
+                                 "error": outcome[1]},
+                        codec, self.tally)
                 else:
                     await write_json_async(
                         writer, {"ok": False, "code": "write_rejected",
@@ -1141,6 +1285,72 @@ class ServeTier:
                                        self.tally)
                 await write_frame_async(writer, [buf], codec,
                                         self.tally)
+
+            elif op == "heartbeat":
+                # Group liveness probe (replication.py monitor). Rides
+                # the replica executor ON PURPOSE: a tier whose replica
+                # lane is wedged reads as dead — the monitor measures
+                # end-to-end serviceability, not TCP accept.
+                lease = msg.get("lease")
+                lease_err = (self._grant_lease(lease)
+                             if isinstance(lease, dict) else None)
+                try:
+                    state = await loop.run_in_executor(
+                        self._replica_pool, self._hb_state,
+                        bool(msg.get("want_root")))
+                except Exception as e:
+                    await write_json_async(
+                        writer, {"ok": False, "code": "hb_failed",
+                                 "error": type(e).__name__,
+                                 "detail": str(e)},
+                        codec, self.tally)
+                    return
+                reply = {"ok": True, "op": "heartbeat",
+                         "role": self.role, "group": self.group_name,
+                         "node": self._node,
+                         "lease_ms": self._lease_ms(), **state}
+                if lease_err is not None:
+                    reply["lease_rejected"] = lease_err
+                await write_json_async(writer, reply, codec,
+                                       self.tally)
+
+            elif op == "lease":
+                # Standalone grant (heartbeat can piggyback one too):
+                # the fence a partitioned ex-primary honors by
+                # answering `busy` once its TTL runs out.
+                lease_err = self._grant_lease(msg)
+                if lease_err is not None:
+                    await write_json_async(
+                        writer, {"ok": False, "code": "lease_stale",
+                                 "error": lease_err},
+                        codec, self.tally)
+                else:
+                    await write_json_async(
+                        writer, {"ok": True, "role": self.role,
+                                 "lease_ms": self._lease_ms()},
+                        codec, self.tally)
+
+            elif op == "replicate":
+                blob = await self._read_blob(reader, codec)
+                if blob is None:
+                    return
+                try:
+                    head = await loop.run_in_executor(
+                        self._replica_pool, self._replicate_in,
+                        msg.get("meta"), blob, msg.get("node_ids"),
+                        tctx)
+                except Exception as e:
+                    await write_json_async(
+                        writer, {"ok": False,
+                                 "code": "packed_rejected",
+                                 "error": type(e).__name__,
+                                 "detail": str(e)},
+                        codec, self.tally)
+                    return
+                await write_json_async(
+                    writer, {"ok": True, "hlc": head,
+                             "role": self.role},
+                    codec, self.tally)
 
             elif op == "metrics":
                 try:

@@ -28,6 +28,13 @@ seeded rng; `ScriptedSchedule` replays an explicit list — unit tests
 use it to script "refuse once, then behave". Set
 :attr:`FaultProxy.passthrough` True to disable faulting (the soak's
 settle phase) without tearing down the proxy.
+
+Two crash-shaped primitives ride along for the replication suite
+(docs/REPLICATION.md): :func:`abrupt_kill` (die with no farewell —
+RST/linger-0, the SIGKILL signature) and
+:attr:`FaultProxy.blackhole` (asymmetric partition: swallow ONE
+direction's bytes with no FIN and no RST, so the victim looks mute
+rather than dead).
 """
 
 from __future__ import annotations
@@ -48,6 +55,51 @@ _CORRUPT_MASK = 0xA5
 # buffered (the duplicate fault is frame-aware and must not hold a
 # 100 MB push in memory).
 _DUP_FRAME_CAP = 1 << 20
+
+
+def _slam(sock: socket.socket) -> None:
+    """Close WITHOUT a FIN: SO_LINGER zero makes close() send a bare
+    RST (or nothing the peer ever hears, if the segment is lost) — the
+    kernel-level signature of a SIGKILLed process, as opposed to
+    `_teardown`'s orderly shutdown. Replication tests use this to
+    prove failover does not depend on the dying side saying goodbye
+    (docs/REPLICATION.md)."""
+    try:
+        sock.setsockopt(socket.SOL_SOCKET, socket.SO_LINGER,
+                        struct.pack("ii", 1, 0))
+    except OSError:
+        pass
+    try:
+        sock.close()
+    except OSError:
+        pass
+
+
+def abrupt_kill(target) -> None:
+    """The abrupt-kill primitive: die NOW, with no farewell protocol.
+
+    Dispatch by shape — a `ReplicaGroup` loses its primary
+    (``kill_primary``), a `ServeTier` (anything with a no-arg
+    ``kill()``) dies via its own SIGKILL-equivalent teardown, a
+    `FaultProxy` slams every established relay (RST both ways), and a
+    bare socket is linger-0 closed. This is the one primitive chaos
+    tests should reach for, so "kill" means the same thing
+    everywhere."""
+    kill_primary = getattr(target, "kill_primary", None)
+    if callable(kill_primary):
+        kill_primary()
+        return
+    kill = getattr(target, "kill", None)
+    if callable(kill) and not isinstance(target, socket.socket):
+        kill()
+        return
+    if isinstance(target, FaultProxy):
+        target.slam()
+        return
+    if isinstance(target, socket.socket):
+        _slam(target)
+        return
+    raise TypeError(f"don't know how to abruptly kill {target!r}")
 
 
 def _teardown(sock: socket.socket) -> None:
@@ -128,6 +180,7 @@ class FaultProxy:
     prove its faults happened."""
 
     _passthrough = False
+    _blackhole: Optional[str] = None
 
     def __init__(self, target_host: str, target_port: int,
                  schedule=None,
@@ -181,6 +234,35 @@ class FaultProxy:
             for sock in list(self._open):
                 self._open.discard(sock)
                 _teardown(sock)
+
+    @property
+    def blackhole(self) -> Optional[str]:
+        """Asymmetric partition mode: ``"c2s"`` silently swallows the
+        client→server byte stream (requests vanish, replies still
+        flow), ``"s2c"`` the reverse (requests land, acks never come
+        back — the direction that distinguishes "dead" from "mute",
+        which is what lease fencing exists for), ``"both"`` swallows
+        both, ``None`` restores normal relaying. Unlike a passthrough
+        flip nothing is torn down: no FIN, no RST — bytes just stop
+        arriving, exactly like a one-way network partition."""
+        return self._blackhole
+
+    @blackhole.setter
+    def blackhole(self, value: Optional[str]) -> None:
+        if value not in (None, "c2s", "s2c", "both"):
+            raise ValueError(
+                f"blackhole must be None/'c2s'/'s2c'/'both'; "
+                f"got {value!r}")
+        self._blackhole = value
+
+    def slam(self) -> None:
+        """RST every established relay, both directions, and refuse
+        nothing afterward: the proxy itself stays up (unlike `stop`),
+        but every flow that existed dies the SIGKILL way — no FIN."""
+        self._count("slam")
+        for sock in list(self._open):
+            self._open.discard(sock)
+            _slam(sock)
 
     def _count(self, key: str) -> None:
         with self._counter_lock:
@@ -248,6 +330,9 @@ class FaultProxy:
                 data = src.recv(1 << 16)
                 if not data:
                     return
+                if self._blackhole in ("s2c", "both"):
+                    self._count("blackhole_s2c")
+                    continue
                 dst.sendall(data)
         except OSError:
             return
@@ -269,6 +354,9 @@ class FaultProxy:
                 data = src.recv(1 << 16)
                 if not data:
                     return
+                if self._blackhole in ("c2s", "both"):
+                    self._count("blackhole_c2s")
+                    continue
                 if kind == "truncate":
                     cut = fault["after"] - sent
                     if cut < len(data):

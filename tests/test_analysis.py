@@ -473,6 +473,61 @@ def test_router_bypass_ignores_routerless_classes():
     assert "router-epoch-bypass" not in rules
 
 
+def test_ack_before_replicate_ungated_ack_flagged():
+    src = (
+        "class Tier:\n"
+        "    def __init__(self, replicator=None):\n"
+        "        self.replicator = replicator\n"
+        "    def tick(self, futs):\n"
+        "        for fut in futs:\n"
+        "            fut.set_result('acked')\n")
+    findings = [f for f in lint_source(src, "snippet.py")
+                if f.rule == "ack-before-replicate"]
+    assert len(findings) == 1
+    assert findings[0].line == 6
+    assert "write-concern barrier" in findings[0].message
+
+
+def test_ack_before_replicate_ack_before_barrier_flagged():
+    # barrier is consulted, but only AFTER the ack already resolved
+    src = (
+        "class Tier:\n"
+        "    def __init__(self, replicator=None):\n"
+        "        self.replicator = replicator\n"
+        "    def tick(self, fut):\n"
+        "        fut.set_result('acked')\n"
+        "        ok, why = self.replicator.barrier()\n")
+    rules = {f.rule for f in lint_source(src, "snippet.py")}
+    assert "ack-before-replicate" in rules
+
+
+def test_ack_before_replicate_barrier_first_clean():
+    src = (
+        "class Tier:\n"
+        "    def __init__(self, replicator=None):\n"
+        "        self.replicator = replicator\n"
+        "    def tick(self, fut):\n"
+        "        rep = self.replicator\n"
+        "        if rep is not None:\n"
+        "            ok, why = rep.barrier()\n"
+        "        fut.set_result('acked')\n")
+    rules = {f.rule for f in lint_source(src, "snippet.py")}
+    assert "ack-before-replicate" not in rules
+
+
+def test_ack_before_replicate_ignores_replicatorless_classes():
+    # a future-resolving class with no replicator carries no write-
+    # concern contract — nothing to gate
+    src = (
+        "class Combiner:\n"
+        "    def __init__(self):\n"
+        "        self._q = []\n"
+        "    def flush(self, fut):\n"
+        "        fut.set_result(len(self._q))\n")
+    rules = {f.rule for f in lint_source(src, "snippet.py")}
+    assert "ack-before-replicate" not in rules
+
+
 def test_shipped_tree_lints_clean():
     from crdt_tpu.analysis.host_lint import lint_package
     import crdt_tpu

@@ -828,3 +828,56 @@ def test_in_process_sync_spans_carry_round_ids():
     finally:
         ring.disable()
         ring.clear()
+
+
+def test_replica_health_rollup_and_primaryless_groups():
+    from crdt_tpu.obs.fleet import replica_health
+    snaps = {
+        "r0": {"replication": {"group": "g0", "role": "primary",
+                               "lease_ms": 120.0, "hlc_head": "h0",
+                               "followers": {"r1": {"durable": "h1"}}}},
+        "r1": {"replication": {"group": "g0", "role": "follower",
+                               "lease_ms": None, "hlc_head": "h1"}},
+        "q0": {"replication": {"group": "g1", "role": "follower",
+                               "lease_ms": None, "hlc_head": "h2"}},
+        "plain": {"counters": {}},          # no replication section
+        "dead": "_not_a_dict_",
+    }
+    health = replica_health(snaps)
+    assert set(health["groups"]) == {"g0", "g1"}
+    assert health["groups"]["g0"]["r0"]["role"] == "primary"
+    assert "followers" in health["groups"]["g0"]["r0"]
+    assert health["groups_without_primary"] == ["g1"]
+
+
+def test_evaluate_slo_fails_group_without_live_primary():
+    from crdt_tpu.obs.fleet import evaluate_slo
+    snaps = {
+        "r0": {"replication": {"group": "g0", "role": "follower",
+                               "lease_ms": None, "hlc_head": "h0"}},
+        "r1": {"replication": {"group": "g0", "role": "follower",
+                               "lease_ms": None, "hlc_head": "h1"}},
+    }
+    verdict = evaluate_slo(snaps)
+    check = verdict["checks"]["groups_without_primary"]
+    assert check["value"] == 1.0 and check["ok"] is False
+    assert verdict["ok"] is False
+    assert verdict["replication"]["groups_without_primary"] == ["g0"]
+    # promotion heals the verdict
+    snaps["r1"]["replication"]["role"] = "primary"
+    verdict = evaluate_slo(snaps)
+    assert verdict["checks"]["groups_without_primary"]["ok"] is True
+    assert verdict["ok"] is True
+
+
+def test_format_replicas_surfaces_health_and_missing_primary():
+    from crdt_tpu.obs.fleet import format_replicas, replica_health
+    snaps = {
+        "r0": {"replication": {"group": "g0", "role": "primary",
+                               "lease_ms": 87.5, "hlc_head": "h0"}},
+        "q0": {"replication": {"group": "g1", "role": "follower",
+                               "lease_ms": None, "hlc_head": "h1"}},
+    }
+    out = format_replicas(replica_health(snaps))
+    assert "primary" in out and "r0" in out
+    assert "NO LIVE PRIMARY" in out and "g1" in out

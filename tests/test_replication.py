@@ -1,0 +1,371 @@
+"""Replica-group robustness (docs/REPLICATION.md): write-concern
+acks, health-checked failover, zero-loss crash recovery.
+
+The acceptance test kills the live primary three times in a row under
+a sustained client write storm and proves the contract the whole
+subsystem exists for: a write the client saw acked is NEVER lost, the
+routing epoch advances on every failover, and a killed member rejoins
+as a convergent follower. The soak (-m soak) replays the same chaos
+with every wire the group uses routed through a `FaultProxy`.
+"""
+
+import threading
+import time
+
+import pytest
+
+from crdt_tpu import DenseCrdt, FederatedClient
+from crdt_tpu.net import SyncServer
+from crdt_tpu.replication import ReplicaGroup, _HbClient
+from crdt_tpu.testing_faults import FaultProxy, FaultSchedule, \
+    abrupt_kill
+
+# Tight but CI-safe chaos timings: detection in ~3 beats, promote in
+# milliseconds, client retry budget (~2 s) comfortably above both.
+FAST = dict(flush_interval=0.002, heartbeat_interval=0.02,
+            heartbeat_timeout=0.15, lease_misses=3)
+
+
+def _wait(pred, timeout=10.0, interval=0.01, what="condition"):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if pred():
+            return
+        time.sleep(interval)
+    raise AssertionError(f"timed out waiting for {what}")
+
+
+class _Storm:
+    """Background client write storm over disjoint slots: monotone
+    values, one (slot -> last acked value) ledger. Write failures are
+    retried forever — only an ACKED write enters the ledger, which is
+    exactly the set failover must not lose."""
+
+    def __init__(self, seeds, writers=3, slots_per_writer=4,
+                 rate_hz=100.0):
+        self.seeds = list(seeds)
+        self.writers = writers
+        self.slots_per_writer = slots_per_writer
+        self.rate_hz = rate_hz
+        self.lock = threading.Lock()
+        self.last_acked = {}
+        self.acked = 0
+        self.errors = []
+        self._stop = threading.Event()
+        self._threads = []
+
+    def _run(self, w):
+        cli = FederatedClient(self.seeds, timeout=5.0)
+        my = [w * self.slots_per_writer + j
+              for j in range(self.slots_per_writer)]
+        i = 0
+        try:
+            while not self._stop.is_set():
+                slot = my[i % len(my)]
+                val = i + 1
+                try:
+                    cli.put(slot, val)
+                except (ConnectionError, ValueError):
+                    time.sleep(0.02)
+                    continue
+                with self.lock:
+                    self.last_acked[slot] = val
+                    self.acked += 1
+                i += 1
+                time.sleep(1.0 / self.rate_hz)
+        except Exception as exc:  # pragma: no cover - asserted empty
+            self.errors.append(f"writer{w}: {exc!r}")
+        finally:
+            cli.close()
+
+    def __enter__(self):
+        self._threads = [
+            threading.Thread(target=self._run, args=(w,), daemon=True)
+            for w in range(self.writers)]
+        for t in self._threads:
+            t.start()
+        _wait(lambda: self.acked >= self.writers,
+              what="storm first acks")
+        return self
+
+    def __exit__(self, *exc):
+        self._stop.set()
+        for t in self._threads:
+            t.join(timeout=10.0)
+
+    def checkpoint(self):
+        with self.lock:
+            return dict(self.last_acked)
+
+
+def _assert_no_acked_loss(seeds, checkpoint):
+    cli = FederatedClient(seeds, timeout=5.0)
+    try:
+        lost = {slot: (val, cli.get(slot))
+                for slot, val in checkpoint.items()
+                if cli.get(slot) is None or int(cli.get(slot)) < val}
+        assert not lost, f"acked writes lost: {lost}"
+    finally:
+        cli.close()
+
+
+def _wait_converged(group, seeds, nudge_slot, timeout=10.0):
+    """All live replicas agree on one digest root. Nudge writes
+    re-arm the flush tick so the replicator ships every follower to
+    head after the storm stops."""
+    cli = FederatedClient(seeds, timeout=5.0)
+    bump = 0
+    try:
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            bump += 1
+            cli.put(nudge_slot, bump)
+            time.sleep(0.05)
+            roots = []
+            for m in group.members:
+                tier = m.tier
+                if m.role == "down" or tier is None or tier.killed:
+                    continue
+                with tier.lock:
+                    roots.append(int(tier.crdt.digest_tree().root))
+            if len(roots) == group.replicas and len(set(roots)) == 1:
+                return roots[0]
+        raise AssertionError(
+            f"replicas did not converge within {timeout}s")
+    finally:
+        cli.close()
+
+
+def test_group_replicates_and_serves():
+    with ReplicaGroup(256, replicas=3, ack_replicas=1,
+                      **FAST) as group:
+        seeds = group.member_addrs()
+        cli = FederatedClient(seeds, timeout=5.0)
+        try:
+            for s in range(0, 64, 8):
+                cli.put(s, 100 + s)
+            for s in range(0, 64, 8):
+                assert cli.get(s) == 100 + s
+        finally:
+            cli.close()
+        # The write-concern barrier really shipped the ticks: at
+        # least one follower's durable head is stamped.
+        rep = group.primary.tier.replicator
+        _wait(lambda: any(f["durable"] is not None
+                          for f in rep.status().values()),
+              what="follower durable head")
+
+
+def test_three_consecutive_failovers_zero_acked_loss():
+    """The acceptance gate: >=3 kill-the-primary cycles under a
+    write storm; every cycle promotes (epoch +1), loses nothing the
+    client saw acked, and measures a finite MTTR."""
+    with ReplicaGroup(256, replicas=3, ack_replicas=1,
+                      **FAST) as group:
+        seeds = group.member_addrs()
+        probe = FederatedClient(seeds, timeout=5.0)
+        mttrs = []
+        try:
+            with _Storm(seeds) as storm:
+                for cycle in range(3):
+                    epoch_before = group.table.epoch
+                    checkpoint = storm.checkpoint()
+                    abrupt_kill(group)
+                    t_kill = time.monotonic()
+                    # Client-observed MTTR: the routed retry loop
+                    # rides out detection + promotion on its own.
+                    probe.put(200 + cycle, 9000 + cycle)
+                    mttr = time.monotonic() - t_kill
+                    probe.refresh()
+                    assert probe.table.epoch > epoch_before, (
+                        f"cycle {cycle}: epoch did not advance")
+                    assert probe.get(200 + cycle) == 9000 + cycle
+                    _assert_no_acked_loss(seeds, checkpoint)
+                    mttrs.append(mttr)
+                    # restart the corpse as a follower before the
+                    # next cycle so the group is back to strength
+                    downed = [m for m in group.members
+                              if m.role == "down"]
+                    assert len(downed) == 1
+                    group.rejoin(downed[0].index)
+                    _wait(lambda: all(m.role != "down"
+                                      for m in group.members),
+                          what="rejoin")
+                assert not storm.errors
+            assert group.failovers == 3
+            assert group.table.epoch >= 3
+            assert all(0 < m < 30 for m in mttrs)
+            _assert_no_acked_loss(seeds, storm.checkpoint())
+        finally:
+            probe.close()
+        _wait_converged(group, seeds, nudge_slot=255)
+
+
+def test_rejoin_discards_crash_image_and_converges():
+    with ReplicaGroup(256, replicas=3, ack_replicas=1,
+                      **FAST) as group:
+        seeds = group.member_addrs()
+        cli = FederatedClient(seeds, timeout=5.0)
+        try:
+            for s in range(16):
+                cli.put(s, s + 1)
+            dead = group.kill_primary()
+            gen_before = dead.generation
+            cli.put(100, 42)          # rides out the failover
+            member = group.rejoin(dead.index)
+            assert member is dead
+            assert member.generation == gen_before + 1
+            assert member.role == "follower"
+            # rebind contract: a restarted member comes back at its
+            # previous address, so original seeds stay valid forever
+            assert member.addr in seeds
+            for s in range(16):
+                assert cli.get(s) == s + 1
+        finally:
+            cli.close()
+        _wait_converged(group, seeds, nudge_slot=255)
+
+
+def test_write_concern_blocks_acks_without_followers():
+    """ack_replicas=2 with both followers dead: the flush tick keeps
+    answering retryable busy — the primary NEVER fabricates a group-
+    backed ack alone. Restoring the followers restores acks."""
+    with ReplicaGroup(128, replicas=3, ack_replicas=2,
+                      **FAST) as group:
+        seeds = group.member_addrs()
+        cli = FederatedClient(seeds, timeout=2.0, max_redirects=5)
+        try:
+            cli.put(1, 11)            # healthy group acks
+            followers = [m for m in group.members
+                         if m.role == "follower"]
+            for m in followers:
+                group.kill(m.index)
+            _wait(lambda: all(m.role == "down" for m in followers),
+                  what="follower death detection")
+            with pytest.raises(ConnectionError):
+                cli.put(2, 22)
+            assert cli.busy_retries > 0
+            for m in followers:
+                group.rejoin(m.index)
+            cli2 = FederatedClient(seeds, timeout=5.0)
+            try:
+                cli2.put(3, 33)
+                assert cli2.get(3) == 33
+            finally:
+                cli2.close()
+        finally:
+            cli.close()
+
+
+def test_sync_server_answers_heartbeat():
+    crdt = DenseCrdt("hb-node", n_slots=64)
+    crdt.put_batch([3], [7])
+    crdt.drain_ingest()
+    with SyncServer(crdt) as server:
+        hb = _HbClient(f"{server.host}:{server.port}", timeout=2.0)
+        try:
+            reply = hb.beat()
+            assert reply["node"] == "hb-node"
+            assert "hlc" in reply and "root" not in reply
+            reply = hb.beat(want_root=True)
+            assert int(reply["root"]) == int(crdt.digest_tree().root)
+        finally:
+            hb.close()
+
+
+def test_abrupt_kill_dispatches_by_shape():
+    with ReplicaGroup(64, replicas=2, ack_replicas=0,
+                      **FAST) as group:
+        primary_tier = group.primary.tier
+        abrupt_kill(group)
+        assert primary_tier.killed
+    with pytest.raises(TypeError):
+        abrupt_kill(object())
+
+
+def test_fault_proxy_blackhole_is_silent_and_asymmetric():
+    crdt = DenseCrdt("mute-node", n_slots=64)
+    with SyncServer(crdt) as server:
+        with FaultProxy(server.host, server.port,
+                        schedule=FaultSchedule(rate=0.0)) as proxy:
+            proxy.passthrough = True
+            addr = f"{proxy.host}:{proxy.port}"
+            hb = _HbClient(addr, timeout=0.3)
+            try:
+                assert hb.beat()["node"] == "mute-node"
+                # s2c blackhole: the request lands (server is fine)
+                # but the reply never comes back — "mute", the state
+                # lease fencing distinguishes from "dead". No RST, no
+                # FIN: the client just times out.
+                proxy.blackhole = "s2c"
+                with pytest.raises(ConnectionError):
+                    hb.beat()
+                assert proxy.counters.get("blackhole_s2c", 0) > 0
+                proxy.blackhole = None
+            finally:
+                hb.close()
+            hb2 = _HbClient(addr, timeout=2.0)
+            try:
+                assert hb2.beat()["node"] == "mute-node"
+            finally:
+                hb2.close()
+    with pytest.raises(ValueError):
+        proxy.blackhole = "sideways"
+
+
+@pytest.mark.slow
+@pytest.mark.soak
+def test_chaos_soak_proxied_kill_restart_cycles():
+    """>=3 kill-and-restart primary cycles with EVERY wire the group
+    uses (client ops, heartbeats, replication ships, merkle rejoin
+    walks) routed through a misbehaving `FaultProxy`: acked writes
+    all survive and every killed replica rejoins convergent."""
+    proxies = {}
+
+    def via(real_addr):
+        if real_addr not in proxies:
+            host, port = real_addr.rsplit(":", 1)
+            schedule = FaultSchedule(
+                seed=len(proxies), rate=0.15,
+                kinds={"drop": 1, "delay": 2, "duplicate": 1},
+                max_delay=0.02)
+            proxies[real_addr] = FaultProxy(
+                host, int(port), schedule=schedule).start()
+        p = proxies[real_addr]
+        return f"{p.host}:{p.port}"
+
+    group = ReplicaGroup(256, replicas=3, ack_replicas=1,
+                         addr_via=via, **FAST)
+    group.start()
+    try:
+        seeds = group.member_addrs()
+        probe = FederatedClient(seeds, timeout=5.0)
+        try:
+            with _Storm(seeds, writers=3, rate_hz=150.0) as storm:
+                for cycle in range(4):
+                    epoch_before = group.table.epoch
+                    checkpoint = storm.checkpoint()
+                    group.kill_primary()
+                    probe.put(200 + cycle, 5000 + cycle)
+                    probe.refresh()
+                    assert probe.table.epoch > epoch_before
+                    _assert_no_acked_loss(seeds, checkpoint)
+                    downed = [m for m in group.members
+                              if m.role == "down"]
+                    assert len(downed) == 1
+                    group.rejoin(downed[0].index)
+                    _wait(lambda: all(m.role != "down"
+                                      for m in group.members),
+                          what="proxied rejoin")
+                assert not storm.errors
+            assert group.failovers >= 4
+            _assert_no_acked_loss(seeds, storm.checkpoint())
+        finally:
+            probe.close()
+        _wait_converged(group, seeds, nudge_slot=255, timeout=20.0)
+        assert sum(p.counters.get("connections", 0)
+                   for p in proxies.values()) > 0
+    finally:
+        group.stop()
+        for p in proxies.values():
+            p.stop()
