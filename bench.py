@@ -35,6 +35,7 @@ from __future__ import annotations
 
 import argparse
 import json
+import math
 import sys
 import time
 
@@ -1988,6 +1989,268 @@ def bench_failover(replicas: int = 3, ack_replicas: int = 1,
     }
 
 
+def bench_elastic(period_s: float = 6.0, cycles: int = 2,
+                  peak_hz: float = 600.0, trough_hz: float = 30.0,
+                  writers: int = 4, slots_per_writer: int = 8,
+                  n_slots: int = 1 << 10,
+                  max_partitions: int = 4,
+                  split_rows_per_s: float = 250.0,
+                  merge_rows_per_s: float = 60.0,
+                  scaler_interval: float = 0.2,
+                  cooldown_s: float = 0.8,
+                  ack_p99_budget_s: float = 0.0146,
+                  slo_budget_s: float = 0.0313,
+                  recovery_s: float = 0.5,
+                  settle_s: float = 1.5) -> dict:
+    """Elastic autoscaling bench: a sine-wave write load against a
+    `FederatedTier` driven by the `Autoscaler` daemon (ROADMAP item
+    1 / docs/FEDERATION.md). Offered load swings trough -> peak ->
+    trough over ``period_s``, ``cycles`` times; the controller must
+    split partitions in on the rising edge and merge them away on the
+    falling edge, live, while every acked write survives.
+
+    Gates: the partition count tracks the load (>= ``cycles`` up-
+    transitions AND >= ``cycles`` down-transitions), zero acked
+    writes lost across every split/merge, and the steady-state
+    client-observed ack p99 — excluding ``recovery_s`` after each
+    routing-epoch flip, which is priced separately as flip recovery —
+    within the SERVE_r01 federate envelope (14.6 ms)."""
+    import threading
+
+    from crdt_tpu import Autoscaler, FederatedClient, FederatedTier
+    from crdt_tpu.obs.fleet import evaluate_slo
+    from crdt_tpu.obs.registry import default_registry
+    from crdt_tpu.obs.trajectory import host_class
+
+    assert writers * slots_per_writer < n_slots - 1
+
+    # Same jit pre-warm as bench_failover: a first-contact compile
+    # inside a flip window would read as fake recovery latency.
+    from crdt_tpu import DenseCrdt as _DC
+    wa = _DC("warm-a", n_slots=n_slots)
+    wb = _DC("warm-b", n_slots=n_slots)
+    for sz in (1, 2, 4, 8, 16, 32, 64, 128, 256, 512, 1024):
+        sz = min(sz, n_slots)
+        wa.put_batch(list(range(sz)), [1] * sz)
+        wa.drain_ingest()
+        packed, ids = wa.pack_since(None, sem_mode="include",
+                                    ranges=((0, n_slots),))
+        wb.merge_packed(packed, ids)
+    int(wa.digest_tree().root)
+    int(wb.digest_tree().root)
+    del wa, wb
+
+    def offered(t: float) -> float:
+        """Total offered puts/s at elapsed ``t`` — a raised cosine
+        that starts and ends at the trough."""
+        t = min(t, period_s * cycles)
+        swing = 0.5 - 0.5 * math.cos(2.0 * math.pi * t / period_s)
+        return trough_hz + (peak_hz - trough_hz) * swing
+
+    def probe() -> dict:
+        # The registry ack histogram is log2-bucketed: a true p99
+        # anywhere in (7.8, 15.6] ms reports as the bucket CEILING
+        # (15.625 ms), which a 14.6 ms budget reads as breached
+        # forever — phantom split pressure pegging the fleet at its
+        # ceiling. The controller therefore gets the first bucket
+        # boundary that unambiguously exceeds the envelope; the
+        # exact 14.6 ms gate is enforced on the client-side samples
+        # below, where latencies are not bucketed.
+        return evaluate_slo({"local": default_registry().snapshot()},
+                            ack_p99_budget_s=slo_budget_s)
+
+    duration = period_s * cycles + settle_s
+    stop = threading.Event()
+    lock = threading.Lock()
+    last_acked: dict = {}          # slot -> highest acked value
+    samples: list = []             # (t_done, ack_latency_s)
+    counters = {"attempted": 0, "acked": 0, "retried": 0}
+    writer_errors: list = []
+
+    # Unreplicated tiers, like bench_federate: the 14.6 ms envelope
+    # this bench gates against was measured without write-concern
+    # follower ships (a CPU-host ship is ~50 ms of pack+merge per
+    # ack, a different envelope entirely — bench_failover prices
+    # that one). Replicated elasticity is the chaos drills' job
+    # (tests/test_serve_federation.py -m soak).
+    fed = FederatedTier(n_slots, partitions=1,
+                        flush_interval=0.002)
+    fed.start()
+    seeds = fed.addrs()
+    # Serve-path warmup: the first ops through a fresh federation pay
+    # session setup plus any residual first-contact compiles, and the
+    # registry ack histogram is cumulative — the spikes recorded here
+    # must be diluted below the 99th percentile before the run starts,
+    # or the controller's SLO probe reads the fleet as breached at the
+    # trough and splits against phantom pressure.
+    warm = FederatedClient(seeds, timeout=5.0)
+    try:
+        for i in range(800):
+            warm.put(n_slots - 1, i + 1)
+    finally:
+        warm.close()
+    t0 = time.monotonic()
+
+    def writer(w: int) -> None:
+        cli = FederatedClient(seeds, timeout=5.0)
+        # Disjoint per-writer slots, strided across the WHOLE
+        # keyspace so a split actually redistributes this load.
+        total = writers * slots_per_writer
+        my = [((w * slots_per_writer + j) * n_slots) // total
+              for j in range(slots_per_writer)]
+        i = 0
+        try:
+            while not stop.is_set():
+                slot = my[i % len(my)]
+                val = i + 1
+                with lock:
+                    counters["attempted"] += 1
+                t_op = time.monotonic()
+                try:
+                    cli.put(slot, val)
+                except (ConnectionError, ValueError):
+                    # Retry budget exhausted mid-flip: never acked,
+                    # so not loss — the storm re-offers next loop.
+                    with lock:
+                        counters["retried"] += 1
+                    time.sleep(0.02)
+                    continue
+                now = time.monotonic()
+                with lock:
+                    counters["acked"] += 1
+                    last_acked[slot] = val
+                    samples.append((now - t0, now - t_op))
+                i += 1
+                time.sleep(writers / max(offered(now - t0), 1e-3))
+        except Exception as exc:  # pragma: no cover - surfaced below
+            writer_errors.append(
+                f"writer{w}: {type(exc).__name__}: {exc}")
+        finally:
+            cli.close()
+
+    trace: list = []               # (t, offered_hz, partitions, epoch)
+
+    def sampler() -> None:
+        while not stop.is_set():
+            t = time.monotonic() - t0
+            table = fed.table
+            trace.append((round(t, 3), round(offered(t), 1),
+                          len(fed.tiers),
+                          0 if table is None else table.epoch))
+            time.sleep(0.05)
+
+    scaler = Autoscaler(
+        fed, interval=scaler_interval, min_partitions=1,
+        max_partitions=max_partitions,
+        split_rows_per_s=split_rows_per_s,
+        merge_rows_per_s=merge_rows_per_s,
+        hysteresis_ticks=2, cooldown_s=cooldown_s,
+        ack_p99_budget_s=slo_budget_s, slo_probe=probe)
+
+    lost = 0
+    try:
+        threads = [threading.Thread(target=writer, args=(w,),
+                                    daemon=True)
+                   for w in range(writers)]
+        threads.append(threading.Thread(target=sampler, daemon=True))
+        for t in threads:
+            t.start()
+        with scaler:
+            time.sleep(duration)
+        stop.set()
+        for t in threads:
+            t.join(timeout=10.0)
+
+        # Zero-loss floor: every slot reads back >= its last acked
+        # value, through a fresh client against the final topology —
+        # seeded from the LIVE address list, the original seed tier
+        # may itself have been merged away.
+        reader = FederatedClient(fed.addrs(), timeout=5.0)
+        try:
+            with lock:
+                frozen = dict(last_acked)
+            for slot, val in frozen.items():
+                got = reader.get(slot)
+                if got is None or int(got) < val:
+                    lost += 1
+        finally:
+            reader.close()
+        slo = probe()
+    finally:
+        stop.set()
+        fed.stop()
+
+    # Partition-count transitions, and the flip times that open each
+    # recovery window.
+    ups = downs = 0
+    flips: list = []
+    for (ta, _, pa, ea), (tb, _, pb, eb) in zip(trace, trace[1:]):
+        if pb > pa:
+            ups += 1
+        elif pb < pa:
+            downs += 1
+        if eb != ea:
+            flips.append(tb)
+    partition_counts = sorted({p for _, _, p, _ in trace})
+
+    def p99(lat: list) -> float:
+        lat = sorted(lat)
+        return lat[int(0.99 * (len(lat) - 1))] if lat else float("nan")
+
+    steady = [dt for (ts, dt) in samples
+              if not any(f <= ts <= f + recovery_s for f in flips)]
+    recovering = [dt for (ts, dt) in samples
+                  if any(f <= ts <= f + recovery_s for f in flips)]
+    steady_p99 = p99(steady)
+    decisions: dict = {}
+    for d in scaler.decisions:
+        key = f"{d['action']}:{d['reason']}"
+        decisions[key] = decisions.get(key, 0) + 1
+
+    tracked = ups >= cycles and downs >= cycles
+    p99_ok = steady_p99 <= ack_p99_budget_s
+    return {
+        "metric": "elastic_ack_p99", "unit": "s",
+        "value": round(steady_p99, 6),
+        "platform": jax.devices()[0].platform,
+        "period_s": period_s, "cycles": cycles,
+        "peak_hz": peak_hz, "trough_hz": trough_hz,
+        "writers": writers,
+        "ops_attempted": counters["attempted"],
+        "ops_acked": counters["acked"],
+        "ops_retried": counters["retried"],
+        "partition_counts_seen": partition_counts,
+        "up_transitions": ups, "down_transitions": downs,
+        "tracked_load": tracked,
+        "epoch_final": 0 if fed.table is None else fed.table.epoch,
+        "flips": len(flips),
+        "acked_writes_lost": lost,
+        "steady_ack_p99_s": round(steady_p99, 6),
+        "steady_samples": len(steady),
+        "recovery_ack_p99_s": (round(p99(recovering), 6)
+                               if recovering else None),
+        "recovery_samples": len(recovering),
+        "ack_p99_budget_s": ack_p99_budget_s,
+        "slo_probe_budget_s": slo_budget_s,
+        "recovery_window_s": recovery_s,
+        "autoscale_decisions": decisions,
+        "writer_errors": writer_errors,
+        "within_budget": (tracked and lost == 0 and p99_ok
+                          and not writer_errors),
+        "_slo": slo,
+        # Partitions, replicas, controller and clients all time-slice
+        # one host's cores over loopback: the elasticity and the
+        # zero-loss gates are real, the latency envelope is not a
+        # multi-host number.
+        "_host_class": host_class() + "-colocated",
+        "downscale_caveat": (
+            "federation colocated on one host (loopback, shared "
+            "cores); ack p99 excludes real network + scheduling "
+            "jitter, and flip recovery windows are priced "
+            "separately"),
+    }
+
+
 def bench_ingest(n_slots: int = 1 << 14, rows: int = 1024,
                  batches: int = 64, repeats: int = 24) -> dict:
     """Write-path fast lane: staged ingest() vs unbatched put_batch.
@@ -2254,7 +2517,7 @@ def main() -> None:
                     choices=("stream", "distinct", "e2e", "e2e-kernel",
                              "sync", "ingest", "types", "antientropy",
                              "serve", "federate", "failover",
-                             "collective"),
+                             "collective", "elastic"),
                     default="stream",
                     help="stream: write-stream replay (chunk replayed "
                          "with +1ms offsets); distinct: HBM-resident "
@@ -2293,7 +2556,13 @@ def main() -> None:
                          "member mesh vs the same-host sync_packed "
                          "loopback — wall time, dispatches-per-round "
                          "(asserted == 1), bytes-to-wire (asserted "
-                         "== 0), dispatch-floor re-read")
+                         "== 0), dispatch-floor re-read; elastic: "
+                         "sine-wave load against the Autoscaler "
+                         "daemon — partition count must track the "
+                         "load for >= 2 full cycles (splits on the "
+                         "rise, merges on the fall) with zero acked "
+                         "writes lost and steady ack p99 within the "
+                         "federate envelope")
     ap.add_argument("--sessions", type=int, default=None,
                     help="serve/federate mode: concurrent client "
                          "sessions (serve default 10000, federate "
@@ -2359,6 +2628,21 @@ def main() -> None:
             slots_per_writer=4 if args.smoke else 8,
             kills=3 if args.smoke else 5,
             rate_hz=50.0 if args.smoke else 100.0,
+            n_slots=1 << 10 if args.smoke else 1 << 14)
+    elif args.mode == "elastic":
+        # >= 2 full sine cycles even in smoke: the acceptance gate is
+        # the partition count tracking the load both ways, not
+        # throughput.
+        result = bench_elastic(
+            period_s=3.0 if args.smoke else 6.0,
+            cycles=2,
+            peak_hz=500.0 if args.smoke else 600.0,
+            trough_hz=25.0 if args.smoke else 30.0,
+            writers=4,
+            max_partitions=args.partitions,
+            scaler_interval=0.15 if args.smoke else 0.2,
+            cooldown_s=0.5 if args.smoke else 0.8,
+            settle_s=1.2 if args.smoke else 1.5,
             n_slots=1 << 10 if args.smoke else 1 << 14)
     elif args.mode == "types":
         result = bench_types(n_slots=1 << 10,

@@ -44,6 +44,7 @@ from .net import (FrameCodec, PeerConnection, SyncError,
 from .serve import ServeTier
 from .routing import PartitionRouter, RoutingTable
 from .federation import FederatedClient, FederatedTier
+from .autoscale import Autoscaler
 from .replication import ReplicaGroup, Replicator
 from .ops.packing import PackedDelta
 from .obs import (MetricsRegistry, TraceRing, default_registry,
@@ -74,7 +75,7 @@ __all__ = [
     "SyncRedirectError", "WireTally",
     "fetch_metrics", "ServeTier",
     "RoutingTable", "PartitionRouter", "FederatedTier",
-    "FederatedClient", "ReplicaGroup", "Replicator",
+    "FederatedClient", "Autoscaler", "ReplicaGroup", "Replicator",
     "GossipNode", "Peer", "RetryPolicy", "BreakerPolicy", "CircuitBreaker",
     "load_dense", "load_json", "save_dense", "save_json",
     "load_gossip_state", "save_gossip_state",

@@ -78,6 +78,15 @@ reproduced bugs):
   (``crdt_tpu_collective_fallback_total`` / ``stats.fallbacks``) nor
   re-raises; a co-located round silently landing on the socket path
   is an invisible topology regression (docs/COLLECTIVE.md).
+- ``scale-decision-unfenced`` — in a class owning a federation handle
+  (``self.fed`` assigned in ``__init__``, the autoscaler shape), a
+  ``split_hot``/``merge_cold`` invocation without BOTH fences
+  lexically before it: the table-epoch consult (any ``epoch``
+  attribute/name read — the stale-observation fence) and the
+  in-flight guard (any name containing ``inflight``/``in_flight``).
+  A scale decision acted on a stale epoch can retire an arc a
+  concurrent change just made hot, and overlapping changes race each
+  other's ``_control`` hold (docs/FEDERATION.md).
 
 The linter is purely lexical/AST — no imports of the linted code — so
 it runs on broken or unimportable files (the self-test fixtures).
@@ -114,6 +123,7 @@ RULES = (
     "router-epoch-bypass",
     "collective-socket-fallback-silent",
     "ack-before-replicate",
+    "scale-decision-unfenced",
     "suppression-without-reason",
 )
 
@@ -938,6 +948,98 @@ def _check_ack_before_replicate(tree: ast.AST,
     return out
 
 
+_SCALE_CALLS = {"split_hot", "merge_cold"}
+
+
+def _ident_contains(name: str, needles: Tuple[str, ...]) -> bool:
+    low = name.lower()
+    return any(n in low for n in needles)
+
+
+def _check_scale_fence(tree: ast.AST, path: str) -> List[Finding]:
+    """In a class owning a federation handle (``self.fed`` assigned
+    in ``__init__`` — the autoscaler shape), any method that fires a
+    topology change (a ``split_hot``/``merge_cold`` call) must
+    consult BOTH fences lexically first: the table epoch (an
+    attribute or name containing ``epoch`` — the stale-observation
+    fence) and the in-flight guard (a name containing ``inflight`` /
+    ``in_flight``). A decision acted on a stale epoch can retire an
+    arc a concurrent change just made hot; a second change fired
+    while one is in flight races its ``_control`` hold
+    (docs/FEDERATION.md)."""
+    out: List[Finding] = []
+    for cls in ast.walk(tree):
+        if not isinstance(cls, ast.ClassDef):
+            continue
+        owns_fed = False
+        for fn in cls.body:
+            if isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)) \
+                    and fn.name == "__init__":
+                for n in ast.walk(fn):
+                    if isinstance(n, ast.Attribute) \
+                            and n.attr == "fed" \
+                            and isinstance(n.value, ast.Name) \
+                            and n.value.id == "self" \
+                            and isinstance(n.ctx, ast.Store):
+                        owns_fed = True
+        if not owns_fed:
+            continue
+        for fn in cls.body:
+            if not isinstance(fn, (ast.FunctionDef,
+                                   ast.AsyncFunctionDef)) \
+                    or fn.name == "__init__":
+                continue
+            epoch_line = None
+            inflight_line = None
+            calls: List[ast.Call] = []
+            for n in ast.walk(fn):
+                if isinstance(n, ast.Attribute) \
+                        and isinstance(n.ctx, ast.Load):
+                    if _ident_contains(n.attr, ("epoch",)):
+                        if epoch_line is None \
+                                or n.lineno < epoch_line:
+                            epoch_line = n.lineno
+                    if _ident_contains(n.attr,
+                                       ("inflight", "in_flight")):
+                        if inflight_line is None \
+                                or n.lineno < inflight_line:
+                            inflight_line = n.lineno
+                if isinstance(n, ast.Name) \
+                        and isinstance(n.ctx, ast.Load):
+                    if _ident_contains(n.id, ("epoch",)):
+                        if epoch_line is None \
+                                or n.lineno < epoch_line:
+                            epoch_line = n.lineno
+                    if _ident_contains(n.id,
+                                       ("inflight", "in_flight")):
+                        if inflight_line is None \
+                                or n.lineno < inflight_line:
+                            inflight_line = n.lineno
+                if isinstance(n, ast.Call) \
+                        and isinstance(n.func, ast.Attribute) \
+                        and n.func.attr in _SCALE_CALLS:
+                    calls.append(n)
+            for call in calls:
+                missing = []
+                if epoch_line is None or call.lineno < epoch_line:
+                    missing.append("the table-epoch fence")
+                if inflight_line is None \
+                        or call.lineno < inflight_line:
+                    missing.append("the in-flight guard")
+                if missing:
+                    out.append(Finding(
+                        rule="scale-decision-unfenced", path=path,
+                        line=call.lineno,
+                        message=f"{fn.name}() invokes "
+                                f"{call.func.attr}() without "
+                                f"consulting {' or '.join(missing)} "
+                                "first — a stale observation can "
+                                "retire a fresh arc, and overlapping "
+                                "topology changes race each other "
+                                "(docs/FEDERATION.md)"))
+    return out
+
+
 _ALL_CHECKS = (
     _check_sockets,
     _check_lock_discipline,
@@ -953,6 +1055,7 @@ _ALL_CHECKS = (
     _check_router_bypass,
     _check_collective_fallback,
     _check_ack_before_replicate,
+    _check_scale_fence,
 )
 
 

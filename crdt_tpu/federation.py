@@ -30,6 +30,25 @@ tier while writes keep flowing:
    loop IS the consistency mechanism; no write is dropped because no
    write is ever acked by a tier that did not commit it.
 
+The inverse, the **live merge** (`merge_cold`), retires a cold
+partition the same way: stream every arc it owns to its ring
+neighbor in idempotent watermark rounds, flip one epoch
+(`RoutingTable.merge` — the recipient must already be an owner),
+drain the donor's last flush tick, then retire the donor tier AND
+its replica group, re-homing its watch sessions with a typed
+``moved`` that carries the flip watermark so re-subscriptions resume
+without a gap. Crash-safety is asymmetric around the flip: before
+it, the table still names the donor, so any failure (including the
+donor primary dying) aborts cleanly and the merge is simply
+retryable; after it, the arcs already belong to the recipient, so a
+donor crash hands off to the group's failover and the full arc is
+re-shipped from the new primary. A recipient crash never flips at
+all. `autoscale.Autoscaler` closes the loop: an SLO-driven daemon
+that calls `split_hot`/`merge_cold` with hysteresis, cooldown and
+epoch fencing, and freezes scaling entirely when its inputs are
+unmeasured or a group is primaryless — unmeasured is never treated
+as safe to shrink.
+
 Geometry: every partition replica is built with the GLOBAL n_slots.
 A partition's store is sparsely occupied outside its ranges, which is
 exactly what makes range streaming, Merkle walks and `merge_packed`
@@ -65,19 +84,48 @@ _MAX_ROUNDS = 64
 def _metrics():
     from .obs.registry import default_registry
     reg = default_registry()
-    return (
-        reg.gauge("crdt_tpu_federation_epoch",
-                  "current routing-table epoch"),
-        reg.gauge("crdt_tpu_federation_partitions",
-                  "live partitions behind the federated front door"),
-        reg.counter("crdt_tpu_federation_splits_total",
-                    "completed live partition splits"),
-        reg.counter("crdt_tpu_federation_migrated_rows_total",
-                    "rows streamed to recipients during live splits"),
-        reg.histogram("crdt_tpu_federation_split_seconds",
-                      "live split wall time (first stream round to "
-                      "post-flip drain)"),
-    )
+    return {
+        "epoch": reg.gauge("crdt_tpu_federation_epoch",
+                           "current routing-table epoch"),
+        "partitions": reg.gauge(
+            "crdt_tpu_federation_partitions",
+            "live partitions behind the federated front door"),
+        # The autoscaler-facing name the ISSUE/ROADMAP specify; kept
+        # alongside the historical federation_partitions gauge so
+        # existing dashboards and the fleet CLI keep reading.
+        "partition_count": reg.gauge(
+            "crdt_tpu_partition_count",
+            "live partitions behind the federated front door"),
+        "splits": reg.counter("crdt_tpu_federation_splits_total",
+                              "completed live partition splits"),
+        "merges": reg.counter("crdt_tpu_federation_merges_total",
+                              "completed live partition merges"),
+        "migrated": reg.counter(
+            "crdt_tpu_federation_migrated_rows_total",
+            "rows streamed to recipients during live splits and "
+            "merges"),
+        "split_seconds": reg.histogram(
+            "crdt_tpu_federation_split_seconds",
+            "live split wall time (first stream round to post-flip "
+            "drain)"),
+        "merge_seconds": reg.histogram(
+            "crdt_tpu_federation_merge_seconds",
+            "live merge wall time (first stream round to donor "
+            "retire)"),
+        # Wedge detection (obs/fleet.py `evaluate_slo`): wall-clock
+        # millis when the in-flight topology change started / last
+        # made progress, 0 when idle. A change whose progress stamp
+        # stalls past the SLO budget is a hard failure — a wedged
+        # split/merge holds `_control` and freezes the scale loop.
+        "inflight_since_ms": reg.gauge(
+            "crdt_tpu_topology_change_inflight_since_ms",
+            "wall-clock ms when the in-flight topology change "
+            "started (0 = idle)"),
+        "progress_ms": reg.gauge(
+            "crdt_tpu_topology_change_progress_ms",
+            "wall-clock ms of the in-flight topology change's last "
+            "progress (0 = idle)"),
+    }
 
 
 class _Upstream:
@@ -142,9 +190,13 @@ class FederatedTier:
     `ServeTier` over its own replica, sharing one epoch-versioned
     `RoutingTable`.
 
-    ``make_crdt(partition_index)`` builds each partition's replica
+    ``make_crdt(partition_id)`` builds each partition's replica
     (global ``n_slots`` geometry — see the module docstring); the
-    default builds a CPU-backed `DenseCrdt`. ``layout="even"`` (the
+    default builds a CPU-backed `DenseCrdt`. The id is a monotone
+    spawn sequence, NOT the partition's list position: elastic
+    split/merge cycles retire and re-create partitions, and a reused
+    node name would collide with the retired generation's rows still
+    living in the survivors. ``layout="even"`` (the
     bench default) gives equal contiguous shares; ``layout="hash"``
     places consistent-hash tokens (`RoutingTable.build`).
 
@@ -168,7 +220,8 @@ class FederatedTier:
                  heartbeat_interval: float = 0.05,
                  heartbeat_timeout: float = 0.25,
                  lease_misses: int = 4,
-                 replicate_timeout: float = 0.25, **tier_kw):
+                 replicate_timeout: float = 0.25,
+                 addr_via=None, **tier_kw):
         if partitions < 1:
             raise ValueError(
                 f"partitions must be >= 1; got {partitions}")
@@ -189,15 +242,28 @@ class FederatedTier:
         self.heartbeat_timeout = float(heartbeat_timeout)
         self.lease_misses = int(lease_misses)
         self.replicate_timeout = float(replicate_timeout)
+        # Forwarded to every ReplicaGroup: maps a member's real listen
+        # address to the address the fleet advertises — the chaos-test
+        # seam that puts a FaultProxy on EVERY wire the federation
+        # uses (replication, streaming, client traffic). Replicated
+        # layouts only; bare tiers (replicas == 1) ignore it.
+        self.addr_via = addr_via
         self.tiers: List[ServeTier] = []
         # Parallel to `tiers`: the ReplicaGroup backing partition i,
         # or None when replicas == 1 (the zero-overhead layout).
         self.groups: List[Optional[object]] = []
         self.table: Optional[RoutingTable] = None
         self.last_split: Optional[dict] = None
+        self.last_merge: Optional[dict] = None
         # Serializes splits and table publication against each other;
         # the serving hot path never takes it.
         self._control = threading.Lock()
+        # Monotone partition-identity counter. Spawn names must NEVER
+        # be reused across elastic cycles: a merged-away partition's
+        # rows live on in the survivor stamped with its node id, and
+        # a later split recipient reusing that name would reject its
+        # own ancestors' rows as a duplicate node mid-migration.
+        self._spawn_seq = 0
 
     def _default_crdt(self, index: int):
         from .models.dense_crdt import DenseCrdt
@@ -221,19 +287,25 @@ class FederatedTier:
         tier.router.bind(f"{tier.host}:{tier.port}")
         return tier
 
-    def _spawn_partition(self, index: int):
-        """Spawn partition ``index``: a bare tier when ``replicas ==
-        1`` (the pre-replication layout, zero added moving parts),
-        else a started `ReplicaGroup` whose primary tier is what the
-        fleet routes to. Returns ``(primary_tier, group_or_None)``."""
+    def _spawn_partition(self):
+        """Spawn one partition under the next spawn-sequence identity:
+        a bare tier when ``replicas == 1`` (the pre-replication
+        layout, zero added moving parts), else a started
+        `ReplicaGroup` whose primary tier is what the fleet routes
+        to. Returns ``(primary_tier, group_or_None)``. The identity
+        is the monotone ``_spawn_seq``, not the list position — list
+        positions are reused as merges retire partitions, names are
+        not (see ``_spawn_seq``)."""
+        seq = self._spawn_seq
+        self._spawn_seq += 1
         if self.replicas == 1:
-            return self._spawn_tier(index), None
+            return self._spawn_tier(seq), None
         from .replication import ReplicaGroup
         grp = ReplicaGroup(
             self.n_slots, replicas=self.replicas,
             ack_replicas=self.ack_replicas, host=self.host,
-            group=f"p{index}",
-            make_crdt=lambda ri, gen, pi=index:
+            group=f"p{seq}",
+            make_crdt=lambda ri, gen, pi=seq:
                 self._replica_crdt(pi, ri, gen),
             flush_interval=self.flush_interval,
             heartbeat_interval=self.heartbeat_interval,
@@ -241,6 +313,7 @@ class FederatedTier:
             lease_misses=self.lease_misses,
             replicate_timeout=self.replicate_timeout,
             on_promote=self._on_promote,
+            addr_via=self.addr_via,
             tier_kwargs={"max_sessions": self.max_sessions,
                          **self._tier_kw})
         grp.start()
@@ -248,8 +321,8 @@ class FederatedTier:
 
     def start(self) -> "FederatedTier":
         try:
-            for i in range(self._n_initial):
-                tier, grp = self._spawn_partition(i)
+            for _ in range(self._n_initial):
+                tier, grp = self._spawn_partition()
                 self.tiers.append(tier)
                 self.groups.append(grp)
             owners = [t.router.addr for t in self.tiers]
@@ -299,9 +372,28 @@ class FederatedTier:
             else:
                 tier.router.install(table)
         self.table = table
-        g_epoch, g_parts, _, _, _ = _metrics()
-        g_epoch.set(float(table.epoch))
-        g_parts.set(float(len(self.tiers)))
+        m = _metrics()
+        m["epoch"].set(float(table.epoch))
+        m["partitions"].set(float(len(self.tiers)))
+        m["partition_count"].set(float(len(self.tiers)))
+
+    # --- wedge instrumentation (obs/fleet.py `evaluate_slo`) ---
+
+    def _change_started(self) -> None:
+        from .hlc import wall_clock_millis
+        ms = float(wall_clock_millis())
+        m = _metrics()
+        m["inflight_since_ms"].set(ms)
+        m["progress_ms"].set(ms)
+
+    def _change_progress(self) -> None:
+        from .hlc import wall_clock_millis
+        _metrics()["progress_ms"].set(float(wall_clock_millis()))
+
+    def _change_done(self) -> None:
+        m = _metrics()
+        m["inflight_since_ms"].set(0.0)
+        m["progress_ms"].set(0.0)
 
     def _on_promote(self, group, table) -> None:
         """Failover driver: a group monitor elected a new primary and
@@ -391,6 +483,44 @@ class FederatedTier:
         }
         return hot, evidence
 
+    def cold_partition(self) -> Tuple[Optional[int], dict]:
+        """Rank partitions by committed write rows and return the
+        COLDEST mergeable index plus the evidence dict (mirror of
+        `hot_partition`, feeding `merge_cold`). A partition is
+        mergeable when some OTHER partition owns a range adjacent to
+        one of its arcs — with one partition left there is nothing to
+        merge into and the index comes back None."""
+        rows = []
+        for tier in self.tiers:
+            wc = tier._wc
+            rows.append(0 if wc is None else int(wc.rows_committed))
+        cold = None
+        for i in sorted(range(len(rows)), key=lambda i: rows[i]):
+            addr = self.tiers[i].router.addr
+            if self.table is not None \
+                    and self._merge_neighbor(addr) is not None:
+                cold = i
+                break
+        evidence = {"rows_committed": rows, "cold_index": cold}
+        return cold, evidence
+
+    def _merge_neighbor(self, donor_addr: str) -> Optional[str]:
+        """The ring neighbor that absorbs a retiring donor's arcs:
+        the owner of the range following the donor's widest arc
+        (wrapping), falling back to the one preceding it. None when
+        no other owner borders the donor (single-owner table)."""
+        table = self.table
+        spans = table.ranges_of(donor_addr)
+        if not spans:
+            return None
+        lo, hi = max(spans, key=lambda r: r[1] - r[0])
+        n = table.n_slots
+        for probe in (hi % n, (lo - 1) % n):
+            owner = table.owner_of(probe)
+            if owner != donor_addr:
+                return owner
+        return None
+
     # --- the live split state machine ---
 
     def split_hot(self, src: Optional[int] = None,
@@ -431,8 +561,7 @@ class FederatedTier:
                 f"range [{lo}, {hi}) too narrow to split")
         mid = (lo + hi) // 2
 
-        recipient, recipient_group = self._spawn_partition(
-            len(self.tiers))
+        recipient, recipient_group = self._spawn_partition()
         self.tiers.append(recipient)
         self.groups.append(recipient_group)
         dst_addr = recipient.router.addr
@@ -450,12 +579,19 @@ class FederatedTier:
         migrated = 0
         mark = None
         flipped = False
-        up = _Upstream(stream_addr)
+        self._change_started()
+        # Dial INSIDE the try: a refused handshake must still run the
+        # unwind (drop the just-spawned recipient) and `_change_done`
+        # (a wedge gauge left in-flight reads as a stuck topology
+        # change forever).
+        up = None
         try:
+            up = self._dial_upstream(stream_addr)
             while rounds < _MAX_ROUNDS:
                 rounds += 1
-                shipped, mark = self._ship_range(
-                    donor, up, mark, (mid, hi))
+                shipped, mark = self._ship_ranges(
+                    donor, up, mark, ((mid, hi),))
+                self._change_progress()
                 migrated += shipped
                 if shipped <= settle_rows:
                     break
@@ -465,14 +601,15 @@ class FederatedTier:
             table = self.table.split(lo, mid, dst_addr)
             self.publish(table)
             flipped = True
+            self._change_progress()
             flip_at = time.perf_counter()
             # Drain: anything the donor enqueued pre-flip commits
             # within one flush tick; wait it out, then ship the final
             # watermark round so the recipient holds every acked row.
             time.sleep(max(donor.flush_interval * 4, 0.01))
             try:
-                shipped, mark = self._ship_range(donor, up, mark,
-                                                 (mid, hi))
+                shipped, mark = self._ship_ranges(donor, up, mark,
+                                                  ((mid, hi),))
             except ConnectionError:
                 if donor_group is None or not donor.killed:
                     raise
@@ -484,8 +621,8 @@ class FederatedTier:
                 # new primary — mark=None, because the watermark was
                 # taken against the dead store's clock.
                 donor = self._await_failover(donor_group, donor)
-                shipped, mark = self._ship_range(donor, up, None,
-                                                 (mid, hi))
+                shipped, mark = self._ship_ranges(donor, up, None,
+                                                  ((mid, hi),))
             migrated += shipped
             rounds += 1
         except BaseException:
@@ -505,13 +642,21 @@ class FederatedTier:
                     pass
             raise
         finally:
-            up.close()
+            if up is not None:
+                up.close()
+            self._change_done()
 
-        _, _, c_splits, c_rows, h_secs = _metrics()
-        c_splits.inc()
-        c_rows.inc(migrated)
+        m = _metrics()
+        m["splits"].inc()
+        m["migrated"].inc(migrated)
         dt = time.perf_counter() - t0
-        h_secs.observe(dt)
+        m["split_seconds"].observe(dt)
+        donor.last_scale = {"action": "split-donor",
+                            "epoch": self.table.epoch,
+                            "peer": dst_addr}
+        recipient.last_scale = {"action": "split-recipient",
+                                "epoch": self.table.epoch,
+                                "peer": donor_addr}
         self.last_split = {
             "src": src, "src_addr": donor_addr, "dst_addr": dst_addr,
             "range": [lo, hi], "split_at": mid,
@@ -523,17 +668,18 @@ class FederatedTier:
         }
         return self.last_split
 
-    def _ship_range(self, donor: ServeTier, up: _Upstream, mark,
-                    span: Tuple[int, int]):
-        """One streaming round: pack the donor's rows in ``span``
+    def _ship_ranges(self, donor: ServeTier, up: _Upstream, mark,
+                     spans: Tuple[Tuple[int, int], ...]):
+        """One streaming round: pack the donor's rows in ``spans``
         modified at-or-after ``mark`` (under the donor's lock, with
         the watermark taken in the SAME hold so no commit can fall
         between pack and mark), ship via push_packed, return
-        (rows, new_mark). Transport faults retry on a fresh
-        connection — the rows are idempotent lattice joins. A KILLED
-        donor raises instead of packing: its in-process store object
-        is still addressable, but a real crash would not be, and the
-        split's abort/handoff paths key off this honesty."""
+        (rows, new_mark). A split streams one half-range; a merge
+        streams every arc the donor owns. Transport faults retry on a
+        fresh connection — the rows are idempotent lattice joins. A
+        KILLED donor raises instead of packing: its in-process store
+        object is still addressable, but a real crash would not be,
+        and the abort/handoff paths key off this honesty."""
         from .ops.packing import pack_rows
         if donor.killed:
             raise ConnectionError(
@@ -541,7 +687,7 @@ class FederatedTier:
         with donor.lock:
             wm = donor.crdt.canonical_time
             packed, ids = _pack_for_peer(donor.crdt, mark, True,
-                                         ranges=(span,))
+                                         ranges=tuple(spans))
         if not packed.k:
             return 0, wm
         meta, bufs = pack_rows(packed)
@@ -571,6 +717,203 @@ class FederatedTier:
                     continue
         raise ConnectionError(
             f"range stream to {up.addr} failed after retries: {last!r}")
+
+    @staticmethod
+    def _dial_upstream(addr: str) -> _Upstream:
+        """Handshake the control-plane stream, with retries. The dial
+        is the one transport step `_ship_ranges` cannot re-run (its
+        reconnects need a session object to exist first), and a single
+        flaky accept should not abort a topology change that has not
+        moved a row yet."""
+        last: Exception = ConnectionError(f"no dial attempted: {addr}")
+        for attempt in range(8):
+            try:
+                return _Upstream(addr)
+            except (ConnectionError, OSError) as e:
+                last = e
+                time.sleep(0.05 * (attempt + 1))
+        raise ConnectionError(
+            f"upstream dial to {addr} failed after retries: {last!r}")
+
+    # --- the live merge state machine (inverse of split_hot) ---
+
+    def merge_cold(self, src: Optional[int] = None,
+                   dst_addr_override: Optional[str] = None,
+                   settle_rows: int = _SETTLE_ROWS) -> dict:
+        """Merge the cold partition away live: stream every arc it
+        owns to its ring neighbor in the same idempotent watermark
+        rounds the split uses, flip the routing epoch
+        (`RoutingTable.merge`), drain the donor's last flush tick
+        plus a final catch-up round, re-home its watch sessions, then
+        retire the donor tier AND its `ReplicaGroup`. Returns the
+        merge stats dict (also kept as ``last_merge``).
+
+        Crash-safety mirrors the split. Donor primary killed PRE-flip:
+        the stream raises, the table still names the donor, nothing
+        was spawned — the merge is simply retryable once the group
+        fails over, and the arc is served throughout. Donor killed
+        POST-flip: hand off to `_await_failover` and re-ship the full
+        arc from the new primary (write concern means every acked row
+        is on the winner). Recipient crash: `push_packed` retries
+        exhaust and the merge aborts WITHOUT flipping — the donor
+        still owns its arc, and a later retry merges into whichever
+        neighbor the recipient's own failover elected.
+
+        ``dst_addr_override`` routes the *stream* through a different
+        address than the recipient's own (tests interpose a
+        `FaultProxy` there); the routing table always names the
+        recipient's real address.
+        """
+        with self._control:
+            stats, grp, donor = self._merge_locked(
+                src, dst_addr_override, settle_rows)
+        # Stop the retired group OUTSIDE the _control hold: after a
+        # donor-kill handoff its monitor thread is parked in
+        # `_on_promote` waiting for _control, and `stop()` joins that
+        # thread — joining under the lock is a deadlock that only the
+        # join timeout would break. Released first, the monitor wakes,
+        # finds the group already detached, backs off, and the join
+        # completes immediately.
+        try:
+            if grp is not None:
+                grp.stop()
+            else:
+                donor.stop()
+        except Exception:
+            pass
+        return stats
+
+    def _merge_locked(self, src, dst_addr_override, settle_rows):
+        if self.table is None:
+            raise RuntimeError("federation not started")
+        if len(self.tiers) <= 1:
+            raise ValueError("cannot merge the last partition")
+        t0 = time.perf_counter()
+        if src is None:
+            src, evidence = self.cold_partition()
+            if src is None:
+                raise ValueError("no mergeable partition "
+                                 "(single-owner table)")
+        else:
+            evidence = {"cold_index": src, "forced": True}
+        donor = self.tiers[src]
+        donor_group = self.groups[src] if src < len(self.groups) \
+            else None
+        donor_addr = donor.router.addr
+        spans = self.table.ranges_of(donor_addr)
+        if not spans:
+            raise ValueError(f"partition {src} owns no ranges")
+        dst_addr = self._merge_neighbor(donor_addr)
+        if dst_addr is None:
+            raise ValueError(
+                f"no ring neighbor to absorb {donor_addr}")
+        recipient = self.tier_at(dst_addr)
+        stream_addr = dst_addr_override or dst_addr
+
+        rounds = 0
+        migrated = 0
+        mark = None
+        flipped = False
+        self._change_started()
+        # Dial INSIDE the try: a refused handshake must still run
+        # `_change_done`, or the wedge gauge reads as a stuck topology
+        # change forever.
+        up = None
+        try:
+            up = self._dial_upstream(stream_addr)
+            while rounds < _MAX_ROUNDS:
+                rounds += 1
+                shipped, mark = self._ship_ranges(donor, up, mark,
+                                                  spans)
+                self._change_progress()
+                migrated += shipped
+                if shipped <= settle_rows:
+                    break
+            # Flip: the donor leaves the table in one epoch bump,
+            # published everywhere — every write arriving after this
+            # instant answers moved at the recipient; writes the
+            # donor acked before it are the drain's job. The
+            # recipient's watch watermark is rewound to the flip
+            # watermark FIRST, so re-homed subscriptions cannot miss
+            # rows whose origin stamps predate the recipient's head.
+            table = self.table.merge(donor_addr, dst_addr)
+            flip_mark = mark
+            recipient.rearm_watch(flip_mark)
+            self.publish(table)
+            flipped = True
+            self._change_progress()
+            flip_at = time.perf_counter()
+            time.sleep(max(donor.flush_interval * 4, 0.01))
+            try:
+                shipped, mark = self._ship_ranges(donor, up, mark,
+                                                  spans)
+            except ConnectionError:
+                if donor_group is None or not donor.killed:
+                    raise
+                # Donor crashed AFTER the flip: the table already
+                # dropped it, so aborting would strand its arcs.
+                # Hand off: wait for the group to promote (write
+                # concern means every acked row is on the winner) and
+                # re-ship the FULL arc from the new primary —
+                # mark=None, the watermark was taken against the dead
+                # store's clock.
+                donor = self._await_failover(donor_group, donor)
+                shipped, mark = self._ship_ranges(donor, up, None,
+                                                  spans)
+            migrated += shipped
+            rounds += 1
+            self._change_progress()
+        except BaseException:
+            # Pre-flip abort: the table still names the donor, so the
+            # arc is served throughout and there is nothing to unwind
+            # — the merge is simply retryable (after a donor-group
+            # failover the retry streams from the new primary).
+            # Post-flip, reaching here means the handoff above also
+            # failed; the arcs belong to the recipient and acked rows
+            # are on the donor group's survivors by write concern —
+            # surface the error, the retire just did not happen.
+            raise
+        finally:
+            if up is not None:
+                up.close()
+            self._change_done()
+
+        # Retire the donor: re-home its watch sessions (typed moved +
+        # flip-watermark resume at the recipient) and drop it from
+        # the partition lists under _control (a late _on_promote for
+        # this group then finds nothing and backs off). The caller
+        # stops the group after releasing _control — heartbeats,
+        # leases and replicator ships cease, the addresses are
+        # released, and the fleet poller loses the member on its next
+        # scrape.
+        rehomed = donor.rehome_watchers(
+            dst_addr, table.epoch,
+            since=None if flip_mark is None else str(flip_mark))
+        del self.tiers[src]
+        grp = self.groups.pop(src) if src < len(self.groups) else None
+
+        m = _metrics()
+        m["merges"].inc()
+        m["migrated"].inc(migrated)
+        dt = time.perf_counter() - t0
+        m["merge_seconds"].observe(dt)
+        # publish() ran before the retire, so refresh the partition
+        # gauges now that the donor is gone.
+        m["partitions"].set(float(len(self.tiers)))
+        m["partition_count"].set(float(len(self.tiers)))
+        recipient.last_scale = {"action": "merge-absorb",
+                                "epoch": table.epoch,
+                                "peer": donor_addr}
+        self.last_merge = {
+            "src": src, "src_addr": donor_addr, "dst_addr": dst_addr,
+            "spans": [list(s) for s in spans],
+            "rounds": rounds, "migrated_rows": migrated,
+            "epoch": self.table.epoch, "seconds": dt,
+            "drain_rows": shipped, "rehomed_watchers": rehomed,
+            "flip_to_drain_seconds": time.perf_counter() - flip_at,
+            "evidence": evidence,
+        }
+        return self.last_merge, grp, donor
 
 
 class FederatedClient:
@@ -603,6 +946,7 @@ class FederatedClient:
         self.table: Optional[RoutingTable] = None
         self.moved_redirects = 0
         self.busy_retries = 0
+        self.redirect_resets = 0
         self.refresh()
 
     # --- plumbing ---
@@ -655,11 +999,29 @@ class FederatedClient:
 
     # --- keyspace ops ---
 
+    def _next_attempt(self, attempt: int, epoch_seen: int) -> int:
+        """Redirect-budget accounting for one retry: a refresh that
+        actually ADVANCED the table epoch means the fleet's topology
+        moved under this op — the attempt bought progress, not a
+        spin, so the budget resets. Back-to-back topology changes (a
+        split chased by a merge chased by a failover) therefore can
+        never burn the whole budget on one churn burst, while the
+        budget still bounds consecutive attempts that learn nothing
+        (resetting on ANY refresh would loop forever against a
+        permanently stale table)."""
+        if self.table is not None and self.table.epoch > epoch_seen:
+            self.redirect_resets += 1
+            return 0
+        return attempt + 1
+
     def _keyspace(self, msg: dict, slot: int,
                   want_field: str = "ok") -> dict:
         if self.table is None:
             self.refresh()
-        for attempt in range(self._max_redirects):
+        attempt = 0
+        while attempt < self._max_redirects:
+            epoch_seen = -1 if self.table is None \
+                else self.table.epoch
             owner = self.table.owner_of(slot)
             msg["epoch"] = self.table.epoch
             try:
@@ -672,6 +1034,7 @@ class FederatedClient:
                 self._drop_session(owner)
                 self._backoff(attempt)
                 self._try_refresh()
+                attempt = self._next_attempt(attempt, epoch_seen)
                 continue
             if isinstance(reply, dict) and reply.get("ok"):
                 return reply
@@ -683,6 +1046,7 @@ class FederatedClient:
                 # SyncRedirectError; here we stay dict-level.)
                 self.moved_redirects += 1
                 self._try_refresh()
+                attempt = self._next_attempt(attempt, epoch_seen)
                 continue
             if code == "busy":
                 # Routing flux, a write-concern barrier miss, or a
@@ -692,6 +1056,7 @@ class FederatedClient:
                 self.busy_retries += 1
                 self._backoff(attempt)
                 self._try_refresh()
+                attempt = self._next_attempt(attempt, epoch_seen)
                 continue
             raise ValueError(f"op {msg.get('op')!r} rejected: "
                              f"{reply!r}")
@@ -729,53 +1094,93 @@ class _WatchSession:
     socket would interleave streams)."""
 
     def __init__(self, addr: str, slots, timeout: float = 30.0):
-        self._up = _Upstream(addr, timeout=timeout)
+        self._timeout = timeout
         # The server's WatchIndex routes by INTEREST but ships the
         # shared tick pack (zero-copy fan-out: one pack, N writers);
         # slot-scoped subscriptions filter here, client-side.
+        self._slots = (None if slots is None
+                       else [int(s) for s in slots])
         self._filter = (None if slots is None
-                        else frozenset(int(s) for s in slots))
+                        else frozenset(self._slots))
+        self._up: Optional[_Upstream] = None
+        self.addr = addr
+        self.moved_rehomes = 0
+        self._subscribe(addr)
+
+    def _subscribe(self, addr: str,
+                   since: Optional[str] = None) -> None:
+        """(Re)subscribe at ``addr`` with the original slot filter —
+        the initial registration AND the typed-``moved`` re-home a
+        partition merge pushes to live sessions. ``since`` is the
+        resume mark a moved frame carries: the recipient rewinds its
+        fan-out watermark to it at registration, so no commit event
+        is dropped across the move."""
+        up = _Upstream(addr, timeout=self._timeout)
         msg: dict = {"op": "watch"}
-        if slots is not None:
-            msg["slots"] = [int(s) for s in slots]
-        reply = self._up.request(msg)
+        if self._slots is not None:
+            msg["slots"] = self._slots
+        if since is not None:
+            msg["since"] = str(since)
+        reply = up.request(msg)
         if not (isinstance(reply, dict) and reply.get("ok")):
-            self._up.close()
+            up.close()
             raise ConnectionError(f"watch refused: {reply!r}")
+        if self._up is not None:
+            self._up.close()
+        self._up = up
+        self.addr = addr
         self.since = reply.get("since")
 
     def next_event(self, timeout: Optional[float] = None
                    ) -> List[Tuple[int, Any]]:
         """Block for one pushed event pack; returns decoded
         (slot, value) pairs (None value = tombstone; typed lanes
-        decode through their registered semantics)."""
+        decode through their registered semantics). A typed ``moved``
+        frame — the partition this subscription lived on was merged
+        away — transparently resubscribes at the named owner: the
+        recipient's fan-out watermark was rewound to the flip
+        watermark server-side, so no commit event is dropped across
+        the move."""
         from .ops.packing import unpack_rows
         from .semantics import by_tag
-        if timeout is not None:
-            self._up.sock.settimeout(timeout)
-        meta_msg = self._up.recv()
-        if not (isinstance(meta_msg, dict)
-                and meta_msg.get("op") == "event"):
-            raise ConnectionError(
-                f"watch stream broke: {meta_msg!r}")
-        blob = self._up.recv_blob()
-        if blob is None:
-            raise ConnectionError("watch stream EOF mid-event")
-        packed = unpack_rows(meta_msg["meta"], blob)
-        out: List[Tuple[int, Any]] = []
-        sem = packed.sem
-        for i in range(packed.k):
-            slot = int(packed.slots[i])
-            if self._filter is not None and slot not in self._filter:
+        for _ in range(4):   # absorb back-to-back re-homes
+            if timeout is not None:
+                self._up.sock.settimeout(timeout)
+            meta_msg = self._up.recv()
+            if isinstance(meta_msg, dict) \
+                    and meta_msg.get("code") == "moved":
+                owner = meta_msg.get("owner")
+                if not owner:
+                    raise ConnectionError(
+                        f"watch moved without owner: {meta_msg!r}")
+                self.moved_rehomes += 1
+                self._subscribe(str(owner), meta_msg.get("since"))
                 continue
-            if packed.tomb[i]:
-                out.append((slot, None))
-                continue
-            lane = int(packed.val[i])
-            tag = int(sem[i]) if sem is not None else 0
-            out.append((slot,
-                        lane if tag == 0 else by_tag(tag).decode(lane)))
-        return out
+            if not (isinstance(meta_msg, dict)
+                    and meta_msg.get("op") == "event"):
+                raise ConnectionError(
+                    f"watch stream broke: {meta_msg!r}")
+            blob = self._up.recv_blob()
+            if blob is None:
+                raise ConnectionError("watch stream EOF mid-event")
+            packed = unpack_rows(meta_msg["meta"], blob)
+            out: List[Tuple[int, Any]] = []
+            sem = packed.sem
+            for i in range(packed.k):
+                slot = int(packed.slots[i])
+                if self._filter is not None \
+                        and slot not in self._filter:
+                    continue
+                if packed.tomb[i]:
+                    out.append((slot, None))
+                    continue
+                lane = int(packed.val[i])
+                tag = int(sem[i]) if sem is not None else 0
+                out.append((slot, lane if tag == 0
+                            else by_tag(tag).decode(lane)))
+            return out
+        raise ConnectionError(
+            "watch re-homed more than 4 times in one poll")
 
     def close(self) -> None:
         self._up.close()

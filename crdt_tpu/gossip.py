@@ -902,6 +902,13 @@ class GossipNode:
             extra["replication"] = {
                 "group": tier.group_name, "role": tier.role,
                 "lease_ms": tier._lease_ms()}
+        if tier is not None:
+            # Per-partition load roll-up for the fleet table
+            # (obs/fleet.py format_partitions) — present only when
+            # the tier is a federated partition.
+            part = tier.partition_info()
+            if part is not None:
+                extra["partition"] = part
         return extra
 
     # --- fleet canary (obs/probe.py) ---

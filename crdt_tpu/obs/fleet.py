@@ -44,10 +44,14 @@ from typing import Any, Dict, List, Optional, Tuple
 from .render import _fmt, _labels, _table
 
 # Default budgets: the serve ack budget SERVE_r01 was judged against
-# (p99 <= 4.25 ms) and a convergence budget loose enough for WAN
-# gossip but tight enough to catch a wedged peer.
+# (p99 <= 4.25 ms), a convergence budget loose enough for WAN gossip
+# but tight enough to catch a wedged peer, and a topology-change
+# progress budget — a split/merge whose progress stamp stalls longer
+# than this holds the federation's control lock and has frozen the
+# scale loop (federation.py wedge gauges).
 ACK_P99_BUDGET_S = 0.00425
 CONVERGENCE_BUDGET_S = 5.0
+TOPOLOGY_STALL_BUDGET_S = 30.0
 
 
 def parse_peers(spec: str) -> List[Tuple[str, str, int]]:
@@ -183,10 +187,52 @@ def _check(value: Optional[float], budget: float,
     return {"value": value, "budget": budget, "ok": ok}
 
 
+def _gauge_max(snap: dict, name: str) -> Optional[float]:
+    vals = [s["value"] for s in snap.get("gauges", {}).get(name, [])
+            if s.get("value") is not None]
+    return max(vals) if vals else None
+
+
+def topology_stall_s(snapshots: Dict[str, dict],
+                     now_ms: Optional[float] = None
+                     ) -> Optional[float]:
+    """Seconds since the in-flight topology change last made progress,
+    0.0 when no change is in flight, None when no snapshot exposes the
+    wedge gauges (pre-elastic fleets). A change is "in flight" when
+    any snapshot's ``crdt_tpu_topology_change_inflight_since_ms`` is
+    non-zero; staleness is measured against the matching
+    ``..._progress_ms`` stamp. Pure given ``now_ms``."""
+    if now_ms is None:
+        from ..hlc import wall_clock_millis
+        now_ms = float(wall_clock_millis())
+    seen = False
+    worst: Optional[float] = None
+    for snap in snapshots.values():
+        if not isinstance(snap, dict):
+            continue
+        inflight = _gauge_max(
+            snap, "crdt_tpu_topology_change_inflight_since_ms")
+        if inflight is None:
+            continue
+        seen = True
+        if inflight <= 0:
+            continue
+        progress = _gauge_max(
+            snap, "crdt_tpu_topology_change_progress_ms") or inflight
+        stall = max(0.0, (now_ms - progress) / 1000.0)
+        worst = stall if worst is None else max(worst, stall)
+    if not seen:
+        return None
+    return worst if worst is not None else 0.0
+
+
 def evaluate_slo(snapshots: Dict[str, dict],
                  matrix: Optional[Dict[str, Any]] = None, *,
                  ack_p99_budget_s: float = ACK_P99_BUDGET_S,
-                 convergence_budget_s: float = CONVERGENCE_BUDGET_S
+                 convergence_budget_s: float = CONVERGENCE_BUDGET_S,
+                 topology_stall_budget_s: float =
+                 TOPOLOGY_STALL_BUDGET_S,
+                 now_ms: Optional[float] = None
                  ) -> Dict[str, Any]:
     """Machine-readable fleet SLO verdict (see module docstring)."""
     if matrix is None:
@@ -228,6 +274,13 @@ def evaluate_slo(snapshots: Dict[str, dict],
         "groups_without_primary": _check(
             float(len(missing)) if health["groups"] else None, 0.0,
             ok=primary_ok),
+        # A wedged in-flight topology change is a hard failure: the
+        # stalled split/merge holds the federation's control lock, so
+        # promotions queue behind it and the autoscaler is frozen —
+        # the fleet cannot react to anything until it clears.
+        "topology_change_stall_s": _check(
+            topology_stall_s(snapshots, now_ms=now_ms),
+            topology_stall_budget_s),
     }
     measured = [c["ok"] for c in checks.values()
                 if c["ok"] is not None]
@@ -326,6 +379,38 @@ def format_replicas(health: Dict[str, Any]) -> str:
     return text
 
 
+def format_partitions(snapshots: Dict[str, dict]) -> str:
+    """Human-readable per-partition table from the ``partition``
+    sections of scraped (or in-process) metrics snapshots, ranked by
+    committed-row load (rank 1 = hottest) with the last scale action
+    each partition took part in — the at-a-glance view of what the
+    autoscaler has been doing. Empty string when no snapshot carries
+    a partition section. Pure."""
+    parts = []
+    for name, snap in snapshots.items():
+        if isinstance(snap, dict) and isinstance(
+                snap.get("partition"), dict):
+            parts.append((name, snap["partition"]))
+    if not parts:
+        return ""
+    parts.sort(key=lambda kv: (
+        -(kv[1].get("rows_committed") or 0), kv[0]))
+    headers = ["rank", "instance", "addr", "epoch", "slots", "rows",
+               "queue", "shed", "last_scale"]
+    rows = []
+    for rank, (name, p) in enumerate(parts, 1):
+        ls = p.get("last_scale") or {}
+        last = str(ls.get("action") or "-")
+        if ls.get("epoch") is not None:
+            last += f"@e{ls['epoch']}"
+        rows.append([str(rank), name, str(p.get("addr")),
+                     str(p.get("epoch")), str(p.get("slots")),
+                     str(p.get("rows_committed")),
+                     str(p.get("queue_depth")), str(p.get("shed")),
+                     last])
+    return "\n".join(_table(headers, rows)) + "\n"
+
+
 def format_matrix(matrix: Dict[str, Any]) -> str:
     """Human-readable (origin × observer) lag table, seconds."""
     if not matrix["origins"]:
@@ -387,6 +472,7 @@ def fleet_main(argv: Optional[List[str]] = None, out=None) -> int:
         else:
             out.write(format_matrix(matrix))
             out.write(format_replicas(verdict["replication"]))
+            out.write(format_partitions(snapshots))
             out.write(f"slo ok={verdict['ok']} "
                       f"{json.dumps(verdict['checks'])}\n")
         out.flush()

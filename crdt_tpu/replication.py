@@ -55,9 +55,10 @@ from typing import Callable, Dict, List, Optional, Tuple
 
 from .gossip import BreakerPolicy, CircuitBreaker
 from .hlc import Hlc
-from .net import (PeerConnection, SyncError, WireTally,
-                  _pack_for_peer, recv_frame, send_bytes_frame,
-                  send_frame, sync_merkle_over_conn)
+from .net import (PeerConnection, SyncError, SyncProtocolError,
+                  WireTally, _pack_for_peer, recv_frame,
+                  send_bytes_frame, send_frame,
+                  sync_merkle_over_conn)
 from .routing import PartitionRouter, RoutingTable
 from .serve import ServeTier
 
@@ -620,6 +621,38 @@ class ReplicaGroup:
                 return
             scored.sort(key=lambda s: (s[0], s[1], s[2]))
             winner = scored[-1][3]
+            # Close the ack-coverage gap BEFORE the routing flip:
+            # with ack_replicas < followers, each tick's write
+            # concern is satisfied by whichever follower acked
+            # first, so no single follower — the freshest-head
+            # winner included — is guaranteed a superset of every
+            # acked row. Lattice-join the winner from each
+            # reachable survivor so promotion never buries a row
+            # some other follower acked. Best-effort per survivor:
+            # losing the primary AND the only follower holding a
+            # tick exceeds what ack_replicas=1 promises.
+            for m in candidates:
+                if m is winner or m.addr is None:
+                    continue
+                host, port = _split_addr(m.addr)
+                for attempt in range(2):
+                    try:
+                        conn = PeerConnection(
+                            host, port,
+                            timeout=self.heartbeat_timeout * 4)
+                    except (ConnectionError, OSError):
+                        continue
+                    try:
+                        sync_merkle_over_conn(
+                            winner.tier.crdt, conn,
+                            lock=winner.tier.lock)
+                        break
+                    except SyncProtocolError:
+                        break
+                    except (ConnectionError, OSError):
+                        pass
+                    finally:
+                        conn.close()
             self._promote(winner, old_addr)
         elapsed = time.perf_counter() - t0
         with self._lock:
@@ -685,13 +718,36 @@ class ReplicaGroup:
         # Catch up BEFORE serving: the walk pulls everything the
         # group committed while this member was dead (and pushes
         # nothing — the store is fresh).
+        # The walk only PULLS into the fresh store, so re-running it
+        # after a transport fault is idempotent — and each pass has
+        # less left to fetch. A proxied/chaos wire dropping one
+        # connection must not fail the whole rejoin; a protocol
+        # rejection (explicit error report) stays fatal.
         host, port = _split_addr(primary.addr)
-        conn = PeerConnection(host, port,
-                              timeout=self.heartbeat_timeout * 4)
-        try:
-            sync_merkle_over_conn(crdt, conn)
-        finally:
-            conn.close()
+        last: Optional[Exception] = None
+        for attempt in range(6):
+            try:
+                conn = PeerConnection(
+                    host, port, timeout=self.heartbeat_timeout * 4)
+            except (ConnectionError, OSError) as e:
+                last = e
+                time.sleep(0.05 * (attempt + 1))
+                continue
+            try:
+                sync_merkle_over_conn(crdt, conn)
+                last = None
+                break
+            except SyncProtocolError:
+                raise
+            except (ConnectionError, OSError) as e:
+                last = e
+                time.sleep(0.05 * (attempt + 1))
+            finally:
+                conn.close()
+        if last is not None:
+            raise ConnectionError(
+                f"rejoin catch-up from {primary.addr} failed after "
+                f"retries: {last!r}")
         with self._lock:
             router = PartitionRouter()
             # Rebind the member's previous listen address: a crashed

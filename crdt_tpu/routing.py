@@ -235,6 +235,33 @@ class RoutingTable:
         return RoutingTable(self.n_slots, self.epoch + 1,
                             self._merge_adjacent(out))
 
+    def merge(self, retiring: str, into: str) -> "RoutingTable":
+        """New table (epoch + 1) with every range of ``retiring``
+        handed to ``into`` — the routing flip at the end of a live
+        merge (`FederatedTier.merge_cold`). Unlike `reassign`, the
+        recipient must ALREADY be an owner: a merge shrinks the fleet
+        by one partition, it never introduces an address, so a typo'd
+        recipient fails here instead of minting a ghost owner the
+        fleet would route writes to. Adjacent ranges coalesce, so a
+        donor arc bordered by the recipient's own arc disappears from
+        the range list entirely."""
+        old, new = str(retiring), str(into)
+        if old == new:
+            raise ValueError(f"cannot merge {old!r} into itself")
+        owners = self.owners()
+        if old not in owners:
+            raise ValueError(f"{old!r} owns no ranges at epoch "
+                             f"{self.epoch}")
+        if new not in owners:
+            raise ValueError(
+                f"merge recipient {new!r} owns no ranges at epoch "
+                f"{self.epoch}; a merge hands arcs to an EXISTING "
+                f"owner (use reassign for promotion flips)")
+        out = [(lo, hi, new if o == old else o)
+               for lo, hi, o in self.ranges]
+        return RoutingTable(self.n_slots, self.epoch + 1,
+                            self._merge_adjacent(out))
+
     @staticmethod
     def newest(a: Optional["RoutingTable"],
                b: Optional["RoutingTable"]) -> Optional["RoutingTable"]:

@@ -252,6 +252,11 @@ class ServeTier:
         # slots answer `moved` (or proxy for pre-federation sessions),
         # and the `federation` hello cap is advertised.
         self.router = router
+        # Elastic repartitioning breadcrumb: the last split/merge this
+        # partition took part in, stamped by the federation's scale
+        # engine and surfaced on the metrics wire for the fleet table
+        # (obs/fleet.py `format_partitions`).
+        self.last_scale: Optional[dict] = None
         # Replication (docs/REPLICATION.md): a primary carries a
         # `Replicator` (replication.py) — the flush tick's write-concern
         # barrier — while followers carry None and learn their role
@@ -679,12 +684,119 @@ class ServeTier:
         self._watch_codec.pop(writer, None)
         self._m_watchers.set(len(self._watch), node=self._node)
 
-    def _watch_arm(self) -> str:
+    def rearm_watch(self, mark) -> None:
+        """Rewind the watch pack watermark to ``mark`` (keeping the
+        MORE inclusive of the two — None means "from store birth" and
+        is never overwritten). The merge engine calls this on the
+        RECIPIENT at the routing flip: rows streamed over from the
+        donor keep their ORIGIN HLC stamps, which an already-advanced
+        recipient watermark would silently skip, so the first fan-out
+        tick after re-homed watchers subscribe must pack from the flip
+        watermark. Rewinding re-delivers rows between the two marks to
+        existing watchers — watch delivery is at-least-once and the
+        rows are idempotent lattice states, so re-applying is safe."""
+        with self.lock:
+            cur = self._watch_mark
+            if cur is not None and (mark is None or mark < cur):
+                self._watch_mark = mark
+
+    def rehome_watchers(self, owner: str, epoch: int,
+                        since: Optional[str] = None,
+                        timeout: float = 5.0) -> int:
+        """Push a typed ``moved`` frame to every live watch session
+        and deregister it — the watch half of a partition retire. Runs
+        on the serve loop (watch state is loop-confined) and blocks
+        the calling control thread until the frames are flushed, so
+        the tier stop that follows cannot RST them off the wire.
+        Returns the number of sessions re-homed."""
+        loop = self._loop
+        if loop is None or self._thread is None or self.killed:
+            return 0
+
+        async def _push() -> int:
+            msg = {"op": "moved", "ok": False, "code": "moved",
+                   "owner": owner, "epoch": int(epoch),
+                   "error": (f"partition merged into {owner} at "
+                             f"routing epoch {epoch}")}
+            if since is not None:
+                # Resume mark: the merge's flip watermark. The client
+                # resubscribes with it so the recipient re-packs from
+                # there regardless of interleaved fan-out ticks.
+                msg["since"] = str(since)
+            raw = [json.dumps(msg).encode()]
+            moved = 0
+            for w in list(self._watch.watchers()):
+                codec = self._watch_codec.get(w)
+                try:
+                    w.writelines(frame_pieces(raw, codec))
+                    await w.drain()
+                except (ConnectionError, OSError):
+                    pass
+                self._drop_watcher(w)
+                moved += 1
+            return moved
+
+        fut = asyncio.run_coroutine_threadsafe(_push(), loop)
+        try:
+            return fut.result(timeout)
+        except (TimeoutError, RuntimeError, OSError):
+            fut.cancel()
+            return 0
+
+    def partition_info(self) -> Optional[dict]:
+        """Per-partition load/ownership roll-up for the fleet poller
+        (obs/fleet.py `format_partitions`): address, routing epoch,
+        owned-slot count, cumulative committed rows, instantaneous
+        queue depth, shed count, and the last scale action this
+        partition took part in. None when the tier is not a federated
+        partition (no bound router)."""
+        router = self.router
+        if router is None or router.addr is None:
+            return None
+        table = router.table
+        wc = self._wc
+        info = {
+            "addr": router.addr,
+            "epoch": None if table is None else table.epoch,
+            "slots": (None if table is None
+                      else table.slots_of(router.addr)),
+            "rows_committed": (0 if wc is None
+                               else int(wc.rows_committed)),
+            # len() on the loop-confined queue is a torn-free read
+            # under the GIL — a load signal, not an invariant.
+            "queue_depth": len(self._q),
+            "shed": int(self.shed_count),
+        }
+        if self.last_scale is not None:
+            info["last_scale"] = dict(self.last_scale)
+        return info
+
+    def _watch_arm(self, since: Optional[str] = None) -> str:
         """Register-time replica touch: the head stamp the reply
         reports, also seeding the pack watermark so event streams
-        start at subscription time, not store birth."""
+        start at subscription time, not store birth. A ``since``
+        stamp (the resume mark a merge's ``moved`` frame hands a
+        re-homed subscription) rewinds the watermark at REGISTRATION
+        time, so rows committed between the routing flip and this
+        resubscribe are re-packed at the next tick no matter how many
+        fan-out ticks other watchers drove in between."""
+        from .hlc import Hlc
+        mark = None
+        if since is not None:
+            try:
+                mark = Hlc.parse(str(since))
+            except (ValueError, TypeError, IndexError):
+                mark = None   # malformed resume mark: plain subscribe
         with self.lock:
             head = self.crdt.canonical_time
+            # A None watermark on a store with no watcher ever armed
+            # carries no from-birth promise to anyone, so a resume
+            # mark may seed it directly — a re-homed subscription
+            # must start at the flip watermark, not at head, or the
+            # commits it is resuming across are silently skipped.
+            if mark is not None and (self._watch_mark is None
+                                     or mark < self._watch_mark):
+                self._watch_mark = mark
             if self._watch_mark is None:
                 self._watch_mark = head
         return str(head)
@@ -831,6 +943,9 @@ class ServeTier:
             if rep is not None:
                 info["followers"] = rep.status()
             snap["replication"] = info
+        part = self.partition_info()
+        if part is not None:
+            snap["partition"] = part
         return snap
 
     # --- replication surface (docs/REPLICATION.md) ---
@@ -1126,7 +1241,8 @@ class ServeTier:
                         codec, self.tally)
                     continue
                 head = await loop.run_in_executor(
-                    self._replica_pool, self._watch_arm)
+                    self._replica_pool, self._watch_arm,
+                    msg.get("since"))
                 self._watch.add(writer, slots)
                 self._watch_codec[writer] = codec
                 self._m_watchers.set(len(self._watch),

@@ -20,7 +20,7 @@ from crdt_tpu import Crdt
 # Fault-injection siblings of this kit: a backend proves CONFORMANCE
 # here, and proves ROBUSTNESS against the scheduled-misbehavior proxy.
 from crdt_tpu.testing_faults import (FaultProxy, FaultSchedule,  # noqa: F401
-                                     ScriptedSchedule)
+                                     ProxyFarm, ScriptedSchedule)
 
 
 class FakeClock:

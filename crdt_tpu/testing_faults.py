@@ -421,3 +421,61 @@ class FaultProxy:
                 return None
             buf += chunk
         return bytes(buf)
+
+
+class ProxyFarm:
+    """One `FaultProxy` per real address, minted on demand — the
+    ``addr_via`` seam that puts a misbehaving proxy on EVERY wire a
+    replica group (or a whole `FederatedTier`) uses: pass
+    ``addr_via=farm.via`` and each member's advertised address becomes
+    its proxy's, so replication ships, heartbeats, split/merge streams
+    and client traffic all cross scheduled faults. Partitions spawned
+    LATER (a live split's recipient) get their own proxies the moment
+    their addresses are first advertised. ``make_schedule(i)`` builds
+    the i-th proxy's schedule (default: a mild drop/delay/duplicate
+    mix seeded by i, so runs are reproducible)."""
+
+    def __init__(self, make_schedule=None):
+        self._make = make_schedule if make_schedule is not None else (
+            lambda i: FaultSchedule(
+                seed=i, rate=0.1,
+                kinds={"drop": 1, "delay": 2, "duplicate": 1},
+                max_delay=0.02))
+        self.proxies: Dict[str, FaultProxy] = {}
+        self._lock = threading.Lock()
+
+    def via(self, real_addr: str) -> str:
+        """The advertised (proxied) address for ``real_addr``,
+        creating and starting the proxy on first sight."""
+        with self._lock:
+            proxy = self.proxies.get(real_addr)
+            if proxy is None:
+                host, _, port = str(real_addr).rpartition(":")
+                proxy = FaultProxy(host, int(port),
+                                   schedule=self._make(
+                                       len(self.proxies))).start()
+                self.proxies[real_addr] = proxy
+            return f"{proxy.host}:{proxy.port}"
+
+    def counters(self) -> Dict[str, int]:
+        """Aggregate fault counters across every proxy — the soak's
+        proof that chaos actually flowed through the wires."""
+        agg: Dict[str, int] = {}
+        with self._lock:
+            proxies = list(self.proxies.values())
+        for proxy in proxies:
+            for k, v in proxy.counters.items():
+                agg[k] = agg.get(k, 0) + v
+        return agg
+
+    def stop(self) -> None:
+        with self._lock:
+            proxies, self.proxies = list(self.proxies.values()), {}
+        for proxy in proxies:
+            proxy.stop()
+
+    def __enter__(self) -> "ProxyFarm":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.stop()

@@ -304,6 +304,11 @@ class WatchIndex:
                     if not group:
                         del self._by_slot[s]
 
+    def watchers(self) -> set:
+        """Every live watcher regardless of slot interest — the set a
+        partition retire must re-home (`ServeTier.rehome_watchers`)."""
+        return self._all | set(self._slots_of)
+
     def touched(self, slots) -> set:
         """Watchers interested in ANY of ``slots`` — the fan-out set
         for one flush tick's pack. Whole-keyspace watchers are always

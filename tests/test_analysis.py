@@ -528,6 +528,68 @@ def test_ack_before_replicate_ignores_replicatorless_classes():
     assert "ack-before-replicate" not in rules
 
 
+def test_scale_fence_missing_epoch_check_flagged():
+    src = (
+        "class Scaler:\n"
+        "    def __init__(self, fed):\n"
+        "        self.fed = fed\n"
+        "        self._inflight = None\n"
+        "    def act(self, dec):\n"
+        "        if self._inflight is not None:\n"
+        "            return False\n"
+        "        self.fed.split_hot(src=dec['src'])\n")
+    findings = [f for f in lint_source(src, "snippet.py")
+                if f.rule == "scale-decision-unfenced"]
+    assert len(findings) == 1
+    assert findings[0].line == 8
+    assert "table-epoch fence" in findings[0].message
+
+
+def test_scale_fence_missing_inflight_guard_flagged():
+    src = (
+        "class Scaler:\n"
+        "    def __init__(self, fed):\n"
+        "        self.fed = fed\n"
+        "    def act(self, dec):\n"
+        "        if self.fed.table.epoch != dec['epoch']:\n"
+        "            return False\n"
+        "        self.fed.merge_cold(src=dec['src'])\n")
+    findings = [f for f in lint_source(src, "snippet.py")
+                if f.rule == "scale-decision-unfenced"]
+    assert len(findings) == 1
+    assert "in-flight guard" in findings[0].message
+
+
+def test_scale_fence_both_fences_first_clean():
+    src = (
+        "class Scaler:\n"
+        "    def __init__(self, fed):\n"
+        "        self.fed = fed\n"
+        "        self._inflight = None\n"
+        "    def act(self, dec):\n"
+        "        if self._inflight is not None:\n"
+        "            return False\n"
+        "        if self.fed.table.epoch != dec['epoch']:\n"
+        "            return False\n"
+        "        self._inflight = dec['action']\n"
+        "        self.fed.merge_cold(src=dec['src'])\n")
+    rules = {f.rule for f in lint_source(src, "snippet.py")}
+    assert "scale-decision-unfenced" not in rules
+
+
+def test_scale_fence_ignores_fedless_classes():
+    # a test harness poking split_hot directly owns no federation
+    # handle — no controller contract to enforce
+    src = (
+        "class Driver:\n"
+        "    def __init__(self):\n"
+        "        self.runs = 0\n"
+        "    def kick(self, fed):\n"
+        "        fed.split_hot(src=0)\n")
+    rules = {f.rule for f in lint_source(src, "snippet.py")}
+    assert "scale-decision-unfenced" not in rules
+
+
 def test_shipped_tree_lints_clean():
     from crdt_tpu.analysis.host_lint import lint_package
     import crdt_tpu

@@ -881,3 +881,89 @@ def test_format_replicas_surfaces_health_and_missing_primary():
     out = format_replicas(replica_health(snaps))
     assert "primary" in out and "r0" in out
     assert "NO LIVE PRIMARY" in out and "g1" in out
+
+
+# ------------------------------------------------ elastic topology health
+
+
+def _wedge_snap(inflight, progress):
+    return {"gauges": {
+        "crdt_tpu_topology_change_inflight_since_ms":
+            [{"labels": {}, "value": inflight}],
+        "crdt_tpu_topology_change_progress_ms":
+            [{"labels": {}, "value": progress}],
+    }}
+
+
+def test_topology_stall_unmeasured_on_pre_elastic_fleets():
+    from crdt_tpu.obs.fleet import evaluate_slo, topology_stall_s
+    snaps = {"r0": {"gauges": {}}}
+    assert topology_stall_s(snaps, now_ms=1000.0) is None
+    check = evaluate_slo(snaps)["checks"]["topology_change_stall_s"]
+    assert check["value"] is None and check["ok"] is None
+
+
+def test_topology_stall_zero_while_idle():
+    from crdt_tpu.obs.fleet import evaluate_slo, topology_stall_s
+    snaps = {"r0": _wedge_snap(0.0, 0.0)}
+    assert topology_stall_s(snaps, now_ms=99_000.0) == 0.0
+    check = evaluate_slo(snaps)["checks"]["topology_change_stall_s"]
+    assert check["ok"] is True
+
+
+def test_topology_stall_wedge_hard_fails_the_verdict():
+    from crdt_tpu.obs.fleet import evaluate_slo, topology_stall_s
+    # In flight since t=1s, last progress at t=2s, now t=40s: the
+    # change has been stuck for 38 s — past the 30 s budget.
+    snaps = {"r0": _wedge_snap(1_000.0, 2_000.0),
+             "r1": _wedge_snap(0.0, 0.0)}
+    assert topology_stall_s(snaps, now_ms=40_000.0) == 38.0
+    verdict = evaluate_slo(snaps, now_ms=40_000.0)
+    check = verdict["checks"]["topology_change_stall_s"]
+    assert check["ok"] is False
+    assert verdict["ok"] is False
+    # a change making progress within budget passes
+    verdict = evaluate_slo({"r0": _wedge_snap(1_000.0, 39_000.0)},
+                           now_ms=40_000.0)
+    assert verdict["checks"]["topology_change_stall_s"]["ok"] is True
+
+
+def test_format_partitions_ranks_by_load():
+    from crdt_tpu.obs.fleet import format_partitions
+    snaps = {
+        "p0": {"partition": {"addr": "h:1", "epoch": 4, "slots": 64,
+                             "rows_committed": 10, "queue_depth": 0,
+                             "shed": 0,
+                             "last_scale": {"action": "split-donor",
+                                            "epoch": 3,
+                                            "peer": "h:2"}}},
+        "p1": {"partition": {"addr": "h:2", "epoch": 4, "slots": 192,
+                             "rows_committed": 900, "queue_depth": 2,
+                             "shed": 0, "last_scale": None}},
+        "stale": {"_scrape_error": "ConnectionError: x"},
+    }
+    out = format_partitions(snaps)
+    lines = [ln for ln in out.splitlines() if ln.strip()]
+    # hottest first: p1 (900 rows) outranks p0 (10 rows)
+    assert lines[1].split()[0] == "1" and "p1" in lines[1]
+    assert lines[2].split()[0] == "2" and "p0" in lines[2]
+    assert "split-donor@e3" in out
+    # no partition sections at all -> empty, not a header-only table
+    assert format_partitions({"x": {"gauges": {}}}) == ""
+
+
+def test_serve_snapshot_carries_partition_section():
+    from crdt_tpu import FederatedTier
+    with FederatedTier(64, partitions=2,
+                       flush_interval=0.002) as fed:
+        tier = fed.tiers[0]
+        snap = tier._metrics_snapshot()
+        part = snap["partition"]
+        assert part["addr"] == tier.router.addr
+        assert part["epoch"] == fed.table.epoch
+        assert part["slots"] == fed.table.slots_of(tier.router.addr)
+        assert part["rows_committed"] == 0
+        # an unfederated tier has no partition identity to report
+        from crdt_tpu import DenseCrdt, ServeTier
+        with ServeTier(DenseCrdt("solo", 64)) as solo:
+            assert "partition" not in solo._metrics_snapshot()

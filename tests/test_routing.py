@@ -105,6 +105,35 @@ class TestEvolution:
         with pytest.raises(ValueError):
             t.split(7, 20, C)    # no range starts at 7
 
+    def test_merge_hands_every_arc_to_the_survivor(self):
+        t = RoutingTable.even(100, [A, B, C])
+        m = t.merge(B, A)
+        assert m.epoch == t.epoch + 1
+        _coverage_ok(m)
+        assert B not in m.owners()
+        assert set(m.owners()) == {A, C}
+        # Every slot B owned now resolves to A; everyone else is
+        # untouched.
+        for slot in range(t.n_slots):
+            was = t.owner_of(slot)
+            assert m.owner_of(slot) == (A if was == B else was)
+        # Adjacent arcs with the same owner coalesce: total range
+        # count shrinks or holds, never grows.
+        assert len(m.ranges) <= len(t.ranges)
+        # The source table is immutable.
+        assert t.owner_of(t.ranges_of(B)[0][0]) == B and t.epoch == 0
+
+    def test_merge_refuses_degenerate_requests(self):
+        t = RoutingTable.even(100, [A, B])
+        with pytest.raises(ValueError):
+            t.merge(A, A)                   # self-merge
+        with pytest.raises(ValueError):
+            t.merge(C, A)                   # retiree owns nothing
+        with pytest.raises(ValueError):
+            t.merge(A, C)                   # recipient must already own
+                                            # arcs (reassign handles
+                                            # promotion flips)
+
     def test_newest_is_a_join(self):
         t0 = RoutingTable.even(100, [A, B])
         t1 = t0.split(0, 25, C)

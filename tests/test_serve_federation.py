@@ -335,3 +335,488 @@ def test_split_survives_mid_handoff_cut_with_zero_lost_writes():
                     want = slot            # seed value, never stormed
                 assert cli.get(slot) == want, f"slot {slot}"
             cli.close()
+
+
+# --- the live merge state machine (inverse of split_hot) ---
+
+def _wait(pred, timeout=10.0, interval=0.005, what="condition"):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if pred():
+            return
+        time.sleep(interval)
+    raise AssertionError(f"timed out waiting for {what}")
+
+
+# Tight but CI-safe replica-group timings (mirrors
+# tests/test_replication.py): detection in ~3 beats, promote in
+# milliseconds, client retry budget comfortably above both.
+FAST_FED = dict(flush_interval=0.002, heartbeat_interval=0.02,
+                heartbeat_timeout=0.15, lease_misses=3)
+
+
+def test_merge_cold_retires_donor_with_zero_lost_rows():
+    with FederatedTier(N_SLOTS, partitions=3,
+                       flush_interval=0.002) as fed:
+        cli = FederatedClient(fed.addrs())
+        try:
+            for slot in range(0, N_SLOTS, 3):
+                cli.put(slot, slot + 7)
+        finally:
+            cli.close()
+        e0 = fed.table.epoch
+        stats = fed.merge_cold()
+        assert stats is fed.last_merge
+        assert stats["epoch"] == e0 + 1 == fed.table.epoch
+        assert len(fed.tiers) == 2 and len(fed.groups) == 2
+        assert stats["src_addr"] not in fed.table.owners()
+        assert stats["dst_addr"] in fed.table.owners()
+        assert stats["migrated_rows"] >= 1
+        # The recipient wears the absorb stamp the fleet table shows.
+        absorber = fed.tier_at(stats["dst_addr"])
+        assert absorber.last_scale == {"action": "merge-absorb",
+                                       "epoch": stats["epoch"],
+                                       "peer": stats["src_addr"]}
+        # Every row the donor owned reads back from the survivors.
+        cli = FederatedClient(fed.addrs())
+        try:
+            for slot in range(0, N_SLOTS, 3):
+                assert cli.get(slot) == slot + 7
+        finally:
+            cli.close()
+
+
+def test_merge_refuses_the_last_partition():
+    with FederatedTier(N_SLOTS, partitions=1,
+                       flush_interval=0.002) as fed:
+        with pytest.raises(ValueError):
+            fed.merge_cold()
+        assert len(fed.tiers) == 1 and fed.table.epoch == 0
+
+
+def test_elastic_cycles_never_reuse_partition_identity():
+    """split -> write through the recipient -> merge -> split again.
+
+    The first cycle's recipient commits rows stamped with its own
+    node id; the merge carries them into the survivor. If the second
+    cycle's recipient reused the retired name (list position instead
+    of the monotone spawn sequence), re-migrating those rows would be
+    rejected as a duplicate node mid-stream — a deterministic
+    `ConnectionError` after retries exhaust. Regression for exactly
+    that."""
+    acked = {}
+    with FederatedTier(N_SLOTS, partitions=1,
+                       flush_interval=0.002) as fed:
+        for cycle in range(2):
+            fed.split_hot()
+            # Commit rows THROUGH the fresh recipient so its node id
+            # outlives it inside whichever partition absorbs it.
+            cli = FederatedClient(fed.addrs())
+            try:
+                for slot in range(1, N_SLOTS, 7):
+                    cli.put(slot, cycle * N_SLOTS + slot)
+                    acked[slot] = cycle * N_SLOTS + slot
+            finally:
+                cli.close()
+            fed.merge_cold()
+            assert len(fed.tiers) == 1
+        assert fed.table.epoch == 4
+        rcli = FederatedClient(fed.addrs())
+        try:
+            for slot, want in acked.items():
+                assert rcli.get(slot) == want, f"slot {slot}"
+        finally:
+            rcli.close()
+
+
+def test_merge_survives_mid_handoff_cut_with_zero_lost_writes():
+    """Cut the merge's migration stream mid-frame: the stream must
+    retry on a fresh connection (idempotent replay), complete, and
+    every row the donor owned must read back from the recipient."""
+    sched = ScriptedSchedule([
+        # Connection 1: let the ~70-byte hello through, then cut the
+        # round-1 push mid-frame.
+        {"kind": "truncate", "after": 150},
+        # Connection 2+ (the retry): behave.
+        None,
+    ])
+    with FederatedTier(N_SLOTS, partitions=2,
+                       flush_interval=0.002) as fed:
+        donor_addr = fed.tiers[0].router.addr
+        dst_addr = fed._merge_neighbor(donor_addr)
+        recipient = fed.tier_at(dst_addr)
+        seeded = [s for lo, hi in fed.table.ranges_of(donor_addr)
+                  for s in range(lo, hi)]
+        cli = FederatedClient(fed.addrs())
+        try:
+            for slot in seeded:
+                cli.put(slot, slot + 3)
+        finally:
+            cli.close()
+        with FaultProxy(recipient.host, recipient.port,
+                        sched) as proxy:
+            stats = fed.merge_cold(
+                src=0,
+                dst_addr_override=f"{proxy.host}:{proxy.port}")
+            assert proxy.counters.get("truncate", 0) >= 1, \
+                f"cut never fired: {proxy.counters}"
+            assert proxy.counters["connections"] >= 2  # reconnected
+        assert stats["epoch"] == 1
+        assert stats["migrated_rows"] >= len(seeded)
+        assert len(fed.tiers) == 1
+        cli = FederatedClient(fed.addrs())
+        try:
+            for slot in seeded:
+                assert cli.get(slot) == slot + 3, f"slot {slot}"
+        finally:
+            cli.close()
+
+
+def test_watch_rehomes_across_merge_and_keeps_delivering():
+    """A watch session subscribed on the retiring donor receives a
+    typed ``moved`` push, transparently resubscribes at the absorbing
+    owner with the flip-watermark resume mark, and keeps receiving
+    commit events — none dropped across the move."""
+    with FederatedTier(N_SLOTS, partitions=2,
+                       flush_interval=0.002) as fed:
+        cli = FederatedClient(fed.addrs())
+        donor = fed.tiers[0]
+        slot = _owned_slot(fed, donor)
+        watch = cli.watch(donor.router.addr, slots=[slot])
+        try:
+            cli.put(slot, 1)
+            deadline = time.monotonic() + 10.0
+            events = []
+            while not events and time.monotonic() < deadline:
+                events = watch.next_event(timeout=10.0)
+            assert events == [(slot, 1)]
+
+            stats = fed.merge_cold(src=0)
+            assert stats["rehomed_watchers"] == 1
+
+            # A write committed at the NEW owner still reaches the
+            # session. (The recipient's rewound watermark may re-ship
+            # the pre-merge row first — at-least-once delivery — so
+            # poll until the new value lands.)
+            cli.put(slot, 2)
+            got = None
+            while got != 2 and time.monotonic() < deadline:
+                for s, v in watch.next_event(timeout=10.0):
+                    if s == slot:
+                        got = v
+            assert got == 2
+            assert watch.moved_rehomes == 1
+            assert watch.addr == stats["dst_addr"]
+        finally:
+            watch.close()
+            cli.close()
+
+
+# --- client redirect budget across topology churn ---
+
+def test_redirect_budget_resets_only_when_the_epoch_advances():
+    """Deterministic budget accounting: five consecutive ``moved``
+    replies would blow a 3-attempt budget, but each refresh that
+    ADVANCES the epoch resets it — while a refresh that learns
+    nothing must still burn an attempt (or a permanently stale table
+    would spin forever)."""
+    from crdt_tpu.routing import RoutingTable
+
+    def _client(max_redirects):
+        cli = FederatedClient.__new__(FederatedClient)
+        cli._seeds = ["h:1"]
+        cli._timeout = 1.0
+        cli._max_redirects = max_redirects
+        cli._sessions = {}
+        cli.moved_redirects = 0
+        cli.busy_retries = 0
+        cli.redirect_resets = 0
+        cli.table = RoutingTable(16, 0, [(0, 16, "h:1")])
+        cli._backoff = lambda attempt: None
+        return cli
+
+    moved = {"ok": False, "code": "moved", "owner": "h:1", "epoch": 0}
+
+    class _Scripted:
+        def __init__(self, replies):
+            self.replies = list(replies)
+
+        def request(self, msg):
+            return self.replies.pop(0)
+
+    # Churny fleet: every refresh advances the epoch, so the budget
+    # keeps resetting and the op outlives 5 redirects on a budget
+    # of 3.
+    cli = _client(max_redirects=3)
+    sess = _Scripted([moved] * 5 + [{"ok": True}])
+    cli._session = lambda addr: sess
+    cli._try_refresh = lambda: setattr(
+        cli, "table",
+        RoutingTable(16, cli.table.epoch + 1, [(0, 16, "h:1")]))
+    assert cli._keyspace({"op": "put", "slot": 1, "value": 1}, 1) \
+        == {"ok": True}
+    assert cli.redirect_resets == 5
+    assert cli.moved_redirects == 5
+
+    # Stale fleet: refresh learns nothing, so the budget bounds the
+    # spin at exactly max_redirects attempts.
+    cli = _client(max_redirects=3)
+    sess = _Scripted([moved] * 10)
+    cli._session = lambda addr: sess
+    cli._try_refresh = lambda: None
+    with pytest.raises(ConnectionError):
+        cli._keyspace({"op": "put", "slot": 1, "value": 1}, 1)
+    assert cli.redirect_resets == 0
+    assert len(sess.replies) == 10 - 3
+
+
+def test_client_survives_more_churn_than_its_redirect_budget():
+    """Forced churn: four topology changes while a 3-attempt client
+    keeps writing. Epoch-advancing refreshes reset the budget, so
+    every write lands and nothing acked is lost."""
+    with FederatedTier(N_SLOTS, partitions=2,
+                       flush_interval=0.002) as fed:
+        cli = FederatedClient(fed.addrs(), max_redirects=3)
+        slots = (1, 90, 170, 250)
+        acked = {}
+        failures = []
+
+        def churn():
+            try:
+                for _ in range(2):
+                    fed.split_hot()
+                    fed.merge_cold()
+            except Exception as e:   # pragma: no cover
+                failures.append(e)
+
+        t = threading.Thread(target=churn, daemon=True)
+        t.start()
+        try:
+            v = 0
+            while t.is_alive():
+                for s in slots:
+                    v += 1
+                    cli.put(s, v)
+                    acked[s] = v
+        finally:
+            t.join(timeout=60)
+        assert not failures, f"churn failed: {failures!r}"
+        assert fed.table.epoch >= 4          # four changes landed
+        for s, want in acked.items():
+            assert cli.get(s) == want, f"slot {s}"
+        cli.close()
+
+
+# --- merge crash-safety: donor-primary kills on both sides of the flip ---
+
+def test_merge_pre_flip_donor_kill_aborts_cleanly_then_retries():
+    """Donor primary abruptly killed before the routing flip: the
+    merge must abort with the topology untouched (the table still
+    names the donor group, whose failover keeps serving the arc), and
+    a retry after promotion must complete with zero acked loss."""
+    from crdt_tpu.testing_faults import abrupt_kill
+
+    with FederatedTier(N_SLOTS, partitions=2, replicas=2,
+                       ack_replicas=1, **FAST_FED) as fed:
+        donor_addr = fed.tiers[0].router.addr
+        seeded = [s for lo, hi in fed.table.ranges_of(donor_addr)
+                  for s in range(lo, hi, 4)]
+        cli = FederatedClient(fed.addrs())
+        try:
+            for slot in seeded:
+                cli.put(slot, slot + 11)
+        finally:
+            cli.close()
+
+        abrupt_kill(fed.tiers[0])
+        with pytest.raises(ConnectionError):
+            fed.merge_cold(src=0)
+        # Clean abort: nothing flipped, nothing retired.
+        assert fed.last_merge is None
+        assert len(fed.tiers) == 2 and len(fed.groups) == 2
+
+        # The group fails over on its own; the arc is served
+        # throughout (by the survivor, under the reassigned table).
+        _wait(lambda: not fed.tiers[0].killed, timeout=15.0,
+              what="donor-group failover")
+        cli = FederatedClient(fed.addrs())
+        try:
+            assert cli.get(seeded[0]) == seeded[0] + 11
+        finally:
+            cli.close()
+
+        # The retry streams from the new primary and completes.
+        stats = fed.merge_cold(src=0)
+        assert stats["migrated_rows"] >= len(seeded)
+        assert len(fed.tiers) == 1
+        cli = FederatedClient(fed.addrs())
+        try:
+            for slot in seeded:
+                assert cli.get(slot) == slot + 11, f"slot {slot}"
+        finally:
+            cli.close()
+
+
+def test_merge_post_flip_donor_kill_hands_off_to_failover():
+    """Donor primary abruptly killed in the post-flip drain window:
+    the table already dropped the donor, so aborting would strand its
+    arcs — the merge must instead wait out the group's promotion and
+    re-ship the full arc from the new primary (write concern put
+    every acked row there), then retire the group."""
+    from crdt_tpu.testing_faults import abrupt_kill
+
+    with FederatedTier(N_SLOTS, partitions=2, replicas=2,
+                       ack_replicas=1, flush_interval=0.05,
+                       heartbeat_interval=0.02,
+                       heartbeat_timeout=0.15,
+                       lease_misses=3) as fed:
+        donor_addr = fed.tiers[0].router.addr
+        seeded = [s for lo, hi in fed.table.ranges_of(donor_addr)
+                  for s in range(lo, hi, 4)]
+        cli = FederatedClient(fed.addrs())
+        try:
+            for slot in seeded:
+                cli.put(slot, slot + 13)
+        finally:
+            cli.close()
+
+        e0 = fed.table.epoch
+        donor_group = fed.groups[0]
+        result, errors = [], []
+
+        def run():
+            try:
+                result.append(fed.merge_cold(src=0))
+            except BaseException as e:   # pragma: no cover
+                errors.append(e)
+
+        th = threading.Thread(target=run, daemon=True)
+        th.start()
+        # The epoch bump IS the flip; the drain window behind it is
+        # flush_interval * 4 = 200 ms — kill the donor primary inside
+        # it, before the final catch-up round.
+        _wait(lambda: fed.table.epoch > e0, timeout=30.0,
+              interval=0.0005, what="routing flip")
+        abrupt_kill(donor_group)
+        th.join(timeout=60.0)
+
+        assert not errors, f"merge failed: {errors!r}"
+        stats = result[0]
+        # The failover counter increments after the monitor's
+        # _on_promote callback returns, which can trail the merge's
+        # own completion by a beat.
+        _wait(lambda: donor_group.failovers >= 1, timeout=5.0,
+              what="handoff failover")
+        assert len(fed.tiers) == 1 and len(fed.groups) == 1
+        assert stats["src_addr"] == donor_addr
+        cli = FederatedClient(fed.addrs())
+        try:
+            for slot in seeded:
+                assert cli.get(slot) == slot + 13, f"slot {slot}"
+        finally:
+            cli.close()
+
+
+# --- the elastic chaos soak: split/merge cycles on an all-proxied fleet ---
+
+@pytest.mark.slow
+@pytest.mark.soak
+def test_chaos_soak_elastic_cycles_through_fault_proxies():
+    """>= 2 full split+merge cycles with EVERY wire the federation
+    uses (client ops, heartbeats, replication ships, migration
+    streams) routed through misbehaving `FaultProxy`s, under a
+    client write storm: zero acked loss, every replica group
+    convergent, and the partition count back at baseline."""
+    from crdt_tpu.testing_faults import FaultSchedule, ProxyFarm
+
+    # `rate` is per-CONNECTION (the chance a connection faults at
+    # all), and this fleet holds few, long-lived sessions — a timid
+    # rate fires nothing in a short soak. Keep it high and the fault
+    # mix delay-heavy so chaos is guaranteed without stalling the
+    # storm behind client-timeout-length drop recoveries.
+    farm = ProxyFarm(lambda i: FaultSchedule(
+        seed=i, rate=0.45,
+        kinds={"drop": 1, "delay": 4, "duplicate": 1},
+        max_delay=0.01))
+    with farm:
+        with FederatedTier(N_SLOTS, partitions=2, replicas=2,
+                           ack_replicas=1, addr_via=farm.via,
+                           **FAST_FED) as fed:
+            baseline = len(fed.tiers)
+            acked = {}
+            lock = threading.Lock()
+            stop = threading.Event()
+            failures = []
+
+            def storm():
+                scli = FederatedClient(fed.addrs(), timeout=5.0)
+                slots = (3, 77, 130, 200)
+                v = 0
+                try:
+                    while not stop.is_set():
+                        for s in slots:
+                            v += 1
+                            scli.put(s, v)
+                            with lock:
+                                acked[s] = v
+                        time.sleep(0.002)
+                except Exception as e:   # pragma: no cover
+                    failures.append(e)
+                finally:
+                    scli.close()
+
+            th = threading.Thread(target=storm, daemon=True)
+            th.start()
+            try:
+                for cycle in range(2):
+                    fed.split_hot()
+                    assert len(fed.tiers) == baseline + 1
+                    fed.merge_cold()
+                    assert len(fed.tiers) == baseline
+            finally:
+                stop.set()
+                th.join(timeout=60.0)
+            assert not failures, f"storm writes failed: {failures!r}"
+            assert fed.table.epoch >= 4
+
+            # Zero acked loss, read back through the faulty wires.
+            rcli = FederatedClient(fed.addrs(), timeout=5.0)
+            try:
+                with lock:
+                    frozen = dict(acked)
+                for s, want in frozen.items():
+                    assert rcli.get(s) == want, f"slot {s}"
+
+                # Every surviving group converges: nudge each
+                # partition's arc to re-arm its flush tick, then
+                # compare member digest roots.
+                def _converged():
+                    for i, g in enumerate(fed.groups):
+                        lo, hi = fed.table.ranges_of(
+                            fed.tiers[i].router.addr)[0]
+                        rcli.put(lo, cycle + 1000)
+                        time.sleep(0.05)
+                        roots = set()
+                        for m in g.members:
+                            t = m.tier
+                            if m.role == "down" or t is None \
+                                    or t.killed:
+                                continue
+                            with t.lock:
+                                roots.add(
+                                    int(t.crdt.digest_tree().root))
+                        if len(roots) != 1:
+                            return False
+                    return True
+
+                _wait(_converged, timeout=30.0, interval=0.1,
+                      what="replica convergence")
+            finally:
+                rcli.close()
+
+        # The chaos was real: faults actually flowed through the
+        # farm. (Read before farm.stop() clears the proxy registry.)
+        counters = farm.counters()
+        assert counters.get("connections", 0) > 0
+        assert sum(counters.get(k, 0)
+                   for k in ("drop", "delay", "duplicate")) > 0
