@@ -1,9 +1,12 @@
 """CI smoke-bench regression gate.
 
-Runs one fast bench (default ``bench.py --mode sync --smoke``) — which
-appends a normalized record to the trajectory — then verdicts that
-record against the fastest-of-N floors of its ``(mode, host_class,
-smoke)`` group via the same code path as
+Runs the static lint leg first (``python -m crdt_tpu.analysis
+--skip-laws --skip-jaxpr``: host linter + whole-tree lock-order
+analyzer — the cheap passes; laws and jaxpr audit have their own CI
+leg), then one fast bench (default ``bench.py --mode sync --smoke``) —
+which appends a normalized record to the trajectory — then verdicts
+that record against the fastest-of-N floors of its ``(mode,
+host_class, smoke)`` group via the same code path as
 ``python -m crdt_tpu.obs bench --compare``.
 
 Exit code is the verdict's, unchanged:
@@ -51,6 +54,14 @@ def main(argv=None) -> int:
     ap.add_argument("--full", action="store_true",
                     help="run the full-size bench instead of --smoke")
     args = ap.parse_args(argv)
+
+    lint_rc = subprocess.run(
+        [sys.executable, "-m", "crdt_tpu.analysis",
+         "--skip-laws", "--skip-jaxpr"], cwd=_REPO).returncode
+    if lint_rc != 0:
+        print(f"smoke_gate: lint leg failed (rc={lint_rc})",
+              file=sys.stderr)
+        return lint_rc
 
     cmd = [sys.executable, os.path.join(_REPO, "bench.py"),
            "--mode", args.mode, "--trajectory", args.trajectory]

@@ -1,6 +1,9 @@
 """crdtlint: static + dynamic correctness tooling (docs/ANALYSIS.md).
 
 - `host_lint` — AST linter for host-layer race/discipline rules
+- `concurrency` — whole-tree lock-order analyzer (declared
+  `_CRDTLINT_LOCK_ORDER` contracts vs the observed acquisition graph)
+  plus the runtime deadlock sanitizer (`make_lock`/`OrderedLock`)
 - `lattice_laws` — seeded semilattice-law counterexample search
 - `jaxpr_audit` — order-sensitivity hazards in merge kernel jaxprs
 - `sanitizer` — opt-in runtime lattice assertions (CRDT_TPU_SANITIZE=1)
@@ -25,6 +28,9 @@ _LAZY = {
     "AuditTarget": "jaxpr_audit", "AuditReport": "jaxpr_audit",
     "audit_all": "jaxpr_audit",
     "LatticeViolation": "sanitizer",
+    "analyze_source": "concurrency", "analyze_paths": "concurrency",
+    "analyze_package": "concurrency",
+    "make_lock": "concurrency", "OrderedLock": "concurrency",
 }
 
 __all__ = ["Finding", "sanitizer"] + sorted(_LAZY)

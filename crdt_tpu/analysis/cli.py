@@ -235,12 +235,15 @@ def main(argv=None) -> int:
     explicit = bool(args.lint or args.law_fixture)
 
     if args.lint:
+        from .concurrency import analyze_paths
         from .host_lint import lint_file, lint_package
         for path in args.lint:
             if os.path.isdir(path):
                 findings.extend(lint_package(path))
             else:
                 findings.extend(lint_file(path))
+        # one global lock graph across every --lint path
+        findings.extend(analyze_paths(args.lint))
 
     if args.law_fixture:
         from .lattice_laws import run_laws
@@ -250,10 +253,12 @@ def main(argv=None) -> int:
 
     if not explicit:
         if not args.skip_lint:
+            from .concurrency import analyze_package
             from .host_lint import lint_package
             pkg_root = os.path.dirname(os.path.dirname(
                 os.path.abspath(__file__)))
             findings.extend(lint_package(pkg_root))
+            findings.extend(analyze_package(pkg_root))
         if not args.skip_laws or not args.skip_jaxpr:
             # The registry gate guards exactly the law + jaxpr
             # coverage surfaces, so it runs whenever either does.

@@ -57,7 +57,15 @@ reproduced bugs):
   (docs/SERVING.md). Route device/file work through
   ``loop.run_in_executor`` and sleep with ``asyncio.sleep``. Passing
   a sync helper BY REFERENCE to an executor is fine — only the
-  direct call blocks.
+  direct call blocks. Also flags a synchronous ``with self.<lock>:``
+  inside an ``async def`` when ``<lock>`` is named by the class's
+  ``_CRDTLINT_LOCK_ORDER`` contract: a contended thread-lock
+  acquisition parks the event loop exactly like a blocking socket
+  (``async with`` on an asyncio lock is the sanctioned form).
+- ``thread-unnamed`` — a ``threading.Thread(...)`` constructed
+  without a stable ``name=``; lock-order witness paths, the runtime
+  sanitizer's violation events, and fleet traces all identify the
+  holder by thread name, and ``Thread-12`` identifies nothing.
 - ``metric-name-unprefixed`` — a counter/gauge/histogram registered
   outside the ``crdt_tpu_`` namespace, or a metric label whose value
   is drawn from a user key/slot. The fleet poller (obs/fleet.py)
@@ -124,6 +132,7 @@ RULES = (
     "collective-socket-fallback-silent",
     "ack-before-replicate",
     "scale-decision-unfenced",
+    "thread-unnamed",
     "suppression-without-reason",
 )
 
@@ -661,6 +670,92 @@ def _check_async_blocking(tree: ast.AST, path: str) -> List[Finding]:
                 message=f"{what} — inside coroutine {fn.name}() this "
                         "stalls every session multiplexed on the "
                         "serving tier's loop (docs/SERVING.md)"))
+    # Synchronous acquisition of a declared-contract thread lock
+    # inside a coroutine: a contended `with self.<lock>:` parks the
+    # event loop exactly like a blocking socket. The contract tuple
+    # (`_CRDTLINT_LOCK_ORDER`) tells us which attributes are real
+    # cross-thread locks; `async with` (an asyncio lock) is the
+    # sanctioned form and is a different AST node entirely.
+    for cls in ast.walk(tree):
+        if not isinstance(cls, ast.ClassDef):
+            continue
+        attrs = _lock_order_attrs(cls)
+        if not attrs:
+            continue
+        for fn in ast.walk(cls):
+            if not isinstance(fn, ast.AsyncFunctionDef):
+                continue
+            for node in _own_nodes(fn):
+                if not isinstance(node, ast.With):
+                    continue
+                for item in node.items:
+                    d = _dotted(item.context_expr)
+                    if d is not None and d.startswith("self.") \
+                            and d[len("self."):] in attrs:
+                        out.append(Finding(
+                            rule="async-blocking-call", path=path,
+                            line=item.context_expr.lineno,
+                            message=f"sync `with {d}:` inside "
+                                    f"coroutine {fn.name}() — "
+                                    f"{d[len('self.'):]} is a "
+                                    "declared contract lock "
+                                    "(_CRDTLINT_LOCK_ORDER), and a "
+                                    "contended thread-lock "
+                                    "acquisition parks the event "
+                                    "loop; hold it via "
+                                    "run_in_executor or switch to "
+                                    "an asyncio.Lock"))
+    return out
+
+
+def _lock_order_attrs(cls: ast.ClassDef) -> Set[str]:
+    """Bare ``self.<attr>`` lock names a class's
+    ``_CRDTLINT_LOCK_ORDER`` contract declares (pattern entries name
+    foreign locks and don't bind a self attribute)."""
+    for stmt in cls.body:
+        value = None
+        if isinstance(stmt, ast.Assign) and len(stmt.targets) == 1 \
+                and isinstance(stmt.targets[0], ast.Name) \
+                and stmt.targets[0].id == "_CRDTLINT_LOCK_ORDER":
+            value = stmt.value
+        elif isinstance(stmt, ast.AnnAssign) \
+                and isinstance(stmt.target, ast.Name) \
+                and stmt.target.id == "_CRDTLINT_LOCK_ORDER" \
+                and stmt.value is not None:
+            value = stmt.value
+        if value is None:
+            continue
+        try:
+            raw = ast.literal_eval(value)
+        except (ValueError, SyntaxError):
+            return set()
+        if isinstance(raw, (tuple, list)):
+            return {e for e in raw if isinstance(e, str)}
+        return set()
+    return set()
+
+
+# --- rule: thread-unnamed ---
+
+def _check_thread_unnamed(tree: ast.AST, path: str) -> List[Finding]:
+    out: List[Finding] = []
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        d = _dotted(node.func)
+        if d is None or not (d == "Thread"
+                             or d.endswith("threading.Thread")
+                             or d == "_threading.Thread"):
+            continue
+        if any(kw.arg == "name" for kw in node.keywords):
+            continue
+        out.append(Finding(
+            rule="thread-unnamed", path=path, line=node.lineno,
+            message="threading.Thread(...) without a stable name= — "
+                    "lock-order witness paths, sanitizer violation "
+                    "events, and fleet traces identify the holder by "
+                    "thread name, and the default Thread-N "
+                    "identifies nothing"))
     return out
 
 
@@ -1056,6 +1151,7 @@ _ALL_CHECKS = (
     _check_collective_fallback,
     _check_ack_before_replicate,
     _check_scale_fence,
+    _check_thread_unnamed,
 )
 
 

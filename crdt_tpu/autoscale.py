@@ -73,6 +73,13 @@ class Autoscaler:
     rate below which — when EVERY partition is that cold — the coldest
     is merged away (all-cold is deliberately conservative: a fleet
     with one busy partition and three idle ones keeps its headroom).
+
+    The controller owns NO locks of its own (the empty
+    `_CRDTLINT_LOCK_ORDER` below is the checked statement of that):
+    split/merge serialization lives entirely in the federation's
+    ``_control``, so a wedged scale action can never also wedge the
+    poller.
+
     An ack-p99 SLO breach (`evaluate_slo`) counts as split pressure
     even below the rate threshold. ``slo_probe`` injects the verdict
     source (tests; the default evaluates the in-process registry).
@@ -80,6 +87,8 @@ class Autoscaler:
     Run as a daemon (``start``/``stop`` or context manager) ticking
     every ``interval`` seconds, or drive ``tick()`` by hand.
     """
+
+    _CRDTLINT_LOCK_ORDER: tuple = ()
 
     def __init__(self, fed, *, interval: float = 0.25,
                  min_partitions: int = 1, max_partitions: int = 8,

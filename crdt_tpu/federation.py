@@ -68,6 +68,7 @@ import threading
 import time
 from typing import Any, Dict, List, Optional, Tuple
 
+from .analysis.concurrency import make_lock
 from .net import (FrameCodec, WireTally, _pack_for_peer, recv_frame,
                   recv_bytes_frame, send_bytes_frame, send_frame)
 from .routing import PartitionRouter, RoutingTable
@@ -210,6 +211,14 @@ class FederatedTier:
     ``make_crdt(partition, replica, generation)``.
     """
 
+    # Checked by analysis/concurrency.py: `_control` may be held while
+    # taking a donor tier's store lock (`_ship_ranges` migrates rows
+    # under both), never the reverse — the promote path takes the
+    # group lock alone and `publish` touches no tier lock, so the
+    # PR 15 "cycle that doesn't happen" is now a machine-checked fact.
+    _CRDTLINT_LOCK_ORDER = ("_control", ("donor.lock",
+                                         "ServeTier.lock"))
+
     def __init__(self, n_slots: int, partitions: int = 4,
                  host: str = "127.0.0.1",
                  flush_interval: float = 0.002,
@@ -257,7 +266,7 @@ class FederatedTier:
         self.last_merge: Optional[dict] = None
         # Serializes splits and table publication against each other;
         # the serving hot path never takes it.
-        self._control = threading.Lock()
+        self._control = make_lock("FederatedTier._control", 10)
         # Monotone partition-identity counter. Spawn names must NEVER
         # be reused across elastic cycles: a merged-away partition's
         # rows live on in the survivor stamped with its node id, and
@@ -445,6 +454,7 @@ class FederatedTier:
                     and m.tier is not dead_tier \
                     and not m.tier.killed:
                 return m.tier
+            # crdtlint: disable=blocking-under-lock -- bounded failover wait; group.primary takes only the group lock, released before _on_promote re-enters _control
             time.sleep(group.heartbeat_interval)
         raise ConnectionError(
             f"group {group.group}: no replacement primary within "
@@ -606,6 +616,7 @@ class FederatedTier:
             # Drain: anything the donor enqueued pre-flip commits
             # within one flush tick; wait it out, then ship the final
             # watermark round so the recipient holds every acked row.
+            # crdtlint: disable=blocking-under-lock -- bounded drain (4 flush ticks); _control intentionally serializes the whole split against other topology changes
             time.sleep(max(donor.flush_interval * 4, 0.01))
             try:
                 shipped, mark = self._ship_ranges(donor, up, mark,
@@ -686,6 +697,7 @@ class FederatedTier:
                 f"donor {donor.host}:{donor.port} killed mid-stream")
         with donor.lock:
             wm = donor.crdt.canonical_time
+            # crdtlint: disable=blocking-under-lock -- migration pack must be atomic with the donor watermark; _control serializes topology changes so no other split waits on this dispatch
             packed, ids = _pack_for_peer(donor.crdt, mark, True,
                                          ranges=tuple(spans))
         if not packed.k:
@@ -709,6 +721,7 @@ class FederatedTier:
                     up.close()
                 except Exception:
                     pass
+                # crdtlint: disable=blocking-under-lock -- bounded redial backoff (8 attempts); abandoning mid-migration would strand shipped-but-unacked rows
                 time.sleep(0.05 * (attempt + 1))
                 try:
                     up.__init__(up.addr)
@@ -731,6 +744,7 @@ class FederatedTier:
                 return _Upstream(addr)
             except (ConnectionError, OSError) as e:
                 last = e
+                # crdtlint: disable=blocking-under-lock -- bounded dial backoff (8 attempts, ≤1.8s total); the PR 16 fix moved the UNBOUNDED wait out, this residue is capped
                 time.sleep(0.05 * (attempt + 1))
         raise ConnectionError(
             f"upstream dial to {addr} failed after retries: {last!r}")
@@ -843,6 +857,7 @@ class FederatedTier:
             flipped = True
             self._change_progress()
             flip_at = time.perf_counter()
+            # crdtlint: disable=blocking-under-lock -- bounded drain (4 flush ticks), same serialized-topology reasoning as _split_locked
             time.sleep(max(donor.flush_interval * 4, 0.01))
             try:
                 shipped, mark = self._ship_ranges(donor, up, mark,

@@ -55,6 +55,7 @@ from concurrent.futures import ThreadPoolExecutor
 from dataclasses import dataclass
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
+from .analysis.concurrency import make_lock
 from .checkpoint import load_gossip_state, save_gossip_state
 from .crdt import Crdt
 from .hlc import Hlc
@@ -267,6 +268,13 @@ class GossipNode:
     # only under self._peers_lock (enforced statically by
     # crdt_tpu.analysis.host_lint).
     _CRDTLINT_GUARDED = {"_peers_lock": ("peers",)}
+    # Checked by analysis/concurrency.py: peers-registry lock before
+    # the server's replica lock. In the shipped tree they are only
+    # ever taken SEQUENTIALLY (lag_snapshot releases one before the
+    # other) — the declaration pins the permitted direction should a
+    # future path nest them.
+    _CRDTLINT_LOCK_ORDER = ("_peers_lock", ("server.lock",
+                                            "SyncServer.lock"))
 
     def __init__(self, crdt: Crdt, host: str = "127.0.0.1",
                  port: int = 0, *,
@@ -317,7 +325,7 @@ class GossipNode:
         # Guards the peer REGISTRY (the dict itself): add_peer may run
         # from any thread while the gossip loop iterates. Per-peer
         # mutable state stays single-writer (the gossip thread).
-        self._peers_lock = threading.Lock()
+        self._peers_lock = make_lock("GossipNode._peers_lock", 38)
         self.peers: Dict[str, Peer] = {}
         self._state_path = state_path
         # Crash resume: watermarks persisted by a previous incarnation
@@ -424,8 +432,9 @@ class GossipNode:
                     self.run_round()
                     self._stop.wait(gossip_interval)
 
-            self._gossip_thread = threading.Thread(target=loop,
-                                                   daemon=True)
+            self._gossip_thread = threading.Thread(
+                target=loop, daemon=True,
+                name=f"gossip-{self.crdt.node_id}")
             self._gossip_thread.start()
         return self
 

@@ -89,6 +89,7 @@ import threading
 import zlib
 from typing import Any, Iterable, Optional, Sequence, Tuple
 
+from .analysis.concurrency import make_lock
 from .crdt import Crdt
 from .hlc import Hlc
 
@@ -503,7 +504,7 @@ class SyncServer:
                  max_ops: int = 1000, conn_deadline: float = 300.0,
                  io_timeout: float = 30.0, max_conns: int = 8):
         self.crdt = crdt
-        self.lock = threading.Lock()
+        self.lock = make_lock("SyncServer.lock", 42)
         self._max_ops = max_ops
         self._conn_deadline = conn_deadline
         # Per-recv socket timeout AND the bound on a push_dense/
@@ -533,7 +534,7 @@ class SyncServer:
         # Live connections + their handler threads, guarded by
         # _conns_lock: stop() shuts every socket down so a handler
         # blocked in a 30 s recv exits promptly.
-        self._conns_lock = threading.Lock()
+        self._conns_lock = make_lock("SyncServer._conns_lock", 44)
         self._conns: set = set()
         self._handlers: set = set()
         self._lsock = socket.create_server((host, port))
@@ -543,7 +544,9 @@ class SyncServer:
         self._thread: Optional[threading.Thread] = None
 
     def start(self) -> "SyncServer":
-        self._thread = threading.Thread(target=self._serve, daemon=True)
+        self._thread = threading.Thread(
+            target=self._serve, daemon=True,
+            name=f"sync-accept-{self.port}")
         self._thread.start()
         return self
 
@@ -646,8 +649,9 @@ class SyncServer:
                 except OSError:
                     pass
                 continue
-            t = threading.Thread(target=self._conn_main, args=(conn,),
-                                 daemon=True)
+            t = threading.Thread(
+                target=self._conn_main, args=(conn,), daemon=True,
+                name=f"sync-conn-{self.port}-fd{conn.fileno()}")
             with self._conns_lock:
                 self._handlers.add(t)
             t.start()

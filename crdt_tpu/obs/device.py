@@ -57,10 +57,10 @@ and verifies the required set against ``registered_kernels()``.
 
 from __future__ import annotations
 
-import threading
 import time
 from typing import Dict, Optional
 
+from ..analysis.concurrency import make_lock
 from .registry import MetricsRegistry, default_registry
 
 # Resolved once per process: donation-violation checks only make sense
@@ -99,11 +99,14 @@ class DispatchLedger:
 
     # crdtlint lock-discipline contract (obs.registry module docstring).
     _CRDTLINT_GUARDED = {"_lock": ("_counts", "_compiled", "_registered")}
+    # analysis/concurrency.py: the ledger lock releases before the
+    # metric incs (`_dispatch`), so nothing ever nests inside it.
+    _CRDTLINT_LOCK_ORDER = ("_lock",)
 
     def __init__(self, registry: Optional[MetricsRegistry] = None):
         self._registry = registry if registry is not None \
             else default_registry()
-        self._lock = threading.Lock()
+        self._lock = make_lock("DispatchLedger._lock", 84)
         self._counts: Dict[str, int] = {}    # kernel -> dispatches
         self._compiled: set = set()          # (kernel, bucket) seen
         self._registered: set = set()        # kernel names declared

@@ -26,9 +26,10 @@ same everywhere by construction).
 from __future__ import annotations
 
 import bisect
-import threading
 import weakref
 from typing import Any, Callable, Dict, List, Optional, Tuple
+
+from ..analysis.concurrency import make_lock
 
 _LabelKey = Tuple[Tuple[str, str], ...]
 
@@ -51,11 +52,13 @@ class Counter:
 
     # crdtlint lock-discipline contract (see module docstring).
     _CRDTLINT_GUARDED = {"_lock": ("_values",)}
+    # analysis/concurrency.py: leaf singleton, nothing nests inside.
+    _CRDTLINT_LOCK_ORDER = ("_lock",)
 
     def __init__(self, name: str, help: str = ""):
         self.name = name
         self.help = help
-        self._lock = threading.Lock()
+        self._lock = make_lock("Counter._lock", 90)
         self._values: Dict[_LabelKey, float] = {}
 
     def inc(self, amount: float = 1, **labels: Any) -> None:
@@ -90,11 +93,12 @@ class Gauge:
     kind = "gauge"
 
     _CRDTLINT_GUARDED = {"_lock": ("_values",)}
+    _CRDTLINT_LOCK_ORDER = ("_lock",)
 
     def __init__(self, name: str, help: str = ""):
         self.name = name
         self.help = help
-        self._lock = threading.Lock()
+        self._lock = make_lock("Gauge._lock", 90)
         self._values: Dict[_LabelKey, float] = {}
 
     def set(self, value: float, **labels: Any) -> None:
@@ -138,6 +142,7 @@ class Histogram:
     kind = "histogram"
 
     _CRDTLINT_GUARDED = {"_lock": ("_series",)}
+    _CRDTLINT_LOCK_ORDER = ("_lock",)
 
     def __init__(self, name: str, help: str = "",
                  low_exp: int = -20, high_exp: int = 5):
@@ -147,7 +152,7 @@ class Histogram:
         self.help = help
         self.bounds: Tuple[float, ...] = tuple(
             2.0 ** e for e in range(low_exp, high_exp + 1))
-        self._lock = threading.Lock()
+        self._lock = make_lock("Histogram._lock", 90)
         # label key -> [bucket counts (len(bounds)+1, last=overflow),
         #               total count, running sum]
         self._series: Dict[_LabelKey, list] = {}
@@ -198,9 +203,13 @@ class MetricsRegistry:
     """
 
     _CRDTLINT_GUARDED = {"_lock": ("_instruments", "_collectors")}
+    # analysis/concurrency.py: scrape takes the registry lock, then
+    # each instrument's — never the reverse (registry rank 86 orders
+    # before the instruments' 90 under the runtime sanitizer).
+    _CRDTLINT_LOCK_ORDER = ("_lock",)
 
     def __init__(self) -> None:
-        self._lock = threading.Lock()
+        self._lock = make_lock("MetricsRegistry._lock", 86)
         self._instruments: Dict[str, Any] = {}
         self._collectors: List[Tuple[str, Dict[str, str],
                                      weakref.ref]] = []

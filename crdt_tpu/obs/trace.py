@@ -35,13 +35,14 @@ from __future__ import annotations
 import functools
 import json
 import os
-import threading
 import time
 from collections import deque
 from contextlib import contextmanager
 from typing import Any, Dict, List, Optional
 
 import jax.profiler
+
+from ..analysis.concurrency import make_lock
 
 
 @functools.lru_cache(maxsize=512)
@@ -85,10 +86,13 @@ class TraceRing:
     _CRDTLINT_GUARDED = {"_lock": ("_events", "_sink", "_seq",
                                    "_sink_path", "_sink_bytes",
                                    "_sink_max_bytes")}
+    # analysis/concurrency.py: leaf singleton — emit never takes
+    # another lock inside the ring critical section.
+    _CRDTLINT_LOCK_ORDER = ("_lock",)
 
     def __init__(self, capacity: int = 4096):
         self.enabled = False
-        self._lock = threading.Lock()
+        self._lock = make_lock("TraceRing._lock", 82)
         self._events: deque = deque(maxlen=capacity)
         self._sink = None
         self._sink_path: Optional[str] = None
@@ -187,7 +191,7 @@ _DEFAULT = TraceRing()
 # wall clock (crdtlint wall-clock-read): the node-id prefix makes them
 # fleet-unique, the counter makes them process-unique, and no clock
 # skew can make two rounds collide or reorder.
-_RID_LOCK = threading.Lock()
+_RID_LOCK = make_lock("trace._RID_LOCK", 82)
 _RID_N = 0
 
 
@@ -206,7 +210,7 @@ def round_id(node: Any = None) -> str:
 # exposes per-phase latency distributions, not just the event tail the
 # ring happens to hold. Created lazily to keep import order trivial.
 _SPAN_HIST = None
-_SPAN_HIST_LOCK = threading.Lock()
+_SPAN_HIST_LOCK = make_lock("trace._SPAN_HIST_LOCK", 82)
 
 
 def tracer() -> TraceRing:

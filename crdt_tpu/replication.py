@@ -53,6 +53,7 @@ from concurrent.futures import FIRST_COMPLETED, ThreadPoolExecutor
 from concurrent.futures import wait as futures_wait
 from typing import Callable, Dict, List, Optional, Tuple
 
+from .analysis.concurrency import make_lock
 from .gossip import BreakerPolicy, CircuitBreaker
 from .hlc import Hlc
 from .net import (PeerConnection, SyncError, SyncProtocolError,
@@ -107,6 +108,11 @@ class Replicator:
     into one device dispatch.
     """
 
+    # Checked by analysis/concurrency.py: membership mutations may
+    # hold `_lock` while reading the tier's store lock, never the
+    # reverse — barrier() runs lock-free on the tier's executor.
+    _CRDTLINT_LOCK_ORDER = ("_lock", ("tier.lock", "ServeTier.lock"))
+
     def __init__(self, tier: ServeTier, followers: Dict[str, str],
                  ack_replicas: int = 1, timeout: float = 0.25,
                  group: str = "g0"):
@@ -115,7 +121,7 @@ class Replicator:
         self.timeout = float(timeout)
         self.group = str(group)
         self.tally = WireTally()
-        self._lock = threading.Lock()   # membership mutations
+        self._lock = make_lock("Replicator._lock", 20)  # membership
         self._followers: Dict[str, _Follower] = {
             str(name): _Follower(str(name), str(addr), self.timeout)
             for name, addr in followers.items()}
@@ -325,6 +331,13 @@ class ReplicaGroup:
     a `FaultProxy` in front of every wire the group uses.
     """
 
+    # Checked by analysis/concurrency.py: the group lock (monitor,
+    # promotion, membership) may be held while a member tier's store
+    # lock is taken; the reverse never happens — _on_promote re-enters
+    # FederatedTier._control only AFTER this lock is released (the
+    # PR 15 invariant).
+    _CRDTLINT_LOCK_ORDER = ("_lock", ("tier.lock", "ServeTier.lock"))
+
     def __init__(self, n_slots: int, replicas: int = 3,
                  ack_replicas: int = 1, host: str = "127.0.0.1",
                  group: str = "g0",
@@ -372,7 +385,7 @@ class ReplicaGroup:
         self.members: List[_Member] = [
             _Member(i, f"{self.group}-r{i}")
             for i in range(self.replicas)]
-        self._lock = threading.RLock()
+        self._lock = make_lock("ReplicaGroup._lock", 30, rlock=True)
         self._lease_epoch = 1
         self._primary: Optional[_Member] = None
         # The table owner a pending flip must replace — survives a

@@ -63,6 +63,7 @@ from typing import Any, List, Optional, Tuple
 
 import numpy as np
 
+from .analysis.concurrency import make_lock
 from .hlc import Hlc
 from .net import (MAX_FRAME_BYTES, FrameCodec, WireTally,
                   _flat_views, _pack_for_peer, _pack_split,
@@ -231,6 +232,12 @@ class ServeTier:
     # crdtlint lock-discipline contract, same as SyncServer: every
     # replica access holds the replica lock.
     _CRDTLINT_GUARDED = {"lock": ("crdt",)}
+    # Checked by analysis/concurrency.py: the store lock is a LEAF —
+    # it guards device dispatches by design and no other lock is ever
+    # taken inside it. Control-plane classes that take it while
+    # holding their own lock declare that order on their side
+    # (FederatedTier, Replicator, ReplicaGroup, GossipNode).
+    _CRDTLINT_LOCK_ORDER = ("lock",)
 
     def __init__(self, crdt, host: str = "127.0.0.1", port: int = 0,
                  max_sessions: int = 12000,
@@ -244,7 +251,8 @@ class ServeTier:
                  lock: Optional[threading.RLock] = None,
                  router=None):
         self.crdt = crdt
-        self.lock = lock if lock is not None else threading.RLock()
+        self.lock = lock if lock is not None \
+            else make_lock("ServeTier.lock", 40, rlock=True)
         # Federation: an attached `PartitionRouter` (routing.py) makes
         # this tier one partition of a federated keyspace — keyspace
         # ops are admitted through router.check() before they may

@@ -200,7 +200,9 @@ class FaultProxy:
     # --- lifecycle (SyncServer's shape) ---
 
     def start(self) -> "FaultProxy":
-        self._thread = threading.Thread(target=self._serve, daemon=True)
+        self._thread = threading.Thread(
+            target=self._serve, daemon=True,
+            name=f"fault-proxy-{self.port}")
         self._thread.start()
         return self
 
@@ -279,7 +281,9 @@ class FaultProxy:
             except OSError:
                 return
             threading.Thread(target=self._relay, args=(conn,),
-                             daemon=True).start()
+                             daemon=True,
+                             name=f"fault-relay-{self.port}"
+                                  f"-fd{conn.fileno()}").start()
 
     def _relay(self, conn: socket.socket) -> None:
         self._count("connections")
@@ -303,7 +307,8 @@ class FaultProxy:
             self._count("delay")
             time.sleep(fault["seconds"])
         reply_pump = threading.Thread(
-            target=self._pump_verbatim, args=(up, conn), daemon=True)
+            target=self._pump_verbatim, args=(up, conn), daemon=True,
+            name=f"fault-reply-pump-{self.port}")
         reply_pump.start()
         try:
             self._pump_faulty(conn, up, fault)

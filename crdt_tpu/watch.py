@@ -22,6 +22,8 @@ import weakref
 from collections import deque
 from typing import Any, Callable, List, NamedTuple, Optional
 
+from .analysis.concurrency import make_lock
+
 
 class ChangeEvent(NamedTuple):
     """A (key, value) change notification — value is None for deletes."""
@@ -176,6 +178,9 @@ class AsyncChangeIterator:
     # crdtlint lock-discipline contract: the pending buffer is touched
     # only under self._lock (enforced by crdt_tpu.analysis.host_lint).
     _CRDTLINT_GUARDED = {"_lock": ("_pending",)}
+    # Checked by analysis/concurrency.py: singleton leaf — no other
+    # lock is ever taken inside the handoff critical section.
+    _CRDTLINT_LOCK_ORDER = ("_lock",)
 
     _CLOSE = object()
 
@@ -183,7 +188,7 @@ class AsyncChangeIterator:
         self._pending: deque = deque()
         self._queue: Optional[asyncio.Queue] = None
         self._loop: Optional[asyncio.AbstractEventLoop] = None
-        self._lock = threading.Lock()
+        self._lock = make_lock("AsyncChangeIterator._lock", 60)
         self._closed = False
         # Subscribe through a weak shim: a bound-method callback would
         # make the iterator reachable FROM the hub (hub -> stream ->
@@ -234,6 +239,7 @@ class AsyncChangeIterator:
 
     async def __anext__(self) -> ChangeEvent:
         if self._queue is None:
+            # crdtlint: disable=async-blocking-call -- bounded handoff: the critical section is a few deque ops, and emitters never block inside it
             with self._lock:
                 self._loop = asyncio.get_running_loop()
                 self._queue = asyncio.Queue()

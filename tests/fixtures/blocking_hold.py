@@ -1,0 +1,51 @@
+"""Planted blocking-under-lock fixture for the concurrency analyzer.
+
+Expected findings, exactly two ``blocking-under-lock``:
+
+- ``push()`` — a socket ``sendall`` directly inside the contract
+  lock's critical section: one slow peer wedges every thread that
+  wants the lock.
+- ``push_with_retry()`` — a ``time.sleep`` reached through the
+  ``_backoff`` helper while the lock is held (the interprocedural
+  case: the sleep is lexically nowhere near a ``with`` block).
+
+The socket carries a timeout so the fixture stays clean under
+host_lint's ``socket-no-timeout`` — the planted bugs are exclusively
+the concurrency analyzer's to catch.
+"""
+
+import socket
+import threading
+import time
+
+
+class Shipper:
+    _CRDTLINT_LOCK_ORDER = ("_lock",)
+
+    def __init__(self, addr):
+        self._lock = threading.Lock()
+        self.sock = socket.create_connection(addr, timeout=1.0)
+        self.backlog = []
+
+    def push(self, payload):
+        # PLANTED: socket I/O inside the critical section
+        with self._lock:
+            self.backlog.append(payload)
+            self.sock.sendall(payload)
+
+    def _backoff(self, attempt):
+        time.sleep(0.05 * (attempt + 1))
+
+    def _try_stage(self, payload):
+        self.backlog.append(payload)
+        return len(self.backlog) < 64
+
+    def push_with_retry(self, payload, attempts=3):
+        # PLANTED: the sleep is reached through a helper while the
+        # lock is held
+        with self._lock:
+            for attempt in range(attempts):
+                if self._try_stage(payload):
+                    return True
+                self._backoff(attempt)
+        return False

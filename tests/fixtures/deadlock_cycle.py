@@ -1,0 +1,74 @@
+"""Planted AB/BA deadlock fixture for the concurrency analyzer.
+
+Expected findings, exactly:
+
+- ``lock-order-cycle`` in ``PairStore.backward`` — the declared order
+  is ``_a`` before ``_b``, ``forward()`` conforms, but ``backward()``
+  holds ``_b`` while reaching ``_a`` through the ``_grab_a`` helper
+  (the interprocedural edge), completing the classic inversion.
+- ``lock-order-undeclared`` in ``Indexer.reindex`` — a cross-class
+  nesting (``_idx`` held while taking a Journal's ``_j``) that no
+  contract declares in either direction.
+
+Every lock is deliberately never contended at runtime — the planted
+bugs must be caught purely statically (the file is never imported by
+the shipped tree).
+"""
+
+import threading
+
+
+class PairStore:
+    _CRDTLINT_LOCK_ORDER = ("_a", "_b")
+
+    def __init__(self):
+        self._a = threading.Lock()
+        self._b = threading.Lock()
+        self.hot = {}
+        self.cold = {}
+
+    def forward(self, key, value):
+        # conforms to the declared order: _a then _b
+        with self._a:
+            self.hot[key] = value
+            with self._b:
+                self.cold[key] = value
+
+    def _grab_a(self, key):
+        with self._a:
+            return self.hot.get(key)
+
+    def backward(self, key):
+        # PLANTED: holds _b, then reaches _a through the helper
+        with self._b:
+            if key in self.cold:
+                return self._grab_a(key)
+            return None
+
+
+class Journal:
+    _CRDTLINT_LOCK_ORDER = ("_j",)
+
+    def __init__(self):
+        self._j = threading.Lock()
+        self.entries = []
+
+    def append(self, entry):
+        with self._j:
+            self.entries.append(entry)
+
+
+class Indexer:
+    _CRDTLINT_LOCK_ORDER = ("_idx",)
+
+    def __init__(self):
+        self._idx = threading.Lock()
+        self.index = {}
+
+    def reindex(self, journal):
+        # PLANTED: nests a foreign contract lock with no declared
+        # order between _idx and Journal._j
+        with self._idx:
+            with journal._j:
+                for i, entry in enumerate(journal.entries):
+                    self.index[entry] = i
