@@ -3,11 +3,15 @@
 Runs the static lint leg first (``python -m crdt_tpu.analysis
 --skip-laws --skip-jaxpr``: host linter + whole-tree lock-order
 analyzer — the cheap passes; laws and jaxpr audit have their own CI
-leg), then one fast bench (default ``bench.py --mode sync --smoke``) —
-which appends a normalized record to the trajectory — then verdicts
-that record against the fastest-of-N floors of its ``(mode,
-host_class, smoke)`` group via the same code path as
-``python -m crdt_tpu.obs bench --compare``.
+leg), then a sketch-accuracy leg (the quantile sketch every SLO gate
+now trusts must recover the quantiles of a known synthetic
+distribution within its configured relative error — if that contract
+drifts, every latency verdict downstream is wrong, so it fails CI
+before any bench runs), then one fast bench (default ``bench.py
+--mode sync --smoke``) — which appends a normalized record to the
+trajectory — then verdicts that record against the fastest-of-N
+floors of its ``(mode, host_class, smoke)`` group via the same code
+path as ``python -m crdt_tpu.obs bench --compare``.
 
 Exit code is the verdict's, unchanged:
 
@@ -38,6 +42,43 @@ sys.path.insert(0, _REPO)
 from crdt_tpu.obs.trajectory import TRAJECTORY_PATH, bench_main
 
 
+def sketch_accuracy_leg() -> int:
+    """Recover known quantiles of a synthetic distribution through
+    the quantile sketch within its configured relative error. Pure
+    host-side, deterministic, <100 ms — the cheapest possible proof
+    that the instrument every 14.6 ms SLO verdict rests on still
+    honors its error bound."""
+    import random
+
+    from crdt_tpu.obs.sketch import QuantileSketch
+
+    rng = random.Random(181)
+    # Latency-shaped lognormal sample, ~0.4..80 ms, known exactly by
+    # sorting — the sketch's answer must sit within alpha of the true
+    # order statistic (DDSketch guarantee, plus one half-bucket of
+    # discretization slack).
+    sample = [0.002 * rng.lognormvariate(0.0, 0.75)
+              for _ in range(20000)]
+    alpha = 0.01
+    sk = QuantileSketch(relative_accuracy=alpha)
+    for v in sample:
+        sk.record(v)
+    ordered = sorted(sample)
+    failures = []
+    for q in (0.5, 0.9, 0.99):
+        true = ordered[int(q * (len(ordered) - 1))]
+        got = sk.quantile(q)
+        rel = abs(got - true) / true
+        if rel > alpha * 1.5:
+            failures.append(f"q{q}: true={true:.6f} sketch={got:.6f} "
+                            f"rel_err={rel:.4f} > {alpha * 1.5}")
+    if failures:
+        print("smoke_gate: sketch accuracy leg failed:\n  "
+              + "\n  ".join(failures), file=sys.stderr)
+        return 1
+    return 0
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(
         description="run a smoke bench and gate it against the "
@@ -62,6 +103,10 @@ def main(argv=None) -> int:
         print(f"smoke_gate: lint leg failed (rc={lint_rc})",
               file=sys.stderr)
         return lint_rc
+
+    sketch_rc = sketch_accuracy_leg()
+    if sketch_rc != 0:
+        return sketch_rc
 
     cmd = [sys.executable, os.path.join(_REPO, "bench.py"),
            "--mode", args.mode, "--trajectory", args.trajectory]

@@ -826,6 +826,19 @@ class OrderedLock:
                               acquiring=self.name, thread=thread)
             except Exception:
                 pass
+            try:
+                # Flight recorder (obs/recorder.py): a lock-order
+                # violation is an incident edge worth a debug bundle.
+                # Same safety profile as the obs calls above — the
+                # reporting guard stops recursive reports, and the
+                # recorder throttles same-kind storms itself.
+                from ..obs.recorder import default_recorder
+                default_recorder().trigger(
+                    "lock_order_violation",
+                    {"held": held_name, "acquiring": self.name,
+                     "thread": thread})
+            except Exception:
+                pass
         finally:
             _held.reporting = False
 

@@ -133,6 +133,7 @@ RULES = (
     "ack-before-replicate",
     "scale-decision-unfenced",
     "thread-unnamed",
+    "histogram-ceiling-gate",
     "suppression-without-reason",
 )
 
@@ -1135,6 +1136,90 @@ def _check_scale_fence(tree: ast.AST, path: str) -> List[Finding]:
     return out
 
 
+_BUDGET_NEEDLES = ("budget",)
+
+
+def _hq_tainted(node: ast.AST, tainted: Set[str]) -> bool:
+    """Does ``node`` carry ``histogram_quantile`` output — a direct
+    call, or a Load of a name the caller already marked tainted?"""
+    for n in ast.walk(node):
+        if isinstance(n, ast.Call):
+            name = _dotted(n.func)
+            if name and name.rsplit(".", 1)[-1] \
+                    == "histogram_quantile":
+                return True
+        if isinstance(n, ast.Name) and isinstance(n.ctx, ast.Load) \
+                and n.id in tainted:
+            return True
+    return False
+
+
+def _mentions_budget(node: ast.AST) -> bool:
+    for n in ast.walk(node):
+        if isinstance(n, ast.Name) \
+                and _ident_contains(n.id, _BUDGET_NEEDLES):
+            return True
+        if isinstance(n, ast.Attribute) \
+                and _ident_contains(n.attr, _BUDGET_NEEDLES):
+            return True
+    return False
+
+
+def _check_histogram_ceiling_gate(tree: ast.AST,
+                                  path: str) -> List[Finding]:
+    """Comparing ``histogram_quantile(...)`` output against an SLO
+    budget is a verdict built on quantization, not latency: the log2
+    histogram answers the bucket CEILING, so a true p99 of 16 ms
+    reads as 31.25 ms and any off-power-of-two budget (the 14.6 ms
+    serve envelope) either flaps or can never pass. Controller and
+    verdict code must gate on the quantile sketch
+    (``registry.sketch()`` / ``obs.fleet.fleet_sketch``), which
+    answers true quantiles within ~1% relative error; ceilings are
+    for display. Taint is tracked per function through assignments
+    (including min/max folds), so ``v = histogram_quantile(s, .99);
+    ceil = max(ceil, v); if ceil > budget:`` still fires."""
+    out: List[Finding] = []
+    for fn in _functions(tree):
+        tainted: Set[str] = set()
+        changed = True
+        while changed:
+            changed = False
+            for n in ast.walk(fn):
+                if isinstance(n, ast.Assign):
+                    value, targets = n.value, n.targets
+                elif isinstance(n, (ast.AugAssign, ast.AnnAssign)):
+                    value, targets = n.value, [n.target]
+                elif isinstance(n, ast.NamedExpr):
+                    value, targets = n.value, [n.target]
+                else:
+                    continue
+                if value is None \
+                        or not _hq_tainted(value, tainted):
+                    continue
+                for t in targets:
+                    if isinstance(t, ast.Name) \
+                            and t.id not in tainted:
+                        tainted.add(t.id)
+                        changed = True
+        for n in ast.walk(fn):
+            if not isinstance(n, ast.Compare):
+                continue
+            sides = [n.left] + list(n.comparators)
+            if any(_hq_tainted(s, tainted) for s in sides) \
+                    and any(_mentions_budget(s) for s in sides):
+                out.append(Finding(
+                    rule="histogram-ceiling-gate", path=path,
+                    line=n.lineno,
+                    message=f"{fn.name}() gates an SLO budget on "
+                            "histogram_quantile output — a log2 "
+                            "bucket CEILING, not the latency; an "
+                            "off-power-of-two budget flaps or never "
+                            "passes. Gate on the quantile sketch "
+                            "(obs/sketch.py, ~1% relative error); "
+                            "ceilings are display-only"))
+    return out
+
+
 _ALL_CHECKS = (
     _check_sockets,
     _check_lock_discipline,
@@ -1152,6 +1237,7 @@ _ALL_CHECKS = (
     _check_ack_before_replicate,
     _check_scale_fence,
     _check_thread_unnamed,
+    _check_histogram_ceiling_gate,
 )
 
 

@@ -45,9 +45,14 @@ from typing import Any, Callable, Dict, List, Optional
 
 __all__ = ["Autoscaler"]
 
-# evaluate_slo's serving budget (obs/fleet.py): the default ack target
-# the controller scales against.
-_ACK_P99_BUDGET_S = 0.00425
+# The controller's default ack target: the measured 14.6 ms SERVE_r01
+# envelope (obs/fleet.py SERVE_ACK_ENVELOPE_S — kept literal here so
+# importing the controller never drags the fleet module in). This
+# budget is only gateable because evaluate_slo reads the ack p99 from
+# the mergeable quantile sketch (obs/sketch.py): the log2 histogram's
+# nearest stable boundary is 31.3 ms, more than 2x the envelope, and
+# a bucket ceiling between the two is unmeasured — not a verdict.
+_ACK_P99_BUDGET_S = 0.0146
 
 
 def _metrics():
@@ -127,6 +132,9 @@ class Autoscaler:
         self._prev_t: Optional[float] = None
         self.last_action: Optional[dict] = None
         self.decisions: List[dict] = []   # bounded audit log
+        # Flight-recorder edge detector: a bundle is dumped when the
+        # SLO verdict FLIPS to failing, not on every failing tick.
+        self._last_slo_ok: Optional[bool] = None
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
 
@@ -212,6 +220,20 @@ class Autoscaler:
         self._prev_t = now
         slo = (self._slo_probe() if self._slo_probe is not None
                else self._default_slo())
+        slo_ok = slo.get("ok") if isinstance(slo, dict) else None
+        if slo_ok is False and self._last_slo_ok is not False:
+            # SLO just flipped to failing: capture forensics NOW,
+            # while the trace ring and sketches still hold the bad
+            # window (obs/recorder.py; never let it wedge the tick).
+            try:
+                from .obs.recorder import default_recorder
+                default_recorder().trigger(
+                    "slo_failing",
+                    {"slo": slo, "epoch": epoch,
+                     "partitions": len(tiers)})
+            except Exception:
+                pass
+        self._last_slo_ok = slo_ok
         return {"epoch": epoch, "partitions": len(tiers),
                 "rows": rows, "rates": rates, "queue_depth": depth,
                 "shed": shed, "primaryless": primaryless,

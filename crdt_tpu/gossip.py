@@ -322,6 +322,12 @@ class GossipNode:
         default_registry().attach("wire", self.wire, replace=True,
                                   role="client", node=str(crdt.node_id))
         self.server.metrics_extra = self._metrics_extra
+        # Flight-recorder context (obs/recorder.py): incident bundles
+        # dumped by this process carry the same node/lag/routing/
+        # partition sections the metrics op shows a live poller.
+        # Weakly held — a test's short-lived node never pins itself.
+        from .obs.recorder import default_recorder
+        default_recorder().attach_source(self._metrics_extra)
         # Guards the peer REGISTRY (the dict itself): add_peer may run
         # from any thread while the gossip loop iterates. Per-peer
         # mutable state stays single-writer (the gossip thread).

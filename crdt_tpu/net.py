@@ -699,6 +699,11 @@ class SyncServer:
         # correlate in the JSONL sink (docs/OBSERVABILITY.md). Needs
         # no replica surface, so it is always advertised.
         caps.add("trace")
+        # "sketch" gates the metrics op's "sketches" section (obs/
+        # sketch.py quantile payloads): a session that never agreed
+        # gets the pre-sketch metrics reply byte-identically, so old
+        # pollers keep parsing exactly what they always parsed.
+        caps.add("sketch")
         return caps
 
     def _handle(self, conn: socket.socket) -> None:
@@ -712,6 +717,7 @@ class SyncServer:
         codec: Optional[FrameCodec] = None
         sem_ok = False   # this session negotiated the sem tag lane
         trace_ok = False  # this session negotiated trace piggyback
+        sketch_ok = False  # this session negotiated sketch payloads
         while not self._stop.is_set():
             sent0, received0 = self.tally.sent, self.tally.received
             try:
@@ -746,6 +752,7 @@ class SyncServer:
                 codec = FrameCodec(compress="zlib" in agreed)
                 sem_ok = "semantics" in agreed
                 trace_ok = "trace" in agreed
+                sketch_ok = "sketch" in agreed
             elif op == "push":
                 try:
                     with _recv_span("push", tctx):
@@ -1020,7 +1027,28 @@ class SyncServer:
                                        "detail": str(e)},
                                 self.tally, codec)
                     return
+                if not sketch_ok:
+                    # Pre-sketch sessions (no hello, or one that did
+                    # not agree "sketch") get the reply a pre-sketch
+                    # server produced, byte for byte: stripping the
+                    # section restores the old key order exactly.
+                    snap.pop("sketches", None)
                 if not self._reply(conn, {"metrics": snap},
+                                   self.tally, codec):
+                    return
+            elif op == "debug_dump":
+                # Flight-recorder bundles (obs/recorder.py): the
+                # post-incident forensics surface. New op — legacy
+                # pollers never send it, so no cap gate is needed;
+                # sketch sections still honor the negotiated cap.
+                from .obs.recorder import default_recorder
+                bundles = default_recorder().bundles()
+                if not sketch_ok:
+                    bundles = [
+                        {k: v for k, v in b.items()
+                         if k != "sketches"} for b in bundles]
+                if not self._reply(conn, {"ok": True,
+                                          "bundles": bundles},
                                    self.tally, codec):
                     return
             else:
@@ -1148,7 +1176,7 @@ class PeerConnection:
                  negotiate: bool = True,
                  want_caps: Iterable[str] = ("zlib", "packed",
                                              "semantics", "merkle",
-                                             "trace")):
+                                             "trace", "sketch")):
         self.host = host
         self.port = port
         self.timeout = timeout
@@ -1870,26 +1898,77 @@ def sync_dense_over_tcp(crdt, host: str, port: int,
     return watermark
 
 
-def fetch_metrics(host: str, port: int, timeout: float = 10.0,
-                  tally: Optional[WireTally] = None) -> dict:
-    """Poll a :class:`SyncServer`'s ``metrics`` op: one registry
-    snapshot (merge/peer/wire counters, and — when the server belongs
-    to a `GossipNode` — per-peer HLC lag under ``"lag"``). Raises the
-    usual :class:`SyncError` taxonomy; a pre-metrics server replies
-    ``unknown_op``, surfaced as :class:`SyncProtocolError`."""
+def _poll_op(host: str, port: int, msg: dict, want_field: str,
+             what: str, timeout: float,
+             tally: Optional[WireTally], negotiate: bool) -> Any:
+    """One-shot request/reply poll shared by `fetch_metrics` and
+    `fetch_debug_dump`. With ``negotiate`` the poll opens with a
+    hello asking for the ``sketch`` cap (so sketch-capable servers
+    include quantile payloads); a pre-hello server answers
+    ``unknown_op`` and hangs up, and the poll retries on a fresh
+    socket WITHOUT hello — byte-identical to what an old poller
+    sends, so mixed-version fleets scrape cleanly both ways."""
     import time as _time
     try:
         with socket.create_connection((host, port),
                                       timeout=timeout) as sock:
             sock.settimeout(timeout)
-            send_frame(sock, {"op": "metrics"}, tally)
+            codec: Optional[FrameCodec] = None
+            if negotiate:
+                send_frame(sock, {"op": "hello", "proto": 1,
+                                  "caps": ["zlib", "sketch"]}, tally)
+                hello = recv_frame(
+                    sock, deadline=_time.monotonic() + timeout,
+                    tally=tally)
+                if isinstance(hello, dict) and hello.get("ok") \
+                        and isinstance(hello.get("caps"), list):
+                    codec = FrameCodec(
+                        compress="zlib" in hello["caps"])
+                else:
+                    # Pre-hello server: it reported unknown_op and
+                    # hung up. Fall back to the bare legacy poll.
+                    return _poll_op(host, port, msg, want_field,
+                                    what, timeout, tally,
+                                    negotiate=False)
+            send_frame(sock, msg, tally, codec)
             reply = recv_frame(sock,
                                deadline=_time.monotonic() + timeout,
-                               tally=tally)
-            _check_reply("metrics poll failed", reply, "metrics")
-            send_frame(sock, {"op": "bye"}, tally)
-            return reply["metrics"]
+                               tally=tally, codec=codec)
+            _check_reply(what, reply, want_field)
+            send_frame(sock, {"op": "bye"}, tally, codec)
+            return reply[want_field]
     except SyncError:
         raise
     except (OSError, ValueError) as e:
-        raise SyncTransportError(f"metrics poll failed: {e!r}") from e
+        raise SyncTransportError(f"{what}: {e!r}") from e
+
+
+def fetch_metrics(host: str, port: int, timeout: float = 10.0,
+                  tally: Optional[WireTally] = None,
+                  sketches: bool = True) -> dict:
+    """Poll a :class:`SyncServer`'s ``metrics`` op: one registry
+    snapshot (merge/peer/wire counters, and — when the server belongs
+    to a `GossipNode` — per-peer HLC lag under ``"lag"``). Raises the
+    usual :class:`SyncError` taxonomy; a pre-metrics server replies
+    ``unknown_op``, surfaced as :class:`SyncProtocolError`.
+
+    With ``sketches`` (the default) the poll negotiates the
+    ``sketch`` hello cap first, so the snapshot includes the
+    ``"sketches"`` quantile section from sketch-capable servers;
+    pre-hello servers are re-polled with the legacy bare frame.
+    ``sketches=False`` skips hello entirely — the legacy wire bytes,
+    unchanged."""
+    return _poll_op(host, port, {"op": "metrics"}, "metrics",
+                    "metrics poll failed", timeout, tally,
+                    negotiate=sketches)
+
+
+def fetch_debug_dump(host: str, port: int, timeout: float = 10.0,
+                     tally: Optional[WireTally] = None) -> list:
+    """Fetch a server's flight-recorder bundles (``debug_dump`` op;
+    obs/recorder.py) — the post-incident forensics pull. Pre-recorder
+    servers answer ``unknown_op``, surfaced as
+    :class:`SyncProtocolError`."""
+    return _poll_op(host, port, {"op": "debug_dump"}, "bundles",
+                    "debug dump failed", timeout, tally,
+                    negotiate=True)

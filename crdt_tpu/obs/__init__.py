@@ -43,6 +43,19 @@ normalizes every ``bench.py`` run into one
 newest run against fastest-of-N floors (``python -m crdt_tpu.obs
 bench --compare``), the CI regression gate.
 
+Quantile plane (PR 18): :mod:`~crdt_tpu.obs.sketch` is a mergeable
+DDSketch-style **relative-error quantile sketch** — the registry's
+``sketch()`` instrument records latencies next to the log2 histograms
+but answers quantiles within ~1% instead of bucket ceilings, merges
+commutatively/associatively across replicas (``obs/fleet.py`` folds
+per-replica sketches into fleet-true p99 for ``evaluate_slo`` and the
+autoscaler's 14.6 ms gate), and ships on the ``metrics`` op behind
+the negotiated ``sketch`` hello cap. :mod:`~crdt_tpu.obs.recorder` is
+the **SLO flight recorder**: bounded debug bundles captured when the
+SLO flips to failing, the lease fence trips, or the deadlock
+sanitizer fires — fetched later via the ``debug_dump`` wire op /
+``python -m crdt_tpu.obs dump``.
+
 Exposition: :func:`~crdt_tpu.obs.render.render_prometheus` renders a
 snapshot as Prometheus text; ``python -m crdt_tpu.obs`` polls a live
 node's ``metrics`` op or summarizes a trace JSONL into a per-phase
@@ -52,7 +65,9 @@ latency table (see docs/OBSERVABILITY.md).
 from __future__ import annotations
 
 from .registry import (Counter, Gauge, Histogram, MetricsRegistry,
-                       default_registry)
+                       Sketch, default_registry)
+from .sketch import QuantileSketch, merge_sketches, sketch_quantile
+from .recorder import FlightRecorder, default_recorder
 from .trace import TraceRing, round_id, span, tracer
 from .lag import health_status, lag_entry, lag_millis
 from .probe import CanaryProbe, canary_observed
@@ -72,8 +87,10 @@ def metrics_snapshot() -> dict:
 
 
 __all__ = [
-    "Counter", "Gauge", "Histogram", "MetricsRegistry",
+    "Counter", "Gauge", "Histogram", "Sketch", "MetricsRegistry",
     "default_registry", "metrics_snapshot",
+    "QuantileSketch", "merge_sketches", "sketch_quantile",
+    "FlightRecorder", "default_recorder",
     "TraceRing", "tracer", "span", "round_id",
     "lag_millis", "lag_entry", "health_status",
     "CanaryProbe", "canary_observed",

@@ -25,6 +25,12 @@ against the fastest-of-N floors of its group — the CI regression gate
 (see :mod:`crdt_tpu.obs.trajectory`)::
 
     python -m crdt_tpu.obs bench --compare benchmarks/history/trajectory.jsonl
+
+The ``dump`` subcommand fetches a node's SLO flight-recorder bundles
+over the ``debug_dump`` wire op — post-incident forensics without a
+poller having been attached (see :mod:`crdt_tpu.obs.recorder`)::
+
+    python -m crdt_tpu.obs dump 127.0.0.1:7000
 """
 
 from __future__ import annotations
@@ -69,6 +75,70 @@ def _summarize_file(path: str, out) -> int:
     return 0
 
 
+def _format_bundle(bundle: dict) -> str:
+    """One flight-recorder bundle as a compact human block."""
+    lines = [f"bundle #{bundle.get('seq', '?')} "
+             f"kind={bundle.get('kind')} "
+             f"t_wall_ms={bundle.get('t_wall_ms')}"]
+    ctx = bundle.get("context")
+    if ctx:
+        lines.append(f"  context: {json.dumps(ctx, default=str)}")
+    trace = bundle.get("trace")
+    if isinstance(trace, list):
+        lines.append(f"  trace tail: {len(trace)} events")
+        lines.append(format_phase_table(summarize_trace(trace))
+                     .rstrip().replace("\n", "\n  "))
+    sketches = bundle.get("sketches")
+    if isinstance(sketches, dict) and sketches:
+        from .sketch import sketch_from_sample
+        for name, samples in sorted(sketches.items()):
+            for s in samples:
+                sk = sketch_from_sample(s)
+                if sk is None or sk.count == 0:
+                    continue
+                lines.append(
+                    f"  {name}{s.get('labels', {})}: "
+                    f"count={sk.count} "
+                    f"p50={sk.quantile(0.5):.6f} "
+                    f"p99={sk.quantile(0.99):.6f}")
+    for src in bundle.get("sources", []):
+        if isinstance(src, dict):
+            keys = ", ".join(sorted(src))
+            lines.append(f"  source sections: {keys}")
+    return "\n".join(lines) + "\n"
+
+
+def _dump_main(argv: List[str], out) -> int:
+    """``python -m crdt_tpu.obs dump`` — fetch a node's flight-
+    recorder bundles (obs/recorder.py) over the ``debug_dump`` op."""
+    ap = argparse.ArgumentParser(
+        prog="python -m crdt_tpu.obs dump",
+        description="fetch a node's SLO flight-recorder debug "
+                    "bundles (post-incident forensics)")
+    ap.add_argument("target",
+                    help="host:port of a running SyncServer/ServeTier")
+    ap.add_argument("--timeout", type=float, default=10.0)
+    ap.add_argument("--json", action="store_true",
+                    help="print raw bundle JSON (one per line)")
+    args = ap.parse_args(argv)
+    host, port = _parse_target(args.target)
+    from ..net import SyncError, fetch_debug_dump
+    try:
+        bundles = fetch_debug_dump(host, port, timeout=args.timeout)
+    except SyncError as e:
+        print(f"dump failed: {e}", file=sys.stderr)
+        return 1
+    if args.json:
+        for b in bundles:
+            out.write(json.dumps(b, default=str) + "\n")
+    elif not bundles:
+        out.write("no bundles recorded\n")
+    else:
+        for b in bundles:
+            out.write(_format_bundle(b))
+    return 0
+
+
 def main(argv: Optional[List[str]] = None, out=None) -> int:
     out = out or sys.stdout
     if argv is None:
@@ -79,6 +149,8 @@ def main(argv: Optional[List[str]] = None, out=None) -> int:
     if argv and argv[0] == "bench":
         from .trajectory import bench_main
         return bench_main(argv[1:], out)
+    if argv and argv[0] == "dump":
+        return _dump_main(argv[1:], out)
     ap = argparse.ArgumentParser(
         prog="python -m crdt_tpu.obs",
         description="poll a node's metrics op, or summarize a trace "

@@ -1,4 +1,5 @@
-"""Process-wide metrics registry: counters, gauges, log2 histograms.
+"""Process-wide metrics registry: counters, gauges, log2 histograms,
+and mergeable quantile sketches.
 
 Design constraints, in order:
 
@@ -30,6 +31,8 @@ import weakref
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
 from ..analysis.concurrency import make_lock
+from .sketch import (DEFAULT_MAX_BINS, DEFAULT_RELATIVE_ACCURACY,
+                     QuantileSketch)
 
 _LabelKey = Tuple[Tuple[str, str], ...]
 
@@ -191,6 +194,77 @@ class Histogram:
                             for k, s in state.items()}
 
 
+class Sketch:
+    """Labelled relative-error quantile sketch (obs/sketch.py).
+
+    The histogram's complement, not its replacement: log2 buckets
+    answer "how many under 2**e" cheaply, but their quantiles are
+    bucket *ceilings* — a true p99 of 16 ms reads as 31.25 ms. A
+    sketch series records the same observations into γ-indexed log
+    buckets whose quantile estimates carry a configurable relative
+    error (~1% default), merge commutatively/associatively across
+    replicas, and so can gate an SLO envelope that does not sit on a
+    power of two (docs/OBSERVABILITY.md).
+    """
+
+    kind = "sketch"
+
+    _CRDTLINT_GUARDED = {"_lock": ("_series",)}
+    _CRDTLINT_LOCK_ORDER = ("_lock",)
+
+    def __init__(self, name: str, help: str = "",
+                 relative_accuracy: float = DEFAULT_RELATIVE_ACCURACY,
+                 max_bins: int = DEFAULT_MAX_BINS):
+        self.name = name
+        self.help = help
+        self.relative_accuracy = float(relative_accuracy)
+        self.max_bins = int(max_bins)
+        self._lock = make_lock("Sketch._lock", 90)
+        self._series: Dict[_LabelKey, QuantileSketch] = {}
+
+    def observe(self, value: float, **labels: Any) -> None:
+        key = _label_key(labels)
+        with self._lock:
+            sk = self._series.get(key)
+            if sk is None:
+                sk = QuantileSketch(self.relative_accuracy,
+                                    self.max_bins)
+                self._series[key] = sk
+            sk.record(value)
+
+    def quantile(self, q: float, **labels: Any) -> Optional[float]:
+        """Per-series quantile; ``None`` when that label set has no
+        observations (unmeasured ≠ zero)."""
+        key = _label_key(labels)
+        with self._lock:
+            sk = self._series.get(key)
+            return None if sk is None else sk.quantile(q)
+
+    def merged(self) -> Optional[QuantileSketch]:
+        """All label sets folded into one fresh sketch; ``None`` when
+        the instrument has never observed."""
+        with self._lock:
+            sketches = [sk.copy() for sk in self._series.values()]
+        out: Optional[QuantileSketch] = None
+        for sk in sketches:
+            out = sk if out is None else out.merge(sk)
+        return out
+
+    def samples(self) -> List[dict]:
+        with self._lock:
+            items = [(k, sk.copy()) for k, sk in self._series.items()]
+        return [{"labels": dict(k), "count": sk.count, "sum": sk.sum,
+                 "sketch": sk.to_dict()} for k, sk in items]
+
+    def _state(self) -> Dict[_LabelKey, QuantileSketch]:
+        with self._lock:
+            return {k: sk.copy() for k, sk in self._series.items()}
+
+    def _restore(self, state: Dict[_LabelKey, QuantileSketch]) -> None:
+        with self._lock:
+            self._series = {k: sk.copy() for k, sk in state.items()}
+
+
 class MetricsRegistry:
     """Named instruments plus weak-referenced stat collectors.
 
@@ -237,6 +311,13 @@ class MetricsRegistry:
         return self._get_or_create(Histogram, name, help,
                                    low_exp=low_exp, high_exp=high_exp)
 
+    def sketch(self, name: str, help: str = "",
+               relative_accuracy: float = DEFAULT_RELATIVE_ACCURACY,
+               max_bins: int = DEFAULT_MAX_BINS) -> Sketch:
+        return self._get_or_create(Sketch, name, help,
+                                   relative_accuracy=relative_accuracy,
+                                   max_bins=max_bins)
+
     def attach(self, kind: str, obj: Any, *, replace: bool = False,
                **labels: Any) -> Any:
         """Register ``obj`` (anything with ``as_dict()``) as a live
@@ -280,10 +361,14 @@ class MetricsRegistry:
             collectors = list(self._collectors)
             self._collectors = [c for c in collectors
                                 if c[2]() is not None]
+        # "sketches" sits before "stats" so a wire layer that strips
+        # it for a pre-sketch peer (net.py metrics op) leaves a dict
+        # whose key order — hence serialized bytes — is identical to
+        # what a pre-sketch server produced.
         out = {"counters": {}, "gauges": {}, "histograms": {},
-               "stats": {}}
+               "sketches": {}, "stats": {}}
         section = {"counter": "counters", "gauge": "gauges",
-                   "histogram": "histograms"}
+                   "histogram": "histograms", "sketch": "sketches"}
         for inst in instruments:
             out[section[inst.kind]][inst.name] = inst.samples()
         for kind, labels, ref in collectors:

@@ -13,6 +13,12 @@ from __future__ import annotations
 import math
 from typing import Any, Dict, Iterable, List
 
+from .sketch import sketch_from_sample
+
+# Sketch series render as Prometheus summary quantiles at these
+# points — the tails SLO gates read, plus the median.
+_SKETCH_QUANTILES = (0.5, 0.9, 0.99)
+
 # stats-collector kinds → Prometheus metric family prefixes. The
 # legacy dataclasses expose as_dict() fields; each numeric field
 # becomes one family: e.g. MergeStats.merges (kind "merge") renders as
@@ -72,6 +78,23 @@ def render_prometheus(snapshot: Dict[str, Any]) -> str:
             cum += s.get("overflow", 0)
             labels = dict(s["labels"], le="+Inf")
             lines.append(f"{name}_bucket{_labels(labels)} {cum}")
+            lines.append(f"{name}_count{_labels(s['labels'])} "
+                         f"{s['count']}")
+            lines.append(f"{name}_sum{_labels(s['labels'])} "
+                         f"{_fmt(s['sum'])}")
+    # Quantile sketches expose as summaries: unlike the log2
+    # histogram families above (whose quantiles are bucket ceilings),
+    # these carry the sketch's relative-error bound — the series a
+    # dashboard should alert on (docs/OBSERVABILITY.md).
+    for name, samples in sorted(snapshot.get("sketches", {}).items()):
+        lines.append(f"# TYPE {name} summary")
+        for s in samples:
+            sk = sketch_from_sample(s)
+            if sk is not None and sk.count > 0:
+                for q in _SKETCH_QUANTILES:
+                    labels = dict(s["labels"], quantile=f"{q:g}")
+                    lines.append(f"{name}{_labels(labels)} "
+                                 f"{_fmt(sk.quantile(q))}")
             lines.append(f"{name}_count{_labels(s['labels'])} "
                          f"{s['count']}")
             lines.append(f"{name}_sum{_labels(s['labels'])} "

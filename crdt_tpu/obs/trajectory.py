@@ -73,10 +73,15 @@ _HIGHER_TOKENS = ("per_sec", "per_s", "_ops", "ops_s", "throughput",
 # "overhead_frac" is the bench's own self-measurement, gated absolutely
 # in-bench against ledger_overhead_budget_frac — its floor bounces 2x
 # run to run, so a multiplicative trajectory floor would only flap.
+# "ceiling" tags metrics derived from histogram_quantile bucket
+# ceilings (obs/fleet.py): those are log2-quantized upper bounds, so
+# gating a real sample against a ceiling floor would verdict the
+# quantization, not the latency — benches record the sketch-true
+# quantile (obs/sketch.py) in a separate, gated key alongside.
 _SKIP_TOKENS = ("budget", "_n", "n_", "rounds", "repeats", "bytes",
                 "rows", "slots", "count", "size", "width", "port",
                 "seed", "chunk", "depth", "within", "ok", "vs_baseline",
-                "overhead_frac")
+                "overhead_frac", "ceiling")
 
 
 def metric_direction(name: str) -> Optional[str]:

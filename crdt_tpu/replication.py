@@ -136,6 +136,12 @@ class Replicator:
         self._m_barrier = reg.histogram(
             "crdt_tpu_replication_barrier_seconds",
             "flush-tick write-concern barrier wall time")
+        # Sketch twin: relative-error barrier quantiles for the fleet
+        # roll-up (obs/sketch.py; docs/OBSERVABILITY.md).
+        self._m_barrier_sketch = reg.sketch(
+            "crdt_tpu_replication_barrier_seconds_sketch",
+            "flush-tick write-concern barrier wall time, "
+            "relative-error quantile sketch")
 
     # --- membership (monitor thread) ---
 
@@ -207,8 +213,9 @@ class Replicator:
             for fut in done:
                 if fut.result():
                     acked += 1
-        self._m_barrier.observe(time.perf_counter() - t0,
-                                group=self.group)
+        barrier_s = time.perf_counter() - t0
+        self._m_barrier.observe(barrier_s, group=self.group)
+        self._m_barrier_sketch.observe(barrier_s, group=self.group)
         if acked >= need:
             return True, f"{acked}/{need} follower acks"
         return False, (f"write concern unmet: {acked}/{need} "

@@ -313,12 +313,25 @@ class ServeTier:
         self._m_ack = reg.histogram(
             "crdt_tpu_serve_ack_seconds",
             "write enqueue-to-ack latency (queue wait + tick commit)")
+        # Sketch twins of the ack/phase histograms: same observations,
+        # γ-indexed buckets, ~1% relative-error quantiles instead of
+        # bucket ceilings. The fleet poller merges these into the
+        # fleet-true p99 evaluate_slo and the autoscaler gate on —
+        # the 14.6 ms envelope is not a power of two
+        # (docs/OBSERVABILITY.md).
+        self._m_ack_sketch = reg.sketch(
+            "crdt_tpu_serve_ack_seconds_sketch",
+            "write enqueue-to-ack latency, relative-error quantile "
+            "sketch")
         self._m_ack_phase = reg.histogram(
             "crdt_tpu_serve_ack_phase_seconds",
             "write-ack latency decomposed by phase: queue_wait (enqueue "
             "to tick pickup), stamp (HLC send_batch), scatter (device "
             "commit dispatch), ack_write (residual tick work + ack "
             "fan-out)")
+        self._m_ack_phase_sketch = reg.sketch(
+            "crdt_tpu_serve_ack_phase_seconds_sketch",
+            "write-ack phase latency, relative-error quantile sketch")
         self._m_moved = reg.counter(
             "crdt_tpu_serve_moved_total",
             "keyspace ops redirected with the moved reply (federated "
@@ -580,6 +593,18 @@ class ServeTier:
             if self._lease_expired():
                 outcome: Any = ("busy", "primary lease expired "
                                         "(fenced; retry)")
+                # Flight recorder (obs/recorder.py): a tripped lease
+                # fence is an incident edge — a write arrived after
+                # this primary's authority lapsed. Capture while the
+                # trace ring still holds the window; the recorder
+                # throttles the tight-retry storm itself.
+                try:
+                    from .obs.recorder import default_recorder
+                    default_recorder().trigger(
+                        "lease_fence",
+                        {"node": self._node, "writes_fenced": n})
+                except Exception:
+                    pass
             elif rep is not None:
                 replicated, detail = await self._loop.run_in_executor(
                     self._replica_pool, rep.barrier)
@@ -607,6 +632,7 @@ class ServeTier:
             if not fut.done():
                 fut.set_result(outcome)
             self._m_ack.observe(now - t0, node=self._node)
+            self._m_ack_sketch.observe(now - t0, node=self._node)
             if outcome is True:
                 self._m_ack_phase.observe(
                     max(0.0, tick_t - t0), phase="queue_wait",
@@ -617,6 +643,15 @@ class ServeTier:
                                           node=self._node)
                 self._m_ack_phase.observe(ack_write, phase="ack_write",
                                           node=self._node)
+                self._m_ack_phase_sketch.observe(
+                    max(0.0, tick_t - t0), phase="queue_wait",
+                    node=self._node)
+                self._m_ack_phase_sketch.observe(
+                    stamp, phase="stamp", node=self._node)
+                self._m_ack_phase_sketch.observe(
+                    scatter, phase="scatter", node=self._node)
+                self._m_ack_phase_sketch.observe(
+                    ack_write, phase="ack_write", node=self._node)
         await self._fanout_tick()
 
     def _commit(self, slots: np.ndarray, vals: np.ndarray,
@@ -852,6 +887,10 @@ class ServeTier:
         # replica surface needed, so it is advertised unconditionally
         # (same as SyncServer).
         caps.add("trace")
+        # Quantile-sketch metrics payloads: sessions that agree get a
+        # "sketches" section on the metrics op; everyone else gets
+        # the pre-sketch reply byte-identically (same as SyncServer).
+        caps.add("sketch")
         if self.router is not None:
             # Advertised only by routed tiers: a client that agrees
             # gets `moved` redirects; one that never asks is a
@@ -1134,6 +1173,7 @@ class ServeTier:
         sem_ok = False
         trace_ok = False
         fed_ok = False
+        sketch_ok = False
         watching = False
         while not self._stop_event.is_set():
             msg = await self._read_op(reader, codec,
@@ -1223,6 +1263,7 @@ class ServeTier:
                 sem_ok = "semantics" in agreed
                 trace_ok = "trace" in agreed
                 fed_ok = "federation" in agreed
+                sketch_ok = "sketch" in agreed
 
             elif op == "route":
                 router = self.router
@@ -1487,8 +1528,28 @@ class ServeTier:
                                  "detail": str(e)},
                         codec, self.tally)
                     return
+                if not sketch_ok:
+                    # Pre-sketch sessions (no hello, or one that did
+                    # not agree "sketch") get the reply a pre-sketch
+                    # server produced, byte for byte: stripping the
+                    # section restores the old key order exactly.
+                    snap.pop("sketches", None)
                 await write_json_async(writer, {"metrics": snap},
                                        codec, self.tally)
+
+            elif op == "debug_dump":
+                # Flight-recorder bundles (obs/recorder.py): the
+                # post-incident forensics surface. New op — legacy
+                # pollers never send it, so no cap is needed.
+                from .obs.recorder import default_recorder
+                bundles = default_recorder().bundles()
+                if not sketch_ok:
+                    bundles = [
+                        {k: v for k, v in b.items()
+                         if k != "sketches"} for b in bundles]
+                await write_json_async(
+                    writer, {"ok": True, "bundles": bundles},
+                    codec, self.tally)
 
             else:
                 await write_json_async(

@@ -1017,3 +1017,74 @@ def test_ordered_lock_rlock_reentry_is_not_a_violation(monkeypatch):
     counter = default_registry().counter(
         "crdt_tpu_lock_order_violations_total")
     assert counter.value(held="Reent.r", acquiring="Reent.r") == 0
+
+
+# --- histogram-ceiling-gate (PR 18) ---
+
+def test_histogram_ceiling_gate_direct_compare_flagged():
+    src = (
+        "def decide(snap, budget_s):\n"
+        "    if histogram_quantile(snap, 0.99) > budget_s:\n"
+        "        split()\n")
+    rules = {f.rule for f in lint_source(src, "snippet.py")}
+    assert "histogram-ceiling-gate" in rules
+
+
+def test_histogram_ceiling_gate_taint_through_max_fold_flagged():
+    # The realistic controller shape: quantile folded through an
+    # assignment and a max() before the gate — the taint must follow.
+    src = (
+        "def decide(samples, ack_p99_budget_s):\n"
+        "    ceil = None\n"
+        "    for s in samples:\n"
+        "        v = histogram_quantile(s, 0.99)\n"
+        "        ceil = v if ceil is None else max(ceil, v)\n"
+        "    if ceil is not None and ceil > ack_p99_budget_s:\n"
+        "        return 'split'\n")
+    findings = [f for f in lint_source(src, "snippet.py")
+                if f.rule == "histogram-ceiling-gate"]
+    assert findings
+    # pinned to the gate line, not the fold
+    assert findings[0].line == 6
+
+
+def test_histogram_ceiling_gate_display_only_not_flagged():
+    # Rendering the ceiling (no budget in sight) is fine — ceilings
+    # are display-only; so are non-budget compares like the None /
+    # inf guards.
+    src = (
+        "def render(samples):\n"
+        "    rows = []\n"
+        "    for s in samples:\n"
+        "        v = histogram_quantile(s, 0.99)\n"
+        "        if v is not None and v != float('inf'):\n"
+        "            rows.append(v * 1e3)\n"
+        "    return rows\n")
+    rules = {f.rule for f in lint_source(src, "snippet.py")}
+    assert "histogram-ceiling-gate" not in rules
+
+
+def test_histogram_ceiling_gate_sketch_gate_not_flagged():
+    # The migration target: gating the same budget on the sketch
+    # quantile must stay clean even with a ceiling computed alongside
+    # for display.
+    src = (
+        "def decide(snapshots, ack_p99_budget_s):\n"
+        "    ceil = histogram_quantile(snapshots[0], 0.99)\n"
+        "    sk = fleet_sketch(snapshots)\n"
+        "    p99 = sk.quantile(0.99)\n"
+        "    show(ceil)\n"
+        "    return p99 is not None and p99 <= ack_p99_budget_s\n")
+    rules = {f.rule for f in lint_source(src, "snippet.py")}
+    assert "histogram-ceiling-gate" not in rules
+
+
+def test_histogram_ceiling_gate_shipped_fleet_fallback_suppressed():
+    # fleet.py's pre-sketch fallback compares the ceiling against the
+    # budget on purpose (three-valued: pass / floor-breach / None) —
+    # it must stay suppressed with a reason, not exempted silently.
+    import crdt_tpu.obs.fleet as fleet
+    findings = [f for f in lint_file(fleet.__file__)
+                if f.rule in ("histogram-ceiling-gate",
+                              "suppression-without-reason")]
+    assert findings == []

@@ -84,7 +84,7 @@ def test_hello_negotiates_cap_intersection():
             conn.ensure()
             assert conn.caps == frozenset({"zlib", "packed",
                                            "semantics", "merkle",
-                                           "trace"})
+                                           "trace", "sketch"})
             assert not conn.legacy
         with PeerConnection(server.host, server.port, timeout=5.0,
                             want_caps=("zlib",)) as conn:
@@ -97,7 +97,8 @@ def test_map_server_does_not_advertise_packed():
         with PeerConnection(server.host, server.port,
                             timeout=5.0) as conn:
             conn.ensure()
-            assert conn.caps == frozenset({"zlib", "trace"})
+            assert conn.caps == frozenset({"zlib", "trace",
+                                           "sketch"})
 
 
 def test_pooled_session_reuses_one_connect():
