@@ -1246,9 +1246,11 @@ def bench_serve(sessions: int = 10000, rate_hz: float = 1.0,
     import resource
     import struct as _struct
     from crdt_tpu import DenseCrdt, ServeTier
+    from crdt_tpu.net import (BINOP_PUT, BINOP_ST_OK,
+                              decode_binop_reply, encode_binop_request)
     from crdt_tpu.obs.fleet import evaluate_slo
     from crdt_tpu.obs.registry import default_registry
-    from crdt_tpu.serve import read_frame_async
+    from crdt_tpu.serve import read_bytes_frame_async, read_frame_async
 
     # fd budget: the tier process holds ONE server-side fd per
     # session; the fleet runs in a forked child whose client-side fds
@@ -1413,16 +1415,142 @@ def bench_serve(sessions: int = 10000, rate_hz: float = 1.0,
         if isinstance(res, dict):
             raise RuntimeError(f"serve fleet failed: {res['error']}")
         lats, counters, connected = res
+
+        def _delta(after, before):
+            return {k: (c - before.get(k, (0, 0.0))[0],
+                        s - before.get(k, (0, 0.0))[1])
+                    for k, (c, s) in after.items()}
+
+        # Snapshot the open-loop run's deltas BEFORE the lane
+        # scenario below adds its own ticks and ack observations.
+        ticks = int(ticks_c.value(trigger="tick", node="srv") - ticks0)
+        ack_d = _delta(_hist_sums(ack_h), ack0)
+        phase_d = _delta(_hist_sums(phase_h, "phase"), phase0)
+
+        # --- dual-lane scenario (docs/WIRE.md): the SAME tier, the
+        # same open-loop frame schedule, equal seated sessions —
+        # JSON one-op-per-frame vs the negotiated binary lane at
+        # `lane_batch` ops per frame. The per-seat frame budget is
+        # what a real client fleet holds constant (its send loop), so
+        # acked-ops/s ratio IS the lane's per-host ceiling gain, and
+        # it is only achieved if the tier actually keeps up: a decode
+        # stall or shed session shows up as lane errors and a ratio
+        # below the x5 acceptance gate. Byte counts are whole-wire
+        # (header + body, both directions) per ACKED op.
+        lane_sessions = min(sessions, 1000)
+        lane_batch = 16
+        lane_rate = 2.0
+        lane_warm = min(warmup, 1.0)
+        lane_dur = min(duration, 5.0)
+
+        async def lane_session(reader, writer, k, start, end,
+                               ctrs, interval, n_sess, lane):
+            loop = asyncio.get_running_loop()
+            slot0 = (k * lane_batch) % n_slots
+            try:
+                if lane == "bin":
+                    hello = json.dumps({"op": "hello", "proto": 1,
+                                        "caps": ["binop"]}).encode()
+                    writer.write(head.pack(len(hello)) + hello)
+                    await writer.drain()
+                    reply = await read_frame_async(reader)
+                    if not (isinstance(reply, dict)
+                            and reply.get("ok")
+                            and "binop" in reply.get("caps", ())):
+                        ctrs["errors"] += 1
+                        return
+                t0 = start + (k / max(1, n_sess)) * interval
+                i = 0
+                while True:
+                    sched = t0 + i * interval
+                    if sched >= end:
+                        return
+                    now = loop.time()
+                    if sched > now:
+                        await asyncio.sleep(sched - now)
+                    if lane == "bin":
+                        # Post-hello framing is codec-tagged: one
+                        # 0x00 raw tag ahead of the binop body.
+                        slots = [(slot0 + j) % n_slots
+                                 for j in range(lane_batch)]
+                        body = b"\x00" + b"".join(
+                            bytes(p) for p in encode_binop_request(
+                                [BINOP_PUT] * lane_batch, slots,
+                                [i] * lane_batch))
+                        writer.write(head.pack(len(body)) + body)
+                        await writer.drain()
+                        raw = await read_bytes_frame_async(reader)
+                        if raw is None:
+                            ctrs["errors"] += 1
+                            return
+                        status, _, _ = decode_binop_reply(raw[1:])
+                        if not (status == BINOP_ST_OK).all():
+                            ctrs["errors"] += 1
+                            return
+                        ctrs["acked"] += lane_batch
+                        ctrs["bytes"] += 8 + len(body) + len(raw)
+                    else:
+                        body = json.dumps({"op": "put", "slot": slot0,
+                                           "value": i}).encode()
+                        writer.write(head.pack(len(body)) + body)
+                        await writer.drain()
+                        raw = await read_bytes_frame_async(reader)
+                        reply = (None if raw is None
+                                 else json.loads(raw))
+                        if not (isinstance(reply, dict)
+                                and reply.get("ok")):
+                            ctrs["errors"] += 1
+                            return
+                        ctrs["acked"] += 1
+                        ctrs["bytes"] += 8 + len(body) + len(raw)
+                    i += 1
+            except (ConnectionError, OSError,
+                    asyncio.IncompleteReadError):
+                ctrs["errors"] += 1
+            finally:
+                try:
+                    writer.close()
+                except Exception:
+                    pass
+
+        async def lane_fleet(lane):
+            loop = asyncio.get_running_loop()
+            interval = 1.0 / lane_rate
+            ctrs = {"acked": 0, "errors": 0, "bytes": 0,
+                    "connect_failures": 0}
+            conns = []
+            for base in range(0, lane_sessions, connect_batch):
+                m = min(connect_batch, lane_sessions - base)
+                got = await asyncio.gather(
+                    *(asyncio.open_connection(tier.host, tier.port)
+                      for _ in range(m)),
+                    return_exceptions=True)
+                for r in got:
+                    if isinstance(r, BaseException):
+                        ctrs["connect_failures"] += 1
+                    else:
+                        conns.append(r)
+            start = loop.time() + 0.5 + lane_warm
+            end = start + lane_dur
+            await asyncio.gather(*(
+                lane_session(r, w, k, start, end, ctrs, interval,
+                             lane_sessions, lane)
+                for k, (r, w) in enumerate(conns)))
+            return ctrs
+
+        copy_c = default_registry().counter(
+            "crdt_tpu_pack_copy_bytes_total",
+            "bytes copied between pack and frame (zero on the "
+            "arena fast path)")
+
+        def _copy_total():
+            return sum(s["value"] for s in copy_c.samples())
+
+        json_ctrs = asyncio.run(lane_fleet("json"))
+        copy0 = _copy_total()
+        bin_ctrs = asyncio.run(lane_fleet("bin"))
+        pack_copy_delta = int(_copy_total() - copy0)
         shed, dropped = tier.shed_count, tier.dropped_sessions
-    ticks = int(ticks_c.value(trigger="tick", node="srv") - ticks0)
-
-    def _delta(after, before):
-        return {k: (c - before.get(k, (0, 0.0))[0],
-                    s - before.get(k, (0, 0.0))[1])
-                for k, (c, s) in after.items()}
-
-    ack_d = _delta(_hist_sums(ack_h), ack0)
-    phase_d = _delta(_hist_sums(phase_h, "phase"), phase0)
     ack_n, ack_sum = ack_d.get("", (0, 0.0))
     phase_sum = sum(s for _, s in phase_d.values())
     attribution = (phase_sum / ack_sum) if ack_sum else None
@@ -1449,6 +1577,14 @@ def bench_serve(sessions: int = 10000, rate_hz: float = 1.0,
     ack_sk_p99_s = ack_sk.quantile(0.99, node="srv")
     sketch_probe = _sketch_overhead(
         (ack_sum / ack_n) if ack_n else None)
+    lane_sk = default_registry().sketch(
+        "crdt_tpu_serve_ack_lane_seconds_sketch")
+    json_lane_p99_s = lane_sk.quantile(0.99, lane="json", node="srv")
+    bin_lane_p99_s = lane_sk.quantile(0.99, lane="bin", node="srv")
+    json_lane_ops_s = json_ctrs["acked"] / lane_dur
+    bin_lane_ops_s = bin_ctrs["acked"] / lane_dur
+    lane_ratio = (bin_lane_ops_s / json_lane_ops_s
+                  if json_lane_ops_s else None)
     return {
         "metric": "serve_open_loop", "unit": "ops/s",
         "platform": jax.devices()[0].platform,
@@ -1502,6 +1638,39 @@ def bench_serve(sessions: int = 10000, rate_hz: float = 1.0,
                                if ack_ceiling_s is not None else None),
         "ack_p99_sketch_ms": (round(ack_sk_p99_s * 1e3, 4)
                               if ack_sk_p99_s is not None else None),
+        # Dual-lane scenario (docs/WIRE.md): JSON per-op vs binary
+        # batched frames through the same tier at equal seated
+        # sessions and one frame schedule. The ops/s ratio is the
+        # per-host ceiling gain the binary lane buys a seat-bound
+        # fleet; the x5 gate only passes when the tier acks every
+        # batch (lane errors collapse the ratio). bytes_per_op is
+        # whole-wire both directions; pack_copy_delta_bytes proves
+        # the binary ack/read path stayed on the arena discipline
+        # (zero copy-counter movement across the entire bin run).
+        "lane_sessions": lane_sessions,
+        "lane_batch": lane_batch,
+        "lane_rate_hz": lane_rate,
+        "json_lane_ops_s": round(json_lane_ops_s, 1),
+        "bin_lane_ops_s": round(bin_lane_ops_s, 1),
+        "bin_vs_json_ops": (round(lane_ratio, 2)
+                            if lane_ratio is not None else None),
+        "binop_speedup_ok": (lane_ratio is not None
+                             and lane_ratio >= 5.0),
+        "json_bytes_per_op": (round(json_ctrs["bytes"]
+                                    / json_ctrs["acked"], 1)
+                              if json_ctrs["acked"] else None),
+        "bin_bytes_per_op": (round(bin_ctrs["bytes"]
+                                   / bin_ctrs["acked"], 1)
+                             if bin_ctrs["acked"] else None),
+        "json_lane_errors": json_ctrs["errors"],
+        "bin_lane_errors": bin_ctrs["errors"],
+        "json_lane_ack_p99_sketch_ms": (
+            round(json_lane_p99_s * 1e3, 4)
+            if json_lane_p99_s is not None else None),
+        "bin_lane_ack_p99_sketch_ms": (
+            round(bin_lane_p99_s * 1e3, 4)
+            if bin_lane_p99_s is not None else None),
+        "pack_copy_delta_bytes": pack_copy_delta,
         **sketch_probe,
         # Fleet SLO verdict over this process's own registry snapshot
         # (same evaluator the network poller runs); main() prints it

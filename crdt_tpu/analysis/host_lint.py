@@ -75,10 +75,18 @@ reproduced bugs):
   (docs/OBSERVABILITY.md).
 - ``router-epoch-bypass`` — in a class carrying a partition router
   (``self.router`` assigned in ``__init__``), a keyspace-op enqueue
-  (``self._q.append``) with no router consultation lexically before
-  it; such a write skips the ``moved``/stale-epoch admission gate and
-  can land on a partition that no longer owns the slot mid-split
-  (docs/FEDERATION.md).
+  (``self._q.append``/``.push``) with no router consultation
+  lexically before it; such a write skips the ``moved``/stale-epoch
+  admission gate and can land on a partition that no longer owns the
+  slot mid-split (docs/FEDERATION.md).
+- ``combiner-enqueue-unsafe`` — in a combiner-owning class
+  (``self._wc`` assigned in ``__init__``, the serving-tier shape), a
+  plain-list ``.append`` on the pending write queue (any
+  ``self._q...`` target); multi-loop serving drains that queue from
+  the committer while EVERY accept loop produces into it, so the one
+  sanctioned enqueue is the MPSC gate's ``push`` — a bare list append
+  races the committer's swap and can drop or double-resolve an acked
+  write (docs/SERVING.md).
 - ``collective-socket-fallback-silent`` — in a class carrying a
   pod-local replica group (``self._group`` assigned in ``__init__``),
   a ``try`` that attempts the collective join with an except-handler
@@ -129,6 +137,7 @@ RULES = (
     "async-blocking-call",
     "metric-name-unprefixed",
     "router-epoch-bypass",
+    "combiner-enqueue-unsafe",
     "collective-socket-fallback-silent",
     "ack-before-replicate",
     "scale-decision-unfenced",
@@ -829,15 +838,20 @@ def _check_metric_names(tree: ast.AST, path: str) -> List[Finding]:
 
 # Lexical evidence that a method admits keyspace ops through the
 # partition router before enqueueing: it touches self.router, or it
-# calls the tier's route-verdict helper.
-_ROUTER_GATE_CALLS = {"_route_verdict", "check"}
+# calls the tier's route-verdict helper (the batched binop admission
+# path goes through check_batch).
+_ROUTER_GATE_CALLS = {"_route_verdict", "check", "check_batch"}
+
+# Enqueue spellings the write-queue rules recognize: list-era append
+# and the MPSC gate's push.
+_ENQUEUE_CALLS = {"append", "push"}
 
 
 def _check_router_bypass(tree: ast.AST, path: str) -> List[Finding]:
     """In a class that carries a partition router (``self.router``
     assigned in ``__init__``), every method that enqueues a keyspace
-    op (``self._q.append``) must consult the router FIRST — an
-    enqueue lexically before any router reference is a write the
+    op (``self._q.append``/``.push``) must consult the router FIRST —
+    an enqueue lexically before any router reference is a write the
     `moved`/stale-epoch protocol never saw, which silently violates
     partition ownership during a live split (docs/FEDERATION.md)."""
     out: List[Finding] = []
@@ -878,7 +892,7 @@ def _check_router_bypass(tree: ast.AST, path: str) -> List[Finding]:
                         gate_line = n.lineno
                 if isinstance(n, ast.Call) \
                         and isinstance(n.func, ast.Attribute) \
-                        and n.func.attr == "append":
+                        and n.func.attr in _ENQUEUE_CALLS:
                     tgt = _dotted(n.func.value)
                     if tgt == "self._q":
                         appends.append(n)
@@ -888,13 +902,87 @@ def _check_router_bypass(tree: ast.AST, path: str) -> List[Finding]:
                         rule="router-epoch-bypass", path=path,
                         line=call.lineno,
                         message=f"{fn.name}() enqueues a keyspace op "
-                                "(self._q.append) without first "
+                                "(self._q enqueue) without first "
                                 "consulting self.router — the op "
                                 "bypasses the moved/stale-epoch "
                                 "admission gate and can land on a "
                                 "partition that no longer owns the "
                                 "slot mid-split "
                                 "(docs/FEDERATION.md)"))
+    return out
+
+
+# --- rule: combiner-enqueue-unsafe ---
+
+
+def _dotted_through_subscripts(node: ast.AST) -> Optional[str]:
+    """Like ``_dotted`` but a subscript link in the chain is elided
+    rather than fatal: ``self._q._stripes[0].items`` reads as
+    ``self._q._stripes.items`` — reaching INTO the queue's stripes is
+    exactly the bypass this rule exists to catch."""
+    parts: List[str] = []
+    while True:
+        if isinstance(node, ast.Attribute):
+            parts.append(node.attr)
+            node = node.value
+        elif isinstance(node, ast.Subscript):
+            node = node.value
+        else:
+            break
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def _check_combiner_enqueue(tree: ast.AST, path: str) -> List[Finding]:
+    """In a combiner-owning class (``self._wc`` assigned in
+    ``__init__`` — the serving-tier shape), every enqueue into the
+    pending write queue must go through the MPSC gate (``.push``): a
+    plain-list ``.append`` on any ``self._q...`` target is a producer
+    that bypasses the stripe locks, racing the committer's drain swap
+    from whatever thread it runs on. ``__init__`` is exempt
+    (construction happens-before publication)."""
+    out: List[Finding] = []
+    for cls in ast.walk(tree):
+        if not isinstance(cls, ast.ClassDef):
+            continue
+        owns_wc = False
+        for fn in cls.body:
+            if isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)) \
+                    and fn.name == "__init__":
+                for n in ast.walk(fn):
+                    if isinstance(n, ast.Attribute) \
+                            and n.attr == "_wc" \
+                            and isinstance(n.value, ast.Name) \
+                            and n.value.id == "self" \
+                            and isinstance(n.ctx, ast.Store):
+                        owns_wc = True
+        if not owns_wc:
+            continue
+        for fn in cls.body:
+            if not isinstance(fn, (ast.FunctionDef,
+                                   ast.AsyncFunctionDef)) \
+                    or fn.name == "__init__":
+                continue
+            for n in ast.walk(fn):
+                if not (isinstance(n, ast.Call)
+                        and isinstance(n.func, ast.Attribute)
+                        and n.func.attr == "append"):
+                    continue
+                tgt = _dotted_through_subscripts(n.func.value)
+                if tgt is not None and tgt.startswith("self._q"):
+                    out.append(Finding(
+                        rule="combiner-enqueue-unsafe", path=path,
+                        line=n.lineno,
+                        message=f"{fn.name}() appends to {tgt} "
+                                "directly — the pending write queue "
+                                "of a combiner-owning class is "
+                                "multi-producer, and only the MPSC "
+                                "gate (.push) is safe against the "
+                                "committer's drain swap; a bare list "
+                                "append can drop or double-resolve "
+                                "an acked write (docs/SERVING.md)"))
     return out
 
 
@@ -1233,6 +1321,7 @@ _ALL_CHECKS = (
     _check_async_blocking,
     _check_metric_names,
     _check_router_bypass,
+    _check_combiner_enqueue,
     _check_collective_fallback,
     _check_ack_before_replicate,
     _check_scale_fence,

@@ -158,14 +158,20 @@ class WriteCombiner:
             self._tombs[k:k + n] = False if tombs is None else tombs
             self._group[k:k + n] = self._groups
             self._k = k + n
+            # Overlay refresh is one bulk dict.update off vectorized
+            # lanes — the per-row Python loop this replaces was the
+            # staging hot path's last O(n)-interpreter cost, visible
+            # once binop frames land whole client batches here.
+            # zip(list, list) keeps insertion order, so the LAST
+            # staged occurrence of a repeated slot wins, same as the
+            # loop it replaces.
             pend = self._pending
             if tombs is None:
-                for s, v in zip(slots.tolist(), values.tolist()):
-                    pend[s] = v
+                pend.update(zip(slots.tolist(), values.tolist()))
             else:
-                for s, v, t in zip(slots.tolist(), values.tolist(),
-                                   tombs.tolist()):
-                    pend[s] = None if t else v
+                vals_obj = values.astype(object)
+                vals_obj[np.asarray(tombs, bool)] = None
+                pend.update(zip(slots.tolist(), vals_obj.tolist()))
         # An EMPTY batch still counts as a group: the unbatched path
         # spends one send per call regardless, so the flush stamps it
         # too — stats.puts and per-call stamp spacing stay uniform.

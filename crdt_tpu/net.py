@@ -471,6 +471,176 @@ def _unpack_split(meta, blob: bytes):
     return cls(*lanes)
 
 
+# --- binary client op lane (docs/WIRE.md) ---
+#
+# The `binop` hello cap replaces per-op framed JSON on the client hot
+# path with fixed little-endian columnar frames: one request carries
+# up to 65535 put/delete/get ops as packed opcode/slot/value rows, one
+# reply answers every op with a status byte (plus an optional value
+# lane and a JSON detail tail for the non-OK minority). Both sides of
+# the wire — the serve tier's decoder and every client encoder — live
+# here so there is exactly ONE framing stack, same as the peer wire.
+#
+# Request body (after the usual 4-byte frame header + codec tag):
+#   <BBHI  magic=0xB1, version=1, n_ops, epoch+1 (0 = no epoch)
+#   u8[n]  opcodes (0=put, 1=delete, 2=get)
+#   <u4[n] slots
+#   <i8[n] values (ignored for delete/get rows)
+# Reply body:
+#   <BBHI  magic=0xB2, flags (bit0 = value lane), n_ops, detail_len
+#   u8[n]  statuses (0=OK, 1=OK_NULL, 2=WRITE_REJECTED, 3=BUSY,
+#          4=MOVED)
+#   <i8[n] values, present iff flags bit0 (get replies; 0 elsewhere)
+#   bytes  detail_len of JSON: a list of dicts carrying the non-OK
+#          minority's codes/errors ("i" = op index; entries without
+#          "i" apply frame-wide, e.g. a busy tick)
+#
+# A JSON op frame starts with '{' (0x7B) and a binop frame with 0xB1,
+# so a negotiated session dispatches on the first body byte with no
+# ambiguity. Malformed FRAMES (bad magic/version/size/opcode) raise
+# ValueError — a protocol violation that hangs up the session, like
+# any other framing fault; a bad op INSIDE a well-formed frame is a
+# per-op status, never a hangup and never the batch's problem.
+
+BINOP_MAGIC = 0xB1
+BINOP_REPLY_MAGIC = 0xB2
+BINOP_VERSION = 1
+BINOP_MAX_OPS = 0xFFFF
+BINOP_PUT, BINOP_DELETE, BINOP_GET = 0, 1, 2
+(BINOP_ST_OK, BINOP_ST_OK_NULL, BINOP_ST_REJECTED,
+ BINOP_ST_BUSY, BINOP_ST_MOVED) = range(5)
+_BINOP_HEAD = struct.Struct("<BBHI")
+_BINOP_REPLY_HEAD = struct.Struct("<BBHI")
+_BINOP_ROW_BYTES = 1 + 4 + 8
+
+
+def encode_binop_request(opcodes, slots, values,
+                         epoch: Optional[int] = None) -> list:
+    """Buffer pieces for one binary op frame, ready for
+    `send_bytes_frame`/`frame_pieces` — the columnar lanes are handed
+    to the transport as memoryviews, never concatenated."""
+    import numpy as np
+    ops = np.ascontiguousarray(opcodes, np.uint8)
+    sl = np.ascontiguousarray(slots, np.uint32)
+    va = np.ascontiguousarray(values, np.int64)
+    n = len(ops)
+    if not 1 <= n <= BINOP_MAX_OPS:
+        raise ValueError(f"binop batch of {n} ops outside "
+                         f"[1, {BINOP_MAX_OPS}]")
+    if len(sl) != n or len(va) != n:
+        raise ValueError("binop lanes must share one length")
+    if int(ops.max()) > BINOP_GET:
+        raise ValueError("unknown binop opcode")
+    head = _BINOP_HEAD.pack(BINOP_MAGIC, BINOP_VERSION, n,
+                            0 if epoch is None else int(epoch) + 1)
+    return [head, ops.data, sl.data.cast("B"), va.data.cast("B")]
+
+
+def decode_binop_request(body):
+    """Validate + decode one binary op frame into
+    ``(opcodes, slots, values, epoch)``. The lanes are zero-copy
+    `np.frombuffer` views into ``body`` (uint8/uint32/int64) — the
+    serve tier hands the write rows straight to the combiner's
+    columnar staging. Raises ValueError on any structural violation
+    BEFORE touching the replica, exactly like `_unpack_split`."""
+    import numpy as np
+    if len(body) < _BINOP_HEAD.size:
+        raise ValueError("binop frame shorter than its header")
+    magic, version, n, epoch1 = _BINOP_HEAD.unpack_from(body)
+    if magic != BINOP_MAGIC:
+        raise ValueError(f"bad binop magic 0x{magic:02x}")
+    if version != BINOP_VERSION:
+        raise ValueError(f"unsupported binop version {version}")
+    if n < 1:
+        raise ValueError("binop frame with zero ops")
+    want = _BINOP_HEAD.size + n * _BINOP_ROW_BYTES
+    if len(body) != want:
+        raise ValueError(f"binop frame holds {len(body)} bytes; "
+                         f"{n} ops need exactly {want}")
+    off = _BINOP_HEAD.size
+    ops = np.frombuffer(body, np.uint8, count=n, offset=off)
+    off += n
+    slots = np.frombuffer(body, "<u4", count=n, offset=off)
+    off += 4 * n
+    values = np.frombuffer(body, "<i8", count=n, offset=off)
+    if int(ops.max()) > BINOP_GET:
+        raise ValueError("unknown binop opcode")
+    return ops, slots, values, (None if epoch1 == 0 else epoch1 - 1)
+
+
+def encode_binop_reply(status, values=None, details=None) -> list:
+    """Buffer pieces for one binop reply frame. ``values`` (int64 per
+    op) is included iff given; ``details`` is the non-OK minority's
+    JSON tail (empty list/None elides it)."""
+    import numpy as np
+    st = np.ascontiguousarray(status, np.uint8)
+    n = len(st)
+    if not 1 <= n <= BINOP_MAX_OPS:
+        raise ValueError(f"binop reply of {n} ops outside "
+                         f"[1, {BINOP_MAX_OPS}]")
+    det = json.dumps(details).encode() if details else b""
+    flags = 0 if values is None else 1
+    head = _BINOP_REPLY_HEAD.pack(BINOP_REPLY_MAGIC, flags, n,
+                                  len(det))
+    bufs = [head, st.data]
+    if values is not None:
+        va = np.ascontiguousarray(values, np.int64)
+        if len(va) != n:
+            raise ValueError("binop reply lanes must share one length")
+        bufs.append(va.data.cast("B"))
+    if det:
+        bufs.append(det)
+    return bufs
+
+
+def decode_binop_reply(body):
+    """Validate + decode one binop reply into
+    ``(statuses, values_or_None, details)`` — status/value lanes as
+    zero-copy views, details as the parsed JSON tail (always a
+    list)."""
+    import numpy as np
+    if len(body) < _BINOP_REPLY_HEAD.size:
+        raise ValueError("binop reply shorter than its header")
+    magic, flags, n, det_len = _BINOP_REPLY_HEAD.unpack_from(body)
+    if magic != BINOP_REPLY_MAGIC:
+        raise ValueError(f"bad binop reply magic 0x{magic:02x}")
+    if n < 1:
+        raise ValueError("binop reply with zero ops")
+    want = (_BINOP_REPLY_HEAD.size + n
+            + (8 * n if flags & 1 else 0) + det_len)
+    if len(body) != want:
+        raise ValueError(f"binop reply holds {len(body)} bytes; "
+                         f"header describes {want}")
+    off = _BINOP_REPLY_HEAD.size
+    status = np.frombuffer(body, np.uint8, count=n, offset=off)
+    off += n
+    values = None
+    if flags & 1:
+        values = np.frombuffer(body, "<i8", count=n, offset=off)
+        off += 8 * n
+    details = json.loads(body[off:]) if det_len else []
+    if not isinstance(details, list):
+        raise ValueError("binop reply details must be a list")
+    return status, values, details
+
+
+def binop_round(sock: socket.socket, opcodes, slots, values,
+                epoch: Optional[int] = None,
+                deadline: Optional[float] = None,
+                tally: Optional[WireTally] = None,
+                codec: Optional[FrameCodec] = None):
+    """One batched binary round over a negotiated socket: N ops out,
+    N statuses back in a single frame each way — the client half of
+    the lane a serve tier advertises with the ``binop`` hello cap."""
+    send_bytes_frame(sock, encode_binop_request(opcodes, slots,
+                                                values, epoch),
+                     tally, codec)
+    body = recv_bytes_frame(sock, deadline, tally, codec)
+    if body is None:
+        raise SyncTransportError("peer closed during binop round")
+    return decode_binop_reply(body)
+
+
 class SyncServer:
     """Serve a replica's merge/delta surface over TCP.
 

@@ -183,6 +183,22 @@ class RoutingTable:
                 f"slot {slot} outside keyspace [0, {self.n_slots})")
         return self.ranges[bisect_right(self._los, slot) - 1][2]
 
+    def owned_mask(self, slots, addr: str):
+        """Vectorized ownership test for a batch of slots (the binary
+        op lane's admission path): one ``searchsorted`` over the range
+        starts against a precomputed per-range owner mask, O(k log r)
+        for a k-op batch instead of k Python-level ``owner_of`` calls.
+        Callers bound the slots to ``[0, n_slots)`` first (the serve
+        tier's per-op slot guard runs before routing)."""
+        import numpy as np
+        slots = np.asarray(slots)
+        idx = np.searchsorted(np.asarray(self._los, np.int64),
+                              slots.astype(np.int64, copy=False),
+                              side="right") - 1
+        owned = np.fromiter((o == addr for _, _, o in self.ranges),
+                            bool, count=len(self.ranges))
+        return owned[idx]
+
     def owners(self) -> Tuple[str, ...]:
         """Distinct owners in first-range order."""
         return tuple(dict.fromkeys(o for _, _, o in self.ranges))
@@ -369,3 +385,24 @@ class PartitionRouter:
                 "epoch": table.epoch,
                 "error": (f"slot {slot} owned by {owner} at routing "
                           f"epoch {table.epoch}")}
+
+    def check_batch(self, slots, client_epoch: Optional[int],
+                    fed_ok: bool):
+        """Vectorized admission for one binary op batch: ``None`` when
+        EVERY op may enqueue locally (the hot all-owned path costs one
+        searchsorted), else a bool admit-mask — the serve loop settles
+        each refused op individually through `check`, so the
+        moved/stale-epoch/proxy taxonomy stays in one place. A stale
+        ``client_epoch`` refuses the whole batch (one epoch stamps the
+        frame), same as the per-op rule."""
+        table = self.table
+        if table is None or self.addr is None:
+            return None          # unbound: single-tier mode, no gate
+        if client_epoch is not None \
+                and int(client_epoch) != table.epoch:
+            import numpy as np
+            return np.zeros(len(slots), bool)
+        mask = table.owned_mask(slots, self.addr)
+        if mask.all():
+            return None
+        return mask

@@ -55,6 +55,7 @@ from __future__ import annotations
 
 import asyncio
 import json
+import socket
 import struct
 import threading
 import time
@@ -65,9 +66,19 @@ import numpy as np
 
 from .analysis.concurrency import make_lock
 from .hlc import Hlc
-from .net import (MAX_FRAME_BYTES, FrameCodec, WireTally,
-                  _flat_views, _pack_for_peer, _pack_split,
-                  _recv_span, _unpack_split)
+from .net import (BINOP_DELETE, BINOP_GET, BINOP_PUT, BINOP_ST_BUSY,
+                  BINOP_ST_MOVED, BINOP_ST_OK, BINOP_ST_OK_NULL,
+                  BINOP_ST_REJECTED, MAX_FRAME_BYTES, FrameCodec,
+                  WireTally, _flat_views, _pack_for_peer, _pack_split,
+                  _recv_span, _unpack_split, decode_binop_request,
+                  encode_binop_reply)
+
+# First body byte of a binary op frame (docs/WIRE.md): a negotiated
+# session dispatches on it — JSON ops start with '{' (0x7b), so the
+# two dialects share one read loop with no ambiguity.
+_BINOP_REQ_TAG = b"\xb1"
+_BINOP_OP_NAMES = {BINOP_PUT: "put", BINOP_DELETE: "delete",
+                   BINOP_GET: "get"}
 
 
 # --- async framing (the length-prefixed wire of net.py, loop-side) ---
@@ -203,6 +214,109 @@ class _OwnerProxy:
                 pass
 
 
+def _resolve_ack(fut: "asyncio.Future", outcome: Any) -> None:
+    """Resolve one write-ack future ON ITS OWNING LOOP — the callback
+    the committer hands to `call_soon_threadsafe` for writes enqueued
+    by another accept loop. Resolved via set_result, never
+    set_exception, so a session torn down mid-ack leaves no
+    unretrieved exception behind."""
+    if not fut.done():
+        fut.set_result(outcome)
+
+
+class _MpscStripe:
+    """One mutex lane of the MPSC write queue. The stripe lock is a
+    LEAF by construction: it wraps exactly a list append or a list
+    swap, never a replica touch, a frame write, or another lock — so
+    an accept loop's enqueue can never wait behind device work."""
+
+    # Checked by analysis/concurrency.py: rank 46 sits above every
+    # control-plane lock and is never held while acquiring anything.
+    _CRDTLINT_LOCK_ORDER = ("_lock",)
+
+    __slots__ = ("_lock", "items")
+
+    def __init__(self) -> None:
+        self._lock = make_lock("MpscStripe._lock", 46)
+        self.items: list = []
+
+    def push(self, entry) -> None:
+        with self._lock:
+            self.items.append(entry)
+
+    def swap(self) -> list:
+        with self._lock:
+            out, self.items = self.items, []
+        return out
+
+
+class MpscQueue:
+    """Multi-producer single-consumer staging queue for the write
+    path: every accept loop enqueues through `push` (the MPSC gate the
+    crdtlint ``combiner-enqueue-unsafe`` rule holds combiner-owning
+    classes to) and ONLY the committer loop drains. Enqueues stripe by
+    producer thread id, so loops contend on disjoint mutexes; `drain`
+    swaps each stripe's list under its own lock, one at a time — two
+    stripe locks are never held together. Per-producer FIFO order is
+    preserved (one thread always lands on one stripe); cross-producer
+    order is whatever the tick observes, exactly as with concurrent
+    appends to a single list."""
+
+    __slots__ = ("_stripes", "_mask")
+
+    def __init__(self, stripes: int = 8) -> None:
+        n = 1
+        while n < stripes:
+            n *= 2
+        self._mask = n - 1
+        self._stripes = tuple(_MpscStripe() for _ in range(n))
+
+    def push(self, entry) -> None:
+        self._stripes[threading.get_ident() & self._mask].push(entry)
+
+    def drain(self) -> list:
+        out: list = []
+        for stripe in self._stripes:
+            if stripe.items:
+                out.extend(stripe.swap())
+        return out
+
+    def __len__(self) -> int:
+        # Torn-free under the GIL: a load signal (queue-depth gauge,
+        # autoscaler pressure), not an invariant.
+        return sum(len(s.items) for s in self._stripes)
+
+
+class _LoopCtx:
+    """Per-accept-loop state for one `ServeTier`. Everything here is
+    confined to its OWN event loop thread — sessions, writers, watch
+    index, proxy pool — so N loops share nothing hot; cross-loop
+    traffic is exactly two seams: the MPSC write queue in, and
+    `call_soon_threadsafe` ack/fan-out hops out. ``index`` 0 is the
+    committer: it owns the ingest window, the flusher task and the
+    tier's public port."""
+
+    __slots__ = ("index", "loop", "stop_event", "started", "error",
+                 "thread", "sessions", "writers", "watch",
+                 "watch_codec", "watch_mark", "proxies", "fanout_busy")
+
+    def __init__(self, index: int) -> None:
+        from .watch import WatchIndex
+        self.index = index
+        self.loop: Optional[asyncio.AbstractEventLoop] = None
+        self.stop_event: Optional[asyncio.Event] = None
+        self.started = threading.Event()
+        self.error: Optional[BaseException] = None
+        self.thread: Optional[threading.Thread] = None
+        self.sessions = 0
+        self.writers: set = set()
+        self.watch = WatchIndex()
+        self.watch_codec: dict = {}
+        self.watch_mark: Optional[Hlc] = None
+        self.proxies: dict = {}
+        self.fanout_busy = False
+
+
 class ServeTier:
     """Serve one replica to thousands of concurrent client sessions.
 
@@ -249,8 +363,18 @@ class ServeTier:
                  key_encoder=None, value_encoder=None,
                  key_decoder=None, value_decoder=None,
                  lock: Optional[threading.RLock] = None,
-                 router=None):
+                 router=None, loops: int = 1):
         self.crdt = crdt
+        # Multi-loop serving (docs/SERVING.md): `loops` accept loops
+        # share ONE listening port via SO_REUSEPORT, each with its own
+        # event loop thread and loop-confined session state; writes
+        # from every loop funnel through the MPSC queue into loop 0's
+        # flusher, so the one-stamp/one-scatter-per-tick invariant is
+        # unchanged however many loops accept. Platforms without
+        # SO_REUSEPORT fall back to one loop, COUNTED on the
+        # crdt_tpu_serve_loops gauge — never a silent downscale.
+        self.loops = max(1, int(loops))
+        self.loops_effective: Optional[int] = None
         self.lock = lock if lock is not None \
             else make_lock("ServeTier.lock", 40, rlock=True)
         # Federation: an attached `PartitionRouter` (routing.py) makes
@@ -305,7 +429,13 @@ class ServeTier:
             "requests shed for backpressure (admission watermark or "
             "cold-join lane bound)")
         self._m_ops = reg.counter(
-            "crdt_tpu_serve_ops_total", "serve-tier ops by kind")
+            "crdt_tpu_serve_ops_total",
+            "serve-tier ops by kind (client ops carry lane=json|bin)")
+        self._m_loops = reg.gauge(
+            "crdt_tpu_serve_loops",
+            "accept loops sharing this tier's port (SO_REUSEPORT "
+            "multi-loop serving; 1 = single loop, incl. the "
+            "no-SO_REUSEPORT fallback)")
         self._m_flush = reg.histogram(
             "crdt_tpu_serve_flush_seconds",
             "combiner flush wall time under the serving tier, by "
@@ -323,6 +453,14 @@ class ServeTier:
             "crdt_tpu_serve_ack_seconds_sketch",
             "write enqueue-to-ack latency, relative-error quantile "
             "sketch")
+        # Per-lane twin of the ack sketch: the json|bin split lives on
+        # its own instrument so the unlabeled series above keeps its
+        # exact label key — evaluate_slo and the bench quantile reads
+        # match label sets exactly, and a new label would orphan them.
+        self._m_ack_lane_sketch = reg.sketch(
+            "crdt_tpu_serve_ack_lane_seconds_sketch",
+            "write ack latency by client lane (json per-op vs bin "
+            "batched frame), relative-error quantile sketch")
         self._m_ack_phase = reg.histogram(
             "crdt_tpu_serve_ack_phase_seconds",
             "write-ack latency decomposed by phase: queue_wait (enqueue "
@@ -347,27 +485,18 @@ class ServeTier:
             "crdt_tpu_serve_watch_fanout_total",
             "watch event frames fanned out at flush ticks")
 
-        # Loop-confined state (touched only from the tier's event
-        # loop, so no lock): the pending write queue, live sessions,
-        # shed/drop counters, the cold-lane occupancy.
-        self._q: List[Tuple[int, int, bool, Any, float]] = []
-        self._writers: set = set()
-        self._sessions = 0
+        # The pending write queue: multi-producer (every accept loop
+        # pushes), single-consumer (loop 0's flusher drains). Session
+        # state, watch indexes and proxy pools live per-loop in
+        # `_LoopCtx`; the counters below are plain ints bumped from
+        # any loop — load signals with GIL-granular (not transactional)
+        # accuracy, exact whenever one loop serves (every test).
+        self._q = MpscQueue()
         self.shed_count = 0
         self.dropped_sessions = 0
         self.idle_closed_sessions = 0
-        self._cold_inflight = 0
-        # Watch fan-out state: slot-interest index + per-watcher codec
-        # (both loop-confined); the pack watermark `_watch_mark` is
-        # shared with executor threads and only touched under `lock`.
-        from .watch import WatchIndex
-        self._watch = WatchIndex()
-        self._watch_codec: dict = {}
-        self._watch_mark: Optional[Hlc] = None
         self.watch_shed_sessions = 0
-        # Upstream connections for the proxy fallback, keyed by owner
-        # address (loop-confined).
-        self._proxies: dict = {}
+        self._cold_inflight = 0
 
         # One replica executor serializes every warm-path replica
         # touch; the cold lane gets its own single worker so a digest
@@ -377,46 +506,101 @@ class ServeTier:
         self._cold_pool = ThreadPoolExecutor(
             max_workers=1, thread_name_prefix="serve-cold")
 
-        self._thread: Optional[threading.Thread] = None
+        self._ctxs: List[_LoopCtx] = []
         self._loop: Optional[asyncio.AbstractEventLoop] = None
         self._stop_event: Optional[asyncio.Event] = None
-        self._started = threading.Event()
-        self._startup_error: Optional[BaseException] = None
         self._ingest_cm = None
         self._wc = None
 
     # --- lifecycle ---
 
+    @property
+    def _thread(self) -> Optional[threading.Thread]:
+        # Liveness probe kept under the pre-multi-loop name (the
+        # rehome/stop guards read it): the committer loop's thread.
+        return self._ctxs[0].thread if self._ctxs else None
+
+    def _effective_loops(self) -> int:
+        """Feature-detect SO_REUSEPORT at bind time: the constant must
+        exist AND the kernel must accept it (WSL/macOS quirks), else
+        the tier serves on one loop — counted on the loop gauge, never
+        a silent downscale."""
+        want = self.loops
+        if want <= 1:
+            return 1
+        if not hasattr(socket, "SO_REUSEPORT"):
+            return 1
+        probe = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        probe.settimeout(1.0)   # never does I/O; bound for hygiene
+        try:
+            probe.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEPORT, 1)
+        except OSError:
+            return 1
+        finally:
+            probe.close()
+        return want
+
     def start(self) -> "ServeTier":
-        self._thread = threading.Thread(target=self._run, daemon=True,
-                                        name="serve-tier-loop")
-        self._thread.start()
-        self._started.wait(timeout=60)
-        if self._startup_error is not None:
-            err, self._startup_error = self._startup_error, None
-            self._thread.join(timeout=5)
-            self._thread = None
+        eff = self._effective_loops()
+        self._ctxs = [_LoopCtx(i) for i in range(eff)]
+        self.loops_effective = eff
+        self._m_loops.set(eff, node=self._node)
+        ctx0 = self._ctxs[0]
+        ctx0.thread = threading.Thread(
+            target=self._run, args=(ctx0,), daemon=True,
+            name="serve-tier-loop")
+        ctx0.thread.start()
+        ctx0.started.wait(timeout=60)
+        if ctx0.error is not None:
+            err, ctx0.error = ctx0.error, None
+            ctx0.thread.join(timeout=5)
+            self._ctxs = []
             raise err
         if self.port is None:
+            self._ctxs = []
             raise RuntimeError("serving tier failed to start in time")
+        # Secondary accept loops bind the CONCRETE port loop 0 got
+        # (which may have been ephemeral), so they start second.
+        for ctx in self._ctxs[1:]:
+            ctx.thread = threading.Thread(
+                target=self._run, args=(ctx,), daemon=True,
+                name=f"serve-tier-loop-{ctx.index}")
+            ctx.thread.start()
+        failed: Optional[BaseException] = None
+        for ctx in self._ctxs[1:]:
+            ctx.started.wait(timeout=60)
+            if ctx.error is not None and failed is None:
+                failed = ctx.error
+        if failed is not None:
+            self.stop()
+            raise failed
         return self
 
+    def _signal_stop(self) -> None:
+        for ctx in self._ctxs:
+            loop, ev = ctx.loop, ctx.stop_event
+            if loop is not None and ev is not None:
+                try:
+                    loop.call_soon_threadsafe(ev.set)
+                except RuntimeError:
+                    pass
+
     def stop(self) -> None:
-        thread = self._thread
-        if thread is None:
+        if not self._ctxs or self._ctxs[0].thread is None:
             return
-        loop, ev = self._loop, self._stop_event
-        if loop is not None and ev is not None:
-            try:
-                loop.call_soon_threadsafe(ev.set)
-            except RuntimeError:
-                pass
-        thread.join(timeout=60)
-        if thread.is_alive():
-            raise RuntimeError(
-                "serving tier loop failed to stop; the replica may "
-                "still be accessed — do not reuse it")
-        self._thread = None
+        # Every loop tears down concurrently: the committer's final
+        # flush tick resolves cross-loop acks while the other loops
+        # are still draining their sessions, so no ack is stranded.
+        self._signal_stop()
+        for ctx in reversed(self._ctxs):
+            thread, ctx.thread = ctx.thread, None
+            if thread is None:
+                continue
+            thread.join(timeout=60)
+            if thread.is_alive():
+                raise RuntimeError(
+                    "serving tier loop failed to stop; the replica "
+                    "may still be accessed — do not reuse it")
         self._replica_pool.shutdown(wait=True)
         self._cold_pool.shutdown(wait=True)
 
@@ -430,18 +614,14 @@ class ServeTier:
         tests measure. The replica object is left as the crash image —
         a restart must build a FRESH store and catch up via the merkle
         walk, never reuse this one."""
-        thread = self._thread
-        if thread is None:
+        if not self._ctxs or self._ctxs[0].thread is None:
             return
         self.killed = True
-        loop, ev = self._loop, self._stop_event
-        if loop is not None and ev is not None:
-            try:
-                loop.call_soon_threadsafe(ev.set)
-            except RuntimeError:
-                pass
-        thread.join(timeout=60)
-        self._thread = None
+        self._signal_stop()
+        for ctx in self._ctxs:
+            thread, ctx.thread = ctx.thread, None
+            if thread is not None:
+                thread.join(timeout=60)
         self._replica_pool.shutdown(wait=True)
         self._cold_pool.shutdown(wait=True)
 
@@ -451,51 +631,96 @@ class ServeTier:
     def __exit__(self, *exc) -> None:
         self.stop()
 
-    def _run(self) -> None:
+    def _run(self, ctx: _LoopCtx) -> None:
         try:
-            asyncio.run(self._main())
+            asyncio.run(self._main(ctx))
         except BaseException as e:   # pragma: no cover - belt+braces
-            if not self._started.is_set():
-                self._startup_error = e
-                self._started.set()
+            if not ctx.started.is_set():
+                ctx.error = e
+                ctx.started.set()
 
-    async def _main(self) -> None:
-        self._loop = asyncio.get_running_loop()
-        self._stop_event = asyncio.Event()
+    def _reuseport_socket(self, port: int) -> socket.socket:
+        # Sync helper on purpose: bind/setsockopt never block, and
+        # keeping them out of the coroutine keeps the async-blocking
+        # lint focused on calls that actually can.
+        sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         try:
-            self._open_ingest()
+            sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEPORT, 1)
+            # Non-blocking from birth (settimeout(0) IS non-blocking
+            # mode): asyncio owns this socket the moment start_server
+            # adopts it.
+            sock.settimeout(0.0)
+            sock.bind((self.host, port))
+        except BaseException:
+            sock.close()
+            raise
+        return sock
+
+    async def _listen(self, ctx: _LoopCtx) -> asyncio.AbstractServer:
+        def handler(r, w):
+            return self._session(ctx, r, w)
+        if self.loops_effective == 1:
+            return await asyncio.start_server(
+                handler, self.host, self._want_port, backlog=2048)
+        # Multi-loop: every loop binds its OWN SO_REUSEPORT socket so
+        # the kernel load-balances accepts across them. Loop 0 binds
+        # the requested port; the others bind whatever concrete port
+        # loop 0 was actually given.
+        port = self._want_port if ctx.index == 0 else self.port
+        sock = self._reuseport_socket(port)
+        try:
+            return await asyncio.start_server(
+                handler, sock=sock, backlog=2048)
+        except BaseException:
+            sock.close()
+            raise
+
+    async def _main(self, ctx: _LoopCtx) -> None:
+        ctx.loop = asyncio.get_running_loop()
+        ctx.stop_event = asyncio.Event()
+        committer = ctx.index == 0
+        if committer:
+            # Committer aliases: the flusher, rehome_watchers and the
+            # stop path address loop 0 through the pre-multi-loop
+            # names.
+            self._loop = ctx.loop
+            self._stop_event = ctx.stop_event
+            try:
+                self._open_ingest()
+            except BaseException as e:
+                ctx.error = e
+                ctx.started.set()
+                return
+        try:
+            server = await self._listen(ctx)
         except BaseException as e:
-            self._startup_error = e
-            self._started.set()
+            ctx.error = e
+            if committer:
+                self._close_ingest()
+            ctx.started.set()
             return
+        flusher = None
+        if committer:
+            self.port = server.sockets[0].getsockname()[1]
+            flusher = asyncio.ensure_future(self._flusher())
+        ctx.started.set()
         try:
-            server = await asyncio.start_server(
-                self._session, self.host, self._want_port,
-                backlog=2048)
-        except BaseException as e:
-            self._startup_error = e
-            self._close_ingest()
-            self._started.set()
-            return
-        self.port = server.sockets[0].getsockname()[1]
-        flusher = asyncio.ensure_future(self._flusher())
-        self._started.set()
-        try:
-            await self._stop_event.wait()
+            await ctx.stop_event.wait()
         finally:
             server.close()
             await server.wait_closed()
-            flusher.cancel()
-            try:
-                await flusher
-            except asyncio.CancelledError:
-                pass
+            if flusher is not None:
+                flusher.cancel()
+                try:
+                    await flusher
+                except asyncio.CancelledError:
+                    pass
             if self.killed:
                 # Crash fidelity (`kill()`): drop the queue unacked,
                 # RST every transport, leave the ingest window where
                 # the crash left it. Pending sessions are cancelled
                 # when asyncio.run tears the loop down.
-                for w in list(self._writers):
+                for w in list(ctx.writers):
                     transport = w.transport
                     if transport is not None:
                         try:
@@ -503,23 +728,28 @@ class ServeTier:
                         except Exception:
                             pass
             else:
-                # Resolve every queued ack, give the sessions one loop
+                # Resolve every queued ack (the committer's final tick
+                # also resolves writes the OTHER loops enqueued — they
+                # are still draining their sessions because stop()
+                # joins the committer last), give the sessions one loop
                 # breath to write their replies, then cut the
                 # transports.
-                await self._flush_tick()
+                if committer:
+                    await self._flush_tick()
                 await asyncio.sleep(0)
-                for proxy in self._proxies.values():
+                for proxy in ctx.proxies.values():
                     await proxy.close()
-                self._proxies.clear()
-                for w in list(self._writers):
+                ctx.proxies.clear()
+                for w in list(ctx.writers):
                     try:
                         w.close()
                     except Exception:
                         pass
-                deadline = self._loop.time() + 5.0
-                while self._sessions and self._loop.time() < deadline:
+                deadline = ctx.loop.time() + 5.0
+                while ctx.sessions and ctx.loop.time() < deadline:
                     await asyncio.sleep(0.01)
-                self._close_ingest()
+                if committer:
+                    self._close_ingest()
 
     def _open_ingest(self) -> None:
         with self.lock:
@@ -561,16 +791,22 @@ class ServeTier:
                 continue
 
     async def _flush_tick(self) -> None:
-        if not self._q:
-            self._m_depth.set(0, node=self._node)
+        entries = self._q.drain()
+        self._m_depth.set(0, node=self._node)
+        if not entries:
             # Quiet ticks still fan out: merges (push_packed from a
             # migration, gossip) advance the store without touching
             # this tier's write queue, and watchers must see them.
-            await self._fanout_tick()
+            await self._fanout_all()
             return
-        q, self._q = self._q, []
-        self._m_depth.set(0, node=self._node)
-        n = len(q)
+        # Two entry shapes share the queue — JSON per-op writes
+        # ("j", slot, value, tomb, fut, t0, loop) and binop batch
+        # frames ("b", slots, vals, tombs, fut, t0, decode_s, loop).
+        # Both carry fut at [4], t0 at [5] and the OWNING loop last.
+        jq = [e for e in entries if e[0] == "j"]
+        bq = [e for e in entries if e[0] == "b"]
+        nj = len(jq)
+        writes = nj + sum(len(e[1]) for e in bq)
         tick_t = time.perf_counter()
         phases: dict = {}
         # Write concern (docs/REPLICATION.md): a primary may resolve
@@ -585,11 +821,13 @@ class ServeTier:
         # its ack, when it finally lands, is backed by the group).
         rep = self.replicator
         try:
-            slots = np.fromiter((e[0] for e in q), np.int64, count=n)
-            vals = np.fromiter((e[1] for e in q), np.int64, count=n)
-            tombs = np.fromiter((e[2] for e in q), bool, count=n)
+            slots = np.fromiter((e[1] for e in jq), np.int64, count=nj)
+            vals = np.fromiter((e[2] for e in jq), np.int64, count=nj)
+            tombs = np.fromiter((e[3] for e in jq), bool, count=nj)
+            batches = [(e[1], e[2], e[3]) for e in bq]
             phases = await self._loop.run_in_executor(
-                self._replica_pool, self._commit, slots, vals, tombs)
+                self._replica_pool, self._commit, slots, vals, tombs,
+                batches)
             if self._lease_expired():
                 outcome: Any = ("busy", "primary lease expired "
                                         "(fenced; retry)")
@@ -602,7 +840,7 @@ class ServeTier:
                     from .obs.recorder import default_recorder
                     default_recorder().trigger(
                         "lease_fence",
-                        {"node": self._node, "writes_fenced": n})
+                        {"node": self._node, "writes_fenced": writes})
                 except Exception:
                     pass
             elif rep is not None:
@@ -624,19 +862,44 @@ class ServeTier:
         # timers don't cover (queue drain, executor hop, ack fan-out).
         # Per-write observation keeps sum(phase sums) comparable to
         # the crdt_tpu_serve_ack_seconds sum. Failed ticks committed
-        # nothing, so nothing is attributed.
+        # nothing, so nothing is attributed. A binop batch is ONE
+        # client-visible ack (one reply frame), so it is one
+        # observation — with its decode+admission cost attributed to
+        # the binary-lane-only `decode` phase.
         stamp = float(phases.get("stamp", 0.0)) if phases else 0.0
         scatter = float(phases.get("scatter", 0.0)) if phases else 0.0
         ack_write = max(0.0, (now - tick_t) - stamp - scatter)
-        for _, _, _, fut, t0 in q:
-            if not fut.done():
-                fut.set_result(outcome)
+        this_loop = self._loop
+        for e in entries:
+            fut, t0, floop = e[4], e[5], e[-1]
+            lane = "json" if e[0] == "j" else "bin"
+            dec = e[6] if e[0] == "b" else 0.0
+            if floop is this_loop:
+                if not fut.done():
+                    fut.set_result(outcome)
+            else:
+                # The write was enqueued by another accept loop: its
+                # future must resolve THERE (futures are not
+                # thread-safe). A loop mid-teardown just drops the ack
+                # — its sessions are gone anyway.
+                try:
+                    floop.call_soon_threadsafe(_resolve_ack, fut,
+                                               outcome)
+                except RuntimeError:
+                    pass
             self._m_ack.observe(now - t0, node=self._node)
             self._m_ack_sketch.observe(now - t0, node=self._node)
+            self._m_ack_lane_sketch.observe(now - t0, lane=lane,
+                                            node=self._node)
             if outcome is True:
+                if dec > 0.0:
+                    self._m_ack_phase.observe(
+                        dec, phase="decode", node=self._node)
+                    self._m_ack_phase_sketch.observe(
+                        dec, phase="decode", node=self._node)
+                queue_wait = max(0.0, tick_t - t0 - dec)
                 self._m_ack_phase.observe(
-                    max(0.0, tick_t - t0), phase="queue_wait",
-                    node=self._node)
+                    queue_wait, phase="queue_wait", node=self._node)
                 self._m_ack_phase.observe(stamp, phase="stamp",
                                           node=self._node)
                 self._m_ack_phase.observe(scatter, phase="scatter",
@@ -644,21 +907,27 @@ class ServeTier:
                 self._m_ack_phase.observe(ack_write, phase="ack_write",
                                           node=self._node)
                 self._m_ack_phase_sketch.observe(
-                    max(0.0, tick_t - t0), phase="queue_wait",
-                    node=self._node)
+                    queue_wait, phase="queue_wait", node=self._node)
                 self._m_ack_phase_sketch.observe(
                     stamp, phase="stamp", node=self._node)
                 self._m_ack_phase_sketch.observe(
                     scatter, phase="scatter", node=self._node)
                 self._m_ack_phase_sketch.observe(
                     ack_write, phase="ack_write", node=self._node)
-        await self._fanout_tick()
+        await self._fanout_all()
 
     def _commit(self, slots: np.ndarray, vals: np.ndarray,
-                tombs: np.ndarray) -> dict:
+                tombs: np.ndarray, batches: list) -> dict:
         with self.lock:
             wc = self._wc
-            self.crdt.put_batch(slots, vals, tombs)
+            if len(slots):
+                self.crdt.put_batch(slots, vals, tombs)
+            # Each binop frame stages as its own stamp group — its
+            # wire views land straight in the combiner's columnar
+            # staging — but the tick still ends in ONE send_batch and
+            # ONE ingest_scatter (the dispatch-ledger invariant).
+            for bs, bv, bt in batches:
+                self.crdt.put_batch(bs, bv, bt)
             if wc is not None:
                 wc.flush("tick")
                 return dict(wc.last_phase_seconds)
@@ -667,18 +936,54 @@ class ServeTier:
     # --- watch fan-out: one pack per flush tick, pushed to every
     # watcher of a touched slot (docs/FEDERATION.md) ---
 
-    async def _fanout_tick(self) -> None:
-        if self._watch.empty:
+    async def _fanout_all(self) -> None:
+        """Fan out the tick to every loop's watchers. The committer's
+        own watchers are pushed inline (awaited — the single-loop path
+        keeps its exact pre-multi-loop ordering); other loops get a
+        `call_soon_threadsafe` nudge that packs-and-pushes on THEIR
+        thread, because watch writers are loop-confined. A loop whose
+        previous fan-out is still in flight is skipped this tick —
+        watch delivery is at-least-once off a watermark, so the next
+        tick covers the gap."""
+        await self._fanout_tick(self._ctxs[0])
+        for ctx in self._ctxs[1:]:
+            if ctx.watch.empty or ctx.fanout_busy:
+                continue
+            if ctx.loop is None or ctx.stop_event is None \
+                    or ctx.stop_event.is_set():
+                continue
+            try:
+                ctx.loop.call_soon_threadsafe(self._spawn_fanout, ctx)
+            except RuntimeError:
+                pass
+
+    def _spawn_fanout(self, ctx: _LoopCtx) -> None:
+        # Runs ON ctx's loop. The busy flag is flipped here (not at
+        # the committer) so it is only ever touched from ctx's thread
+        # once set, and from the committer only as a skip hint.
+        if ctx.fanout_busy:
+            return
+        ctx.fanout_busy = True
+        task = asyncio.ensure_future(self._fanout_tick(ctx))
+
+        def _done(t: "asyncio.Future") -> None:
+            ctx.fanout_busy = False
+            t.exception()   # a pack failure must never go unretrieved
+
+        task.add_done_callback(_done)
+
+    async def _fanout_tick(self, ctx: _LoopCtx) -> None:
+        if ctx.watch.empty:
             return
         try:
-            out = await self._loop.run_in_executor(
-                self._replica_pool, self._watch_pack)
+            out = await ctx.loop.run_in_executor(
+                self._replica_pool, self._watch_pack, ctx)
         except Exception:
             return   # a pack failure must never kill the flusher
         if out is None:
             return
         meta_msg, bufs, touched = out
-        targets = self._watch.touched(touched)
+        targets = ctx.watch.touched(touched)
         if not targets:
             return
         # Frame pieces are built ONCE per codec flavor (raw vs zlib)
@@ -688,7 +993,7 @@ class ServeTier:
         flavors: dict = {}
         meta_raw = [json.dumps(meta_msg).encode()]
         for w in list(targets):
-            codec = self._watch_codec.get(w)
+            codec = ctx.watch_codec.get(w)
             key = codec is not None and codec.compress
             cached = flavors.get(key)
             if cached is None:
@@ -707,7 +1012,7 @@ class ServeTier:
                 # letting its transport buffer grow without bound.
                 self.watch_shed_sessions += 1
                 self._m_shed.inc(lane="watch", node=self._node)
-                self._drop_watcher(w)
+                self._drop_watcher(ctx, w)
                 try:
                     w.close()
                 except Exception:
@@ -717,15 +1022,16 @@ class ServeTier:
                 w.writelines(head)
                 w.writelines(body)
             except (ConnectionError, OSError):
-                self._drop_watcher(w)
+                self._drop_watcher(ctx, w)
                 continue
             self.tally.sent += nbytes
             self._m_fanout.inc(node=self._node)
 
-    def _drop_watcher(self, writer) -> None:
-        self._watch.remove(writer)
-        self._watch_codec.pop(writer, None)
-        self._m_watchers.set(len(self._watch), node=self._node)
+    def _drop_watcher(self, ctx: _LoopCtx, writer) -> None:
+        ctx.watch.remove(writer)
+        ctx.watch_codec.pop(writer, None)
+        self._m_watchers.set(sum(len(c.watch) for c in self._ctxs),
+                             node=self._node)
 
     def rearm_watch(self, mark) -> None:
         """Rewind the watch pack watermark to ``mark`` (keeping the
@@ -739,9 +1045,10 @@ class ServeTier:
         existing watchers — watch delivery is at-least-once and the
         rows are idempotent lattice states, so re-applying is safe."""
         with self.lock:
-            cur = self._watch_mark
-            if cur is not None and (mark is None or mark < cur):
-                self._watch_mark = mark
+            for ctx in self._ctxs:
+                cur = ctx.watch_mark
+                if cur is not None and (mark is None or mark < cur):
+                    ctx.watch_mark = mark
 
     def rehome_watchers(self, owner: str, epoch: int,
                         since: Optional[str] = None,
@@ -752,39 +1059,43 @@ class ServeTier:
         the calling control thread until the frames are flushed, so
         the tier stop that follows cannot RST them off the wire.
         Returns the number of sessions re-homed."""
-        loop = self._loop
-        if loop is None or self._thread is None or self.killed:
+        if self._loop is None or self._thread is None or self.killed:
             return 0
+        msg = {"op": "moved", "ok": False, "code": "moved",
+               "owner": owner, "epoch": int(epoch),
+               "error": (f"partition merged into {owner} at "
+                         f"routing epoch {epoch}")}
+        if since is not None:
+            # Resume mark: the merge's flip watermark. The client
+            # resubscribes with it so the recipient re-packs from
+            # there regardless of interleaved fan-out ticks.
+            msg["since"] = str(since)
+        raw = [json.dumps(msg).encode()]
 
-        async def _push() -> int:
-            msg = {"op": "moved", "ok": False, "code": "moved",
-                   "owner": owner, "epoch": int(epoch),
-                   "error": (f"partition merged into {owner} at "
-                             f"routing epoch {epoch}")}
-            if since is not None:
-                # Resume mark: the merge's flip watermark. The client
-                # resubscribes with it so the recipient re-packs from
-                # there regardless of interleaved fan-out ticks.
-                msg["since"] = str(since)
-            raw = [json.dumps(msg).encode()]
+        async def _push(ctx: _LoopCtx) -> int:
             moved = 0
-            for w in list(self._watch.watchers()):
-                codec = self._watch_codec.get(w)
+            for w in list(ctx.watch.watchers()):
+                codec = ctx.watch_codec.get(w)
                 try:
                     w.writelines(frame_pieces(raw, codec))
                     await w.drain()
                 except (ConnectionError, OSError):
                     pass
-                self._drop_watcher(w)
+                self._drop_watcher(ctx, w)
                 moved += 1
             return moved
 
-        fut = asyncio.run_coroutine_threadsafe(_push(), loop)
-        try:
-            return fut.result(timeout)
-        except (TimeoutError, RuntimeError, OSError):
-            fut.cancel()
-            return 0
+        total = 0
+        for ctx in self._ctxs:
+            if ctx.loop is None or ctx.thread is None:
+                continue
+            fut = asyncio.run_coroutine_threadsafe(_push(ctx),
+                                                   ctx.loop)
+            try:
+                total += fut.result(timeout)
+            except (TimeoutError, RuntimeError, OSError):
+                fut.cancel()
+        return total
 
     def partition_info(self) -> Optional[dict]:
         """Per-partition load/ownership roll-up for the fleet poller
@@ -814,7 +1125,8 @@ class ServeTier:
             info["last_scale"] = dict(self.last_scale)
         return info
 
-    def _watch_arm(self, since: Optional[str] = None) -> str:
+    def _watch_arm(self, ctx: _LoopCtx,
+                   since: Optional[str] = None) -> str:
         """Register-time replica touch: the head stamp the reply
         reports, also seeding the pack watermark so event streams
         start at subscription time, not store birth. A ``since``
@@ -837,14 +1149,14 @@ class ServeTier:
             # mark may seed it directly — a re-homed subscription
             # must start at the flip watermark, not at head, or the
             # commits it is resuming across are silently skipped.
-            if mark is not None and (self._watch_mark is None
-                                     or mark < self._watch_mark):
-                self._watch_mark = mark
-            if self._watch_mark is None:
-                self._watch_mark = head
+            if mark is not None and (ctx.watch_mark is None
+                                     or mark < ctx.watch_mark):
+                ctx.watch_mark = mark
+            if ctx.watch_mark is None:
+                ctx.watch_mark = head
         return str(head)
 
-    def _watch_pack(self):
+    def _watch_pack(self, ctx: _LoopCtx):
         """One tick's event pack (executor thread, lock held): every
         row modified at-or-after the watermark, tags included. The
         inclusive bound means a row exactly AT the watermark can ship
@@ -853,12 +1165,12 @@ class ServeTier:
         from .ops.packing import pack_rows
         with self.lock:
             head = self.crdt.canonical_time
-            if self._watch_mark is not None \
-                    and head == self._watch_mark:
+            if ctx.watch_mark is not None \
+                    and head == ctx.watch_mark:
                 return None
-            packed, ids = _pack_for_peer(self.crdt, self._watch_mark,
+            packed, ids = _pack_for_peer(self.crdt, ctx.watch_mark,
                                          True)
-            self._watch_mark = head
+            ctx.watch_mark = head
         if not packed.k:
             return None
         meta, bufs = pack_rows(packed)
@@ -891,6 +1203,12 @@ class ServeTier:
         # "sketches" section on the metrics op; everyone else gets
         # the pre-sketch reply byte-identically (same as SyncServer).
         caps.add("sketch")
+        # Binary client op lane (docs/WIRE.md): serve-tier-only — the
+        # peer wire (`SyncServer`) keeps its packed-lane dialect and
+        # its hello bytes unchanged. Advertised unconditionally: the
+        # lane rides the write combiner the tier always owns. A client
+        # that never offers it gets today's JSON dialect byte-for-byte.
+        caps.add("binop")
         if self.router is not None:
             # Advertised only by routed tiers: a client that agrees
             # gets `moved` redirects; one that never asks is a
@@ -1048,14 +1366,18 @@ class ServeTier:
 
     # --- the session coroutine ---
 
-    async def _session(self, reader: asyncio.StreamReader,
+    async def _session(self, ctx: _LoopCtx,
+                       reader: asyncio.StreamReader,
                        writer: asyncio.StreamWriter) -> None:
-        if self._sessions >= self.max_sessions \
-                or self._stop_event.is_set():
+        seated = sum(c.sessions for c in self._ctxs)
+        if seated >= self.max_sessions or ctx.stop_event.is_set():
             # Admission watermark: refuse with the same pre-hello
             # untagged busy frame SyncServer's accept path uses, so
             # every client generation reads it and backs off
-            # (retryable, never the legacy-downgrade signal).
+            # (retryable, never the legacy-downgrade signal). The
+            # seated count sums per-loop tallies — GIL-granular, so a
+            # racing burst across loops can overshoot by at most one
+            # accept per loop, which the watermark tolerates.
             self.shed_count += 1
             self._m_shed.inc(lane="admission", node=self._node)
             try:
@@ -1069,26 +1391,27 @@ class ServeTier:
                 pass
             await self._hangup(writer)
             return
-        self._sessions += 1
-        self._m_sessions.set(self._sessions, node=self._node)
-        self._writers.add(writer)
+        ctx.sessions += 1
+        self._m_sessions.set(seated + 1, node=self._node)
+        ctx.writers.add(writer)
         try:
-            await self._session_loop(reader, writer)
+            await self._session_loop(ctx, reader, writer)
         except (ConnectionError, OSError, ValueError,
                 json.JSONDecodeError, asyncio.TimeoutError,
                 asyncio.IncompleteReadError):
             # An ADMITTED session torn down by error (vs a clean
             # bye/EOF) counts as dropped — the bench's "zero dropped
             # below the watermark" criterion reads this. Idle expiry
-            # is absorbed as a clean close in _read_op, so the only
-            # TimeoutError reaching here is a mid-op io_timeout (a
-            # genuinely stalled client).
+            # is absorbed as a clean close in _read_op_raw, so the
+            # only TimeoutError reaching here is a mid-op io_timeout
+            # (a genuinely stalled client).
             self.dropped_sessions += 1
         finally:
-            self._drop_watcher(writer)
-            self._writers.discard(writer)
-            self._sessions -= 1
-            self._m_sessions.set(self._sessions, node=self._node)
+            self._drop_watcher(ctx, writer)
+            ctx.writers.discard(writer)
+            ctx.sessions -= 1
+            self._m_sessions.set(sum(c.sessions for c in self._ctxs),
+                                 node=self._node)
             await self._hangup(writer)
 
     @staticmethod
@@ -1099,14 +1422,18 @@ class ServeTier:
         except (ConnectionError, OSError):
             pass
 
-    async def _read_op(self, reader: asyncio.StreamReader,
-                       codec: Optional[FrameCodec],
-                       idle_exempt: bool = False):
+    async def _read_op_raw(self, reader: asyncio.StreamReader,
+                           codec: Optional[FrameCodec],
+                           idle_exempt: bool = False):
+        # RAW body bytes, not parsed JSON: the session loop dispatches
+        # on the first byte (0xB1 binop vs '{' JSON) before paying for
+        # a parse.
         if self.idle_timeout is None or idle_exempt:
-            return await read_frame_async(reader, codec, self.tally)
+            return await read_bytes_frame_async(reader, codec,
+                                                self.tally)
         try:
             return await asyncio.wait_for(
-                read_frame_async(reader, codec, self.tally),
+                read_bytes_frame_async(reader, codec, self.tally),
                 timeout=self.idle_timeout)
         except asyncio.TimeoutError:
             # Idle expiry is ROUTINE housekeeping, not a failure: close
@@ -1124,8 +1451,8 @@ class ServeTier:
             read_bytes_frame_async(reader, codec, self.tally),
             timeout=self._io_timeout)
 
-    async def _route_verdict(self, msg: dict, slot: int,
-                             fed_ok: bool):
+    async def _route_verdict(self, ctx: _LoopCtx, msg: dict,
+                             slot: int, fed_ok: bool):
         """Admission through the router for one keyspace op: None to
         enqueue locally, else the reply dict to send instead. The
         `moved`/proxy taxonomy lives in routing.PartitionRouter.check;
@@ -1145,9 +1472,9 @@ class ServeTier:
                              "non-owner (retry after table refresh)"}
         if verdict is PROXY:
             owner = router.table.owner_of(slot)
-            proxy = self._proxies.get(owner)
+            proxy = ctx.proxies.get(owner)
             if proxy is None:
-                proxy = self._proxies[owner] = _OwnerProxy(
+                proxy = ctx.proxies[owner] = _OwnerProxy(
                     owner, self._io_timeout)
             fwd = dict(msg)
             fwd["fwd"] = int(fwd.get("fwd", 0) or 0) + 1
@@ -1166,23 +1493,166 @@ class ServeTier:
         self._m_moved.inc(op=str(msg.get("op")), node=self._node)
         return verdict
 
-    async def _session_loop(self, reader: asyncio.StreamReader,
+    def _read_slots(self, slots: np.ndarray) -> list:
+        # Batched point reads for a binop frame: one lock hold, one
+        # executor hop for every `get` in the frame.
+        with self.lock:
+            return [self.crdt.get(int(s)) for s in slots]
+
+    async def _binop_frame(self, ctx: _LoopCtx, body: bytes,
+                           writer: asyncio.StreamWriter,
+                           codec: Optional[FrameCodec],
+                           fed_ok: bool) -> bool:
+        """One binary op batch -> one status reply frame. Per-op error
+        isolation: a rejected slot, a refused route or a proxied miss
+        fails ITS status byte; its batchmates commit normally. Writes
+        stage before gets execute, so read-your-writes extends into
+        the frame — a get observes every write earlier in (or
+        anywhere in) its own batch. Returns False when the reply could
+        not be written (transport gone) so the session ends."""
+        t0 = time.perf_counter()
+        opcodes, slots, values, epoch = decode_binop_request(body)
+        n = len(opcodes)
+        status = np.zeros(n, np.uint8)
+        details: list = []
+        values_out: Optional[np.ndarray] = None
+        for code, name in _BINOP_OP_NAMES.items():
+            count = int((opcodes == code).sum())
+            if count:
+                self._m_ops.inc(count, op=name, lane="bin",
+                                node=self._node)
+        ok = slots < self._n_slots
+        if not ok.all():
+            for i in np.nonzero(~ok)[0]:
+                status[i] = BINOP_ST_REJECTED
+                details.append({"i": int(i), "code": "write_rejected",
+                                "error": "bad slot"})
+        router = self.router
+        if router is not None:
+            admit = router.check_batch(slots, epoch, fed_ok)
+            if admit is not None:
+                # Refused ops re-enter the JSON verdict path one by
+                # one: `moved` redirects, proxy hops and the fwd-flux
+                # guard keep ONE taxonomy (and one set of counters)
+                # across both dialects. Refusals are the cold path —
+                # a current-epoch client on the owner never lands
+                # here.
+                for i in np.nonzero(~admit & ok)[0]:
+                    i = int(i)
+                    code = int(opcodes[i])
+                    msg = {"op": _BINOP_OP_NAMES[code],
+                           "slot": int(slots[i])}
+                    if code != BINOP_GET:
+                        msg["value"] = int(values[i])
+                    if epoch is not None:
+                        msg["epoch"] = epoch
+                    reply = await self._route_verdict(
+                        ctx, msg, int(slots[i]), fed_ok)
+                    st, detail = _binop_status_of(reply)
+                    status[i] = st
+                    if detail is not None:
+                        detail["i"] = i
+                        details.append(detail)
+                    if st == BINOP_ST_OK and code == BINOP_GET:
+                        if values_out is None:
+                            values_out = np.zeros(n, np.int64)
+                        value = reply.get("value")
+                        if value is None:
+                            status[i] = BINOP_ST_OK_NULL
+                        else:
+                            values_out[i] = int(value)
+                ok &= admit
+        wmask = ok & (opcodes != BINOP_GET)
+        nw = int(wmask.sum())
+        if nw:
+            # decode+admission cost rides the entry so the flush tick
+            # can attribute it as the binary lane's `decode` phase.
+            decode_s = time.perf_counter() - t0
+            if nw == n:
+                # The hot shape (a pure write batch): the wire views
+                # go STRAIGHT into combiner staging — zero copies in
+                # this module, proven by the pack-copy counters.
+                wslots, wvals = slots, values
+                wtombs = opcodes == BINOP_DELETE
+            else:
+                wslots = slots[wmask]
+                wvals = values[wmask]
+                wtombs = opcodes[wmask] == BINOP_DELETE
+            fut = ctx.loop.create_future()
+            self._q.push(("b", wslots, wvals, wtombs, fut, t0,
+                          decode_s, ctx.loop))
+            self._m_depth.set(len(self._q), node=self._node)
+            outcome = await fut
+            if outcome is not True:
+                widx = np.nonzero(wmask)[0]
+                if isinstance(outcome, tuple):
+                    status[widx] = BINOP_ST_BUSY
+                    details.append({"code": outcome[0],
+                                    "error": outcome[1]})
+                else:
+                    status[widx] = BINOP_ST_REJECTED
+                    details.append({"code": "write_rejected",
+                                    "error": str(outcome)})
+        gmask = ok & (opcodes == BINOP_GET)
+        if gmask.any():
+            gidx = np.nonzero(gmask)[0]
+            read = await ctx.loop.run_in_executor(
+                self._replica_pool, self._read_slots, slots[gidx])
+            if values_out is None:
+                values_out = np.zeros(n, np.int64)
+            for i, value in zip(gidx, read):
+                if value is None:
+                    status[i] = BINOP_ST_OK_NULL
+                else:
+                    values_out[i] = int(value)
+        try:
+            writer.writelines(frame_pieces(
+                encode_binop_reply(status, values_out, details),
+                codec, self.tally))
+            await writer.drain()
+        except (ConnectionError, OSError):
+            return False
+        return True
+
+    async def _session_loop(self, ctx: _LoopCtx,
+                            reader: asyncio.StreamReader,
                             writer: asyncio.StreamWriter) -> None:
-        loop = self._loop
+        loop = ctx.loop
         codec: Optional[FrameCodec] = None
+        binop_ok = False
         sem_ok = False
         trace_ok = False
         fed_ok = False
         sketch_ok = False
         watching = False
-        while not self._stop_event.is_set():
-            msg = await self._read_op(reader, codec,
-                                      idle_exempt=watching)
-            if msg is None or not isinstance(msg, dict) \
-                    or msg.get("op") == "bye":
+        while not ctx.stop_event.is_set():
+            raw = await self._read_op_raw(reader, codec,
+                                          idle_exempt=watching)
+            if raw is None:
+                return
+            if binop_ok and raw[:1] == _BINOP_REQ_TAG:
+                # Binary op lane: a whole batch of keyspace ops in one
+                # frame, one reply frame back. A malformed binop frame
+                # raises ValueError out of this loop — protocol
+                # violation, hang up — while a bad op INSIDE a
+                # well-formed frame only fails that op's status slot.
+                if not await self._binop_frame(ctx, raw, writer,
+                                               codec, fed_ok):
+                    return
+                continue
+            msg = json.loads(raw)
+            if not isinstance(msg, dict) or msg.get("op") == "bye":
                 return
             op = msg.get("op")
-            self._m_ops.inc(op=str(op), node=self._node)
+            if op in ("put", "delete", "get"):
+                # Client keyspace ops carry the lane label (the binop
+                # path counts the same ops with lane="bin"); control
+                # ops stay label-compatible with every dashboard that
+                # predates the lane split.
+                self._m_ops.inc(op=str(op), lane="json",
+                                node=self._node)
+            else:
+                self._m_ops.inc(op=str(op), node=self._node)
             tctx = msg.get("trace") if trace_ok else None
             if not isinstance(tctx, dict):
                 tctx = None
@@ -1201,14 +1671,15 @@ class ServeTier:
                                  "error": "bad slot/value"},
                         codec, self.tally)
                     continue
-                routed = await self._route_verdict(msg, slot, fed_ok)
+                routed = await self._route_verdict(ctx, msg, slot,
+                                                   fed_ok)
                 if routed is not None:
                     await write_json_async(writer, routed, codec,
                                            self.tally)
                     continue
                 fut = loop.create_future()
-                self._q.append((slot, value, op == "delete", fut,
-                                time.perf_counter()))
+                self._q.push(("j", slot, value, op == "delete", fut,
+                              time.perf_counter(), loop))
                 self._m_depth.set(len(self._q), node=self._node)
                 outcome = await fut
                 if outcome is True:
@@ -1236,7 +1707,8 @@ class ServeTier:
                                  "error": "bad slot"},
                         codec, self.tally)
                     continue
-                routed = await self._route_verdict(msg, slot, fed_ok)
+                routed = await self._route_verdict(ctx, msg, slot,
+                                                   fed_ok)
                 if routed is not None:
                     await write_json_async(writer, routed, codec,
                                            self.tally)
@@ -1260,6 +1732,7 @@ class ServeTier:
                 await write_json_async(writer, reply, codec,
                                        self.tally)
                 codec = FrameCodec(compress="zlib" in agreed)
+                binop_ok = "binop" in agreed
                 sem_ok = "semantics" in agreed
                 trace_ok = "trace" in agreed
                 fed_ok = "federation" in agreed
@@ -1290,12 +1763,13 @@ class ServeTier:
                         codec, self.tally)
                     continue
                 head = await loop.run_in_executor(
-                    self._replica_pool, self._watch_arm,
+                    self._replica_pool, self._watch_arm, ctx,
                     msg.get("since"))
-                self._watch.add(writer, slots)
-                self._watch_codec[writer] = codec
-                self._m_watchers.set(len(self._watch),
-                                     node=self._node)
+                ctx.watch.add(writer, slots)
+                ctx.watch_codec[writer] = codec
+                self._m_watchers.set(
+                    sum(len(c.watch) for c in self._ctxs),
+                    node=self._node)
                 # A subscribed session is exempt from idle expiry —
                 # a silent watcher is the normal state, and the
                 # fan-out path owns its liveness (buffer-cap shed).
@@ -1557,6 +2031,23 @@ class ServeTier:
                              "error": f"unknown op {op!r}"},
                     codec, self.tally)
                 return
+
+
+def _binop_status_of(reply) -> Tuple[int, Optional[dict]]:
+    """Map a JSON routing/proxy verdict onto a binop status byte plus
+    an optional detail dict (the human-readable half of the reply:
+    owner address, epoch, error text)."""
+    if not isinstance(reply, dict):
+        return BINOP_ST_BUSY, {"code": "busy",
+                               "error": "owner returned garbage "
+                                        "(proxy)"}
+    if reply.get("ok"):
+        return BINOP_ST_OK, None
+    code = str(reply.get("code", "write_rejected"))
+    status = {"busy": BINOP_ST_BUSY,
+              "moved": BINOP_ST_MOVED}.get(code, BINOP_ST_REJECTED)
+    detail = {k: v for k, v in reply.items() if k != "ok"}
+    return status, detail
 
 
 def _slot_ok(slot: Any, n_slots: int) -> bool:

@@ -473,6 +473,96 @@ def test_router_bypass_ignores_routerless_classes():
     assert "router-epoch-bypass" not in rules
 
 
+def test_router_bypass_covers_mpsc_push_spelling():
+    # the MPSC-era enqueue (self._q.push) carries the same routing
+    # contract as the list-era append, and the batched admission call
+    # (check_batch) gates it
+    src = (
+        "class Tier:\n"
+        "    def __init__(self, router=None):\n"
+        "        self.router = router\n"
+        "        self._q = MpscQueue()\n"
+        "    def ungated(self, slot, value):\n"
+        "        self._q.push(('j', slot, value))\n"
+        "    def gated(self, slots, epoch):\n"
+        "        admit = self.router.check_batch(slots, epoch, True)\n"
+        "        self._q.push(('b', slots))\n")
+    findings = [f for f in lint_source(src, "snippet.py")
+                if f.rule == "router-epoch-bypass"]
+    assert len(findings) == 1
+    assert findings[0].line == 6
+    assert "ungated()" in findings[0].message
+
+
+def test_combiner_enqueue_bare_append_flagged():
+    src = (
+        "class Tier:\n"
+        "    def __init__(self, crdt):\n"
+        "        self._q = MpscQueue()\n"
+        "        self._wc = None\n"
+        "    def handle(self, slot, value, fut):\n"
+        "        self._q.append((slot, value, fut))\n")
+    findings = [f for f in lint_source(src, "snippet.py")
+                if f.rule == "combiner-enqueue-unsafe"]
+    assert len(findings) == 1
+    assert findings[0].line == 6
+    assert "handle()" in findings[0].message
+    assert ".push" in findings[0].message
+
+
+def test_combiner_enqueue_mpsc_push_clean():
+    src = (
+        "class Tier:\n"
+        "    def __init__(self, crdt):\n"
+        "        self._q = MpscQueue()\n"
+        "        self._wc = None\n"
+        "    def handle(self, slot, value, fut):\n"
+        "        self._q.push((slot, value, fut))\n")
+    rules = {f.rule for f in lint_source(src, "snippet.py")}
+    assert "combiner-enqueue-unsafe" not in rules
+
+
+def test_combiner_enqueue_ignores_non_combiner_classes():
+    # no self._wc in __init__ -> not a combiner owner; a plain list
+    # queue drained on the same thread carries no MPSC contract
+    src = (
+        "class Hub:\n"
+        "    def __init__(self):\n"
+        "        self._q = []\n"
+        "    def handle(self, item):\n"
+        "        self._q.append(item)\n")
+    rules = {f.rule for f in lint_source(src, "snippet.py")}
+    assert "combiner-enqueue-unsafe" not in rules
+
+
+def test_combiner_enqueue_init_exempt_and_inner_targets_flagged():
+    # __init__ is construction (happens-before publication); any
+    # deeper self._q... target (a stripe's raw list) is still a
+    # bypass of the MPSC gate
+    src = (
+        "class Tier:\n"
+        "    def __init__(self, crdt):\n"
+        "        self._q = MpscQueue()\n"
+        "        self._q.append = None\n"
+        "        self._wc = None\n"
+        "    def sneak(self, entry):\n"
+        "        self._q._stripes[0].items.append(entry)\n")
+    findings = [f for f in lint_source(src, "snippet.py")
+                if f.rule == "combiner-enqueue-unsafe"]
+    assert len(findings) == 1
+    assert findings[0].line == 7
+    assert "sneak()" in findings[0].message
+
+
+def test_combiner_enqueue_shipped_serve_tier_clean():
+    # pin: the real serving tier routes every producer through the
+    # MPSC gate — this is the tree-level guarantee the rule exists for
+    import crdt_tpu.serve as serve_mod
+    findings = [f for f in lint_file(serve_mod.__file__)
+                if f.rule == "combiner-enqueue-unsafe"]
+    assert findings == []
+
+
 def test_ack_before_replicate_ungated_ack_flagged():
     src = (
         "class Tier:\n"

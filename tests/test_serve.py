@@ -4,16 +4,24 @@ compatibility with every client generation — negotiated
 `PeerConnection` sessions (packed + merkle) and pre-hello legacy
 peers — in both directions."""
 
+import json
 import socket
 import time
 
+import numpy as np
 import pytest
 
-from crdt_tpu import (DenseCrdt, PeerConnection, ServeTier,
-                      SyncTransportError, default_registry,
+from crdt_tpu import (DenseCrdt, FederatedTier, PeerConnection,
+                      ServeTier, SyncTransportError, default_registry,
                       fetch_metrics, sync_merkle_over_conn,
                       sync_over_tcp, sync_packed_over_conn)
-from crdt_tpu.net import recv_frame, send_frame
+from crdt_tpu.net import (BINOP_DELETE, BINOP_GET, BINOP_PUT,
+                          BINOP_ST_MOVED, BINOP_ST_OK,
+                          BINOP_ST_OK_NULL, BINOP_ST_REJECTED,
+                          FrameCodec, binop_round,
+                          encode_binop_request, recv_frame,
+                          send_bytes_frame, send_frame)
+from crdt_tpu.testing import FaultProxy, ScriptedSchedule
 
 pytestmark = pytest.mark.serve
 
@@ -29,6 +37,17 @@ def _req(sock, obj, codec=None):
     send_frame(sock, obj, None, codec)
     return recv_frame(sock, deadline=time.monotonic() + 10.0,
                       codec=codec)
+
+
+def _binop_session(host, port, extra_caps=()):
+    """Negotiated binary-lane session: hello offering binop (plus any
+    extra caps), post-hello tagged framing with no compression."""
+    sock = socket.create_connection((host, port), timeout=10.0)
+    sock.settimeout(10.0)
+    reply = _req(sock, {"op": "hello", "proto": 1,
+                        "caps": ["binop", *extra_caps]})
+    assert reply["ok"] and "binop" in reply["caps"]
+    return sock, FrameCodec(compress=False)
 
 
 # --- serve-only ops: put / get / delete over the framed wire ---
@@ -396,3 +415,368 @@ def test_rejected_tick_observes_ack_but_not_phases():
         finally:
             serve_mod._value_ok = orig
     assert _count(node=node) == before
+
+
+# --- binary client op lane (docs/WIRE.md) ---
+
+def test_binop_batched_roundtrip_reads_own_frame():
+    """One frame of puts + a delete + gets; one reply frame; gets
+    observe writes from the SAME batch (read-your-writes extends into
+    the frame — gets run after the batch commits)."""
+    crdt = DenseCrdt("a", n_slots=64)
+    with ServeTier(crdt, flush_interval=0.002) as tier:
+        sock, codec = _binop_session(tier.host, tier.port)
+        with sock:
+            ops = [BINOP_PUT, BINOP_PUT, BINOP_PUT, BINOP_DELETE,
+                   BINOP_GET, BINOP_GET]
+            slots = [3, 4, 5, 4, 3, 4]
+            vals = [30, 40, 50, 0, 0, 0]
+            status, values, details = binop_round(
+                sock, ops, slots, vals,
+                deadline=time.monotonic() + 10.0, codec=codec)
+            assert list(status) == [BINOP_ST_OK] * 4 \
+                + [BINOP_ST_OK, BINOP_ST_OK_NULL]
+            assert values is not None and int(values[4]) == 30
+            assert details == []
+            send_frame(sock, {"op": "bye"}, None, codec)
+    assert crdt.get(3) == 30
+    assert crdt.get(4) is None
+    assert crdt.get(5) == 50
+
+
+def test_binop_per_op_error_isolation():
+    """A bad slot inside a well-formed frame fails ITS status byte
+    with an indexed detail; its batchmates commit and the session
+    stays open for the next frame."""
+    crdt = DenseCrdt("a", n_slots=64)
+    with ServeTier(crdt, flush_interval=0.002) as tier:
+        sock, codec = _binop_session(tier.host, tier.port)
+        with sock:
+            status, values, details = binop_round(
+                sock, [BINOP_PUT, BINOP_PUT, BINOP_PUT],
+                [1, 9999, 2], [10, 1, 20],
+                deadline=time.monotonic() + 10.0, codec=codec)
+            assert list(status) == [BINOP_ST_OK, BINOP_ST_REJECTED,
+                                    BINOP_ST_OK]
+            assert details == [{"i": 1, "code": "write_rejected",
+                                "error": "bad slot"}]
+            # ...and the next frame on the same session still works.
+            status, _, details = binop_round(
+                sock, [BINOP_GET], [1], [0],
+                deadline=time.monotonic() + 10.0, codec=codec)
+            assert list(status) == [BINOP_ST_OK]
+            send_frame(sock, {"op": "bye"}, None, codec)
+    assert crdt.get(1) == 10
+    assert crdt.get(2) == 20
+
+
+def test_binop_malformed_frame_is_protocol_violation():
+    """A structurally bad binop frame (truncated rows) hangs the
+    session up — protocol violation, not a per-op error — and the
+    tier survives it."""
+    crdt = DenseCrdt("a", n_slots=64)
+    with ServeTier(crdt) as tier:
+        sock, codec = _binop_session(tier.host, tier.port)
+        with sock:
+            pieces = encode_binop_request([BINOP_PUT, BINOP_PUT],
+                                          [1, 2], [10, 20])
+            body = b"".join(bytes(p) for p in pieces)[:-5]
+            send_bytes_frame(sock, [body], None, codec)
+            assert recv_frame(sock, deadline=time.monotonic() + 10.0,
+                              codec=codec) is None
+        # the tier is still serving
+        with _connect(tier) as sock2:
+            assert _req(sock2, {"op": "put", "slot": 7,
+                                "value": 70}) == {"ok": True}
+            send_frame(sock2, {"op": "bye"})
+    assert crdt.get(7) == 70
+
+
+def test_binop_frame_without_negotiation_hangs_up():
+    """A session that never agreed `binop` sending a 0xB1 frame is a
+    protocol violation (the server parses it as JSON and fails) —
+    byte-compat: pre-binop behavior is fully governed by hello."""
+    crdt = DenseCrdt("a", n_slots=64)
+    with ServeTier(crdt) as tier:
+        with _connect(tier) as sock:
+            send_bytes_frame(sock, encode_binop_request(
+                [BINOP_PUT], [1], [10]))
+            assert recv_frame(
+                sock, deadline=time.monotonic() + 10.0) is None
+        with _connect(tier) as sock2:
+            assert _req(sock2, {"op": "put", "slot": 1,
+                                "value": 11}) == {"ok": True}
+            send_frame(sock2, {"op": "bye"})
+    assert crdt.get(1) == 11
+
+
+def test_binop_wire_compat_new_client_pre_binop_server():
+    """A new client offering `binop` against a pre-binop server: the
+    cap is simply not agreed and the session speaks today's JSON
+    dialect byte-identically."""
+    crdt = DenseCrdt("a", n_slots=64)
+    with ServeTier(crdt) as tier:
+        # Simulate the pre-binop server generation: same caps surface
+        # minus the new lane.
+        orig = ServeTier._caps
+        ServeTier._caps = lambda self: orig(self) - {"binop"}
+        try:
+            with _connect(tier) as sock:
+                reply = _req(sock, {"op": "hello", "proto": 1,
+                                    "caps": ["binop", "packed"]})
+                assert reply["ok"] is True
+                assert "binop" not in reply["caps"]
+                assert "packed" in reply["caps"]
+                codec = FrameCodec(compress=False)
+                assert _req(sock, {"op": "put", "slot": 2,
+                                   "value": 22},
+                            codec) == {"ok": True}
+                assert _req(sock, {"op": "get", "slot": 2}, codec) \
+                    == {"ok": True, "value": 22}
+                send_frame(sock, {"op": "bye"}, None, codec)
+        finally:
+            ServeTier._caps = orig
+    assert crdt.get(2) == 22
+
+
+def test_binop_moved_and_stale_epoch_redirects():
+    """Foreign slots in a binop frame answer MOVED (detail carries the
+    owner + epoch), local ops in the same frame commit; a stale frame
+    epoch refuses the whole batch with MOVED, same taxonomy as the
+    JSON lane."""
+    with FederatedTier(256, partitions=2,
+                       flush_interval=0.002) as fed:
+        tier = fed.tiers[0]
+        own = next(s for s in range(256)
+                   if fed.table.owner_of(s) == tier.router.addr)
+        foreign = next(s for s in range(256)
+                       if fed.table.owner_of(s) != tier.router.addr)
+        sock, codec = _binop_session(tier.host, tier.port,
+                                     extra_caps=["federation"])
+        with sock:
+            status, _, details = binop_round(
+                sock, [BINOP_PUT, BINOP_PUT], [own, foreign],
+                [5, 6], epoch=fed.table.epoch,
+                deadline=time.monotonic() + 10.0, codec=codec)
+            assert status[0] == BINOP_ST_OK
+            assert status[1] == BINOP_ST_MOVED
+            moved = [d for d in details if d.get("i") == 1]
+            assert moved and moved[0]["code"] == "moved"
+            assert moved[0]["owner"] != tier.router.addr
+            # stale epoch: the WHOLE frame is refused
+            status, _, details = binop_round(
+                sock, [BINOP_PUT], [own], [7],
+                epoch=fed.table.epoch + 1,
+                deadline=time.monotonic() + 10.0, codec=codec)
+            assert status[0] == BINOP_ST_MOVED
+            send_frame(sock, {"op": "bye"}, None, codec)
+        with tier.lock:
+            assert tier.crdt.get(own) == 5
+
+
+# --- fault injection on the client wire ---
+
+def test_fault_mid_hello_truncate_tier_survives():
+    crdt = DenseCrdt("a", n_slots=64)
+    with ServeTier(crdt) as tier:
+        sched = ScriptedSchedule([{"kind": "truncate", "after": 6}])
+        with FaultProxy(tier.host, tier.port,
+                        schedule=sched) as proxy:
+            sock = socket.create_connection((proxy.host, proxy.port),
+                                            timeout=10.0)
+            sock.settimeout(10.0)
+            with sock:
+                send_frame(sock, {"op": "hello", "proto": 1,
+                                  "caps": ["binop"]})
+                assert recv_frame(
+                    sock, deadline=time.monotonic() + 10.0) is None
+            assert proxy.counters.get("truncate", 0) == 1
+        # the tier took a half-hello and kept serving
+        with _connect(tier) as sock2:
+            assert _req(sock2, {"op": "put", "slot": 3,
+                                "value": 33}) == {"ok": True}
+            send_frame(sock2, {"op": "bye"})
+    assert crdt.get(3) == 33
+
+
+def test_fault_mid_batch_truncate_tier_survives():
+    """The cut lands INSIDE a binop batch frame (after a clean hello):
+    the client sees a dead socket, the tier sees a partial frame and
+    drops the session — and keeps serving everyone else."""
+    crdt = DenseCrdt("a", n_slots=64)
+    with ServeTier(crdt) as tier:
+        hello = {"op": "hello", "proto": 1, "caps": ["binop"]}
+        hello_bytes = 4 + len(json.dumps(hello).encode())
+        sched = ScriptedSchedule(
+            [{"kind": "truncate", "after": hello_bytes + 9}])
+        with FaultProxy(tier.host, tier.port,
+                        schedule=sched) as proxy:
+            sock = socket.create_connection((proxy.host, proxy.port),
+                                            timeout=10.0)
+            sock.settimeout(10.0)
+            with sock:
+                reply = _req(sock, hello)
+                assert reply["ok"] and "binop" in reply["caps"]
+                codec = FrameCodec(compress=False)
+                with pytest.raises(SyncTransportError):
+                    binop_round(sock,
+                                [BINOP_PUT] * 4, [1, 2, 3, 4],
+                                [10, 20, 30, 40],
+                                deadline=time.monotonic() + 10.0,
+                                codec=codec)
+            assert proxy.counters.get("truncate", 0) == 1
+        with _connect(tier) as sock2:
+            assert _req(sock2, {"op": "put", "slot": 9,
+                                "value": 90}) == {"ok": True}
+            send_frame(sock2, {"op": "bye"})
+    assert crdt.get(9) == 90
+    assert crdt.get(1) is None   # the truncated batch never landed
+
+
+# --- per-lane observability ---
+
+def test_binop_lane_counters_and_sketches():
+    crdt = DenseCrdt("lane-a", n_slots=64)
+    node = str(crdt.node_id)
+    reg = default_registry()
+    ops = reg.counter("crdt_tpu_serve_ops_total")
+    lane_sk = reg.sketch("crdt_tpu_serve_ack_lane_seconds_sketch")
+    with ServeTier(crdt, flush_interval=0.002) as tier:
+        with _connect(tier) as jsock:
+            assert _req(jsock, {"op": "put", "slot": 1,
+                                "value": 1}) == {"ok": True}
+            send_frame(jsock, {"op": "bye"})
+        bsock, codec = _binop_session(tier.host, tier.port)
+        with bsock:
+            status, _, _ = binop_round(
+                bsock, [BINOP_PUT, BINOP_DELETE, BINOP_GET],
+                [2, 3, 2], [20, 0, 0],
+                deadline=time.monotonic() + 10.0, codec=codec)
+            assert list(status)[:2] == [BINOP_ST_OK, BINOP_ST_OK]
+            send_frame(bsock, {"op": "bye"}, None, codec)
+    assert ops.value(op="put", lane="json", node=node) == 1
+    assert ops.value(op="put", lane="bin", node=node) == 1
+    assert ops.value(op="delete", lane="bin", node=node) == 1
+    assert ops.value(op="get", lane="bin", node=node) == 1
+    assert lane_sk.quantile(0.99, lane="json", node=node) is not None
+    assert lane_sk.quantile(0.99, lane="bin", node=node) is not None
+
+
+def test_binop_ack_phases_include_decode_and_reconstruct():
+    """The binary lane adds a `decode` phase (frame decode +
+    admission) and the phase sums still reconstruct the ack sum
+    within 10% — the PR 11 property, extended."""
+    crdt = DenseCrdt("binphase-a", n_slots=64)
+    node = str(crdt.node_id)
+    reg = default_registry()
+    ack = reg.histogram("crdt_tpu_serve_ack_seconds")
+    phase = reg.histogram("crdt_tpu_serve_ack_phase_seconds")
+
+    def _sum(h, **labels):
+        return sum(s["sum"] for s in h.samples()
+                   if all(s["labels"].get(k) == v
+                          for k, v in labels.items()))
+
+    names = ("decode", "queue_wait", "stamp", "scatter", "ack_write")
+    ack0 = _sum(ack, node=node)
+    frames = 10
+    with ServeTier(crdt, flush_interval=0.002) as tier:
+        sock, codec = _binop_session(tier.host, tier.port)
+        with sock:
+            for i in range(frames):
+                status, _, _ = binop_round(
+                    sock, [BINOP_PUT] * 4,
+                    [4 * i % 64, (4 * i + 1) % 64,
+                     (4 * i + 2) % 64, (4 * i + 3) % 64],
+                    [i, i, i, i],
+                    deadline=time.monotonic() + 10.0, codec=codec)
+                assert list(status) == [BINOP_ST_OK] * 4
+            send_frame(sock, {"op": "bye"}, None, codec)
+    ack_sum = _sum(ack, node=node) - ack0
+    counts = {p: sum(s["count"] for s in phase.samples()
+                     if s["labels"] == {"node": node, "phase": p})
+              for p in names}
+    # one observation per phase per acked FRAME (the batch is the
+    # client-visible ack unit)
+    assert counts == {p: frames for p in names}
+    total = sum(_sum(phase, node=node, phase=p) for p in names)
+    assert total == pytest.approx(ack_sum, rel=0.10), \
+        (counts, total, ack_sum)
+
+
+# --- SO_REUSEPORT multi-loop serving ---
+
+def test_multi_loop_acks_and_single_tick_invariant():
+    if not hasattr(socket, "SO_REUSEPORT"):
+        pytest.skip("platform has no SO_REUSEPORT")
+    crdt = DenseCrdt("ml-a", n_slots=256)
+    node = str(crdt.node_id)
+    reg = default_registry()
+    flushes = reg.counter("crdt_tpu_ingest_flush_total")
+    before = flushes.value(trigger="tick", node=node)
+    loops_g = reg.gauge("crdt_tpu_serve_loops")
+    with ServeTier(crdt, flush_interval=0.05, loops=2) as tier:
+        assert tier.loops_effective == 2
+        assert loops_g.value(node=node) == 2
+        # Many connections: the kernel spreads accepts across both
+        # loops, so writes (and their acks) cross the MPSC seam.
+        socks = [_connect(tier) for _ in range(12)]
+        try:
+            for i, s in enumerate(socks):
+                send_frame(s, {"op": "put", "slot": i,
+                               "value": i * 10})
+            for s in socks:
+                assert recv_frame(
+                    s, deadline=time.monotonic() + 10.0) == {"ok": True}
+            # 12 writers across 2 loops, still a handful of combiner
+            # ticks — never one flush per write, and the dispatch
+            # ledger (runtime-asserted) saw ONE ingest_scatter per
+            # tick.
+            ticks = flushes.value(trigger="tick", node=node) - before
+            assert 1 <= ticks <= 4
+        finally:
+            for s in socks:
+                s.close()
+    for i in range(12):
+        assert crdt.get(i) == i * 10
+    assert tier.dropped_sessions == 0
+
+
+def test_multi_loop_binop_lane():
+    if not hasattr(socket, "SO_REUSEPORT"):
+        pytest.skip("platform has no SO_REUSEPORT")
+    crdt = DenseCrdt("ml-b", n_slots=256)
+    with ServeTier(crdt, flush_interval=0.01, loops=2) as tier:
+        sessions = [_binop_session(tier.host, tier.port)
+                    for _ in range(6)]
+        try:
+            for k, (sock, codec) in enumerate(sessions):
+                status, _, _ = binop_round(
+                    sock, [BINOP_PUT] * 4,
+                    [4 * k, 4 * k + 1, 4 * k + 2, 4 * k + 3],
+                    [k, k, k, k],
+                    deadline=time.monotonic() + 10.0, codec=codec)
+                assert list(status) == [BINOP_ST_OK] * 4
+        finally:
+            for sock, _ in sessions:
+                sock.close()
+    for k in range(6):
+        for j in range(4):
+            assert crdt.get(4 * k + j) == k
+
+
+def test_reuseport_less_platform_falls_back_counted(monkeypatch):
+    """No SO_REUSEPORT -> ONE loop, and the loop gauge says so (no
+    silent downscale)."""
+    monkeypatch.delattr(socket, "SO_REUSEPORT", raising=False)
+    crdt = DenseCrdt("fb-a", n_slots=64)
+    node = str(crdt.node_id)
+    loops_g = default_registry().gauge("crdt_tpu_serve_loops")
+    with ServeTier(crdt, loops=4) as tier:
+        assert tier.loops_effective == 1
+        assert loops_g.value(node=node) == 1
+        with _connect(tier) as sock:
+            assert _req(sock, {"op": "put", "slot": 1,
+                               "value": 5}) == {"ok": True}
+            send_frame(sock, {"op": "bye"})
+    assert crdt.get(1) == 5
