@@ -2884,6 +2884,105 @@ def bench_types(n_slots: int = 1 << 10, loops: int = 16,
     return out
 
 
+def bench_churn(live: int = 4096, cycles: int = 5,
+                drift_budget: float = 0.05) -> dict:
+    """Churn soak: tombstone epoch GC + online compaction keep a
+    steady live-set workload at CONSTANT footprint (docs/STORAGE.md).
+
+    Each cycle puts ``live`` never-before-seen keys through a
+    `KeyedDenseCrdt`, deletes the previous cycle's keys, exercises the
+    pack + digest surfaces (so their caches are live), then runs one
+    GC pass (own canonical head — single node, so the fleet stability
+    watermark IS the local head) and one `compact`. Without the
+    storage plane every cycle grows the store by ``live`` slots and
+    the digest tree gains depth; with it, store bytes, digest depth,
+    pack-cache entries and slot capacity must all be FLAT across the
+    post-warmup cycles (<= ``drift_budget`` relative spread). The flat
+    checks are returned as booleans AND enforced with a nonzero exit
+    via ``churn_flat_ok`` so the smoke gate fails loudly, and the
+    byte metrics use the trajectory's lower-is-better override names
+    (``store_bytes_hwm``, ``bytes_per_live_row``) so a footprint
+    regression gates like a latency regression."""
+    import numpy as np
+    from crdt_tpu.models.dense_crdt import DenseCrdt
+    from crdt_tpu.models.keyed_dense import KeyedDenseCrdt
+
+    platform = jax.devices()[0].platform
+    kc = KeyedDenseCrdt(DenseCrdt("churn", n_slots=2 * live))
+
+    def store_bytes():
+        return int(sum(ln.nbytes for ln in kc.dense._store))
+
+    prev_keys: list = []
+    series = []
+    gc_ms = []
+    t_total = time.perf_counter()
+    for cycle in range(cycles):
+        keys = [f"c{cycle}:{i}" for i in range(live)]
+        kc.put_all({k: (cycle * live + i) % 100000
+                    for i, k in enumerate(keys)})
+        for k in prev_keys:
+            kc.delete(k)
+        # Populate the caches the flat checks watch; the entry count
+        # is read HERE, at its per-cycle high-water (compact clears
+        # the cache via the store swap) — the check is that it never
+        # accumulates across cycles.
+        kc.dense.pack_since(None)
+        depth = kc.digest_tree().depth
+        pack_entries = len(kc.dense._pack_cache)
+        t0 = time.perf_counter()
+        stability = kc.canonical_time   # single node: head == fleet min
+        purged = kc.gc_purge(stability, drift_slack_ms=0)
+        retained = kc.compact()
+        gc_ms.append((time.perf_counter() - t0) * 1e3)
+        series.append({
+            "cycle": cycle, "purged": purged, "retained": retained,
+            "store_bytes": store_bytes(), "digest_depth": depth,
+            "pack_cache_entries": pack_entries,
+            "capacity_slots": kc.dense.n_slots})
+        prev_keys = keys
+    total_s = time.perf_counter() - t_total
+
+    # Read-back oracle: the live set must survive GC + remap intact.
+    sample = prev_keys[:: max(1, live // 64)]
+    reads_ok = all(
+        kc.get(k) == ((cycles - 1) * live + i * max(1, live // 64))
+        % 100000 for i, k in enumerate(sample))
+
+    # Flatness over the post-warmup cycles (cycle 0 has no deletes to
+    # purge, so it's warmup; >= 3 measured cycles by construction).
+    tail = series[1:]
+
+    def flat(key):
+        vals = [c[key] for c in tail]
+        lo, hi = min(vals), max(vals)
+        return lo > 0 and (hi - lo) / lo <= drift_budget
+
+    checks = {k: flat(k) for k in ("store_bytes", "digest_depth",
+                                   "pack_cache_entries",
+                                   "capacity_slots")}
+    purge_ok = all(c["purged"] == live for c in tail)
+    ok = all(checks.values()) and purge_ok and reads_ok
+    hwm = max(c["store_bytes"] for c in series)
+    return {
+        "metric": "churn_constant_footprint", "unit": "bytes",
+        "platform": platform, "live_rows": live, "cycles": cycles,
+        "keys_churned_total": live * cycles,
+        "churn_keys_per_sec": round(live * cycles / total_s, 1),
+        "gc_compact_ms_p50": round(sorted(gc_ms)[len(gc_ms) // 2], 3),
+        "store_bytes_hwm": hwm,
+        "bytes_per_live_row": round(hwm / live, 2),
+        "digest_depth": tail[-1]["digest_depth"],
+        "pack_cache_entries": tail[-1]["pack_cache_entries"],
+        "capacity_slots": tail[-1]["capacity_slots"],
+        "purged_per_cycle_ok": purge_ok,
+        "reads_ok": reads_ok,
+        "flat": checks,
+        "churn_flat_ok": ok,
+        "cycles_detail": series,
+    }
+
+
 def result_dict(metric: str, merges: int, secs: float,
                 path: str = None, platform: str = None) -> dict:
     """The one-line JSON contract shared by bench.py and the suite.
@@ -2915,7 +3014,7 @@ def main() -> None:
                     choices=("stream", "distinct", "e2e", "e2e-kernel",
                              "sync", "ingest", "types", "antientropy",
                              "serve", "federate", "failover",
-                             "collective", "elastic"),
+                             "collective", "elastic", "churn"),
                     default="stream",
                     help="stream: write-stream replay (chunk replayed "
                          "with +1ms offsets); distinct: HBM-resident "
@@ -2960,7 +3059,12 @@ def main() -> None:
                          "load for >= 2 full cycles (splits on the "
                          "rise, merges on the fall) with zero acked "
                          "writes lost and steady ack p99 within the "
-                         "federate envelope")
+                         "federate envelope; churn: tombstone-GC + "
+                         "compaction soak — unique-key churn with a "
+                         "constant live set; store bytes, digest "
+                         "depth, pack-cache entries and capacity "
+                         "must stay flat across >= 3 GC cycles "
+                         "(exit 1 otherwise)")
     ap.add_argument("--sessions", type=int, default=None,
                     help="serve/federate mode: concurrent client "
                          "sessions (serve default 10000, federate "
@@ -3042,6 +3146,10 @@ def main() -> None:
             cooldown_s=0.5 if args.smoke else 0.8,
             settle_s=1.2 if args.smoke else 1.5,
             n_slots=1 << 10 if args.smoke else 1 << 14)
+    elif args.mode == "churn":
+        result = bench_churn(
+            live=256 if args.smoke else 4096,
+            cycles=4 if args.smoke else 6)
     elif args.mode == "types":
         result = bench_types(n_slots=1 << 10,
                              loops=4 if args.smoke else 16,
@@ -3101,6 +3209,10 @@ def main() -> None:
             _traj.normalize_record(args.mode, rec, smoke=args.smoke,
                                    host=host_override),
             args.trajectory or _traj.TRAJECTORY_PATH)
+    if result.get("churn_flat_ok") is False:
+        # The churn soak's acceptance IS the flatness; a growing
+        # footprint must fail CI, not just log (docs/STORAGE.md).
+        sys.exit(1)
 
 
 if __name__ == "__main__":

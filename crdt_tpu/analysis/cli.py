@@ -166,6 +166,8 @@ _LEDGER_REQUIRED = (
     "parallel.sharded_delta_mask", "parallel.sharded_max_logical_time",
     # parallel/collective.py — the pod-local group join
     "parallel.collective_join",
+    # storage plane (docs/STORAGE.md) — epoch GC + online compaction
+    "dense.gc_purge", "dense.compact_remap", "parallel.sharded_compact",
 )
 
 
@@ -197,6 +199,49 @@ def _ledger_completeness(registered=None) -> List:
                        "at module import so dispatch counts, the "
                        "compile census and the zero-dispatch probes "
                        "cover it (docs/OBSERVABILITY.md)"))
+    return out
+
+
+# Storage-plane kernels (docs/STORAGE.md) the default run must find
+# covered by BOTH verification surfaces: the jaxpr audit (an epoch-GC
+# purge that reorders under donation corrupts silently) and the law
+# search (purge composed with the merge-side resurrection fence must
+# stay a semilattice — idempotent, commutative, associative — or
+# replica states diverge permanently).
+_GC_REQUIRED = (
+    "dense.gc_purge",
+    "dense.compact_remap",
+)
+
+
+def _gc_completeness(audit_names=None, law_names=None) -> List:
+    """The storage-plane CI gate: epoch GC and online compaction must
+    be registered with every verification surface that ran this
+    invocation (pass ``None`` for one that did not run). A physically
+    destructive kernel shipping without audit or law coverage is the
+    one class of bug eventual consistency cannot repair."""
+    from .findings import Finding
+    out = []
+    for req in _GC_REQUIRED:
+        if audit_names is not None and req not in set(audit_names):
+            out.append(Finding(
+                rule="gc-kernel-unaudited",
+                path="crdt_tpu/analysis/jaxpr_audit.py", line=0,
+                message=f"storage-plane kernel {req!r} is not a "
+                        "registered jaxpr-audit target",
+                detail="add it to builtin_targets() — a donated "
+                       "purge/remap with an order-sensitivity hazard "
+                       "destroys state unrecoverably "
+                       "(docs/STORAGE.md)"))
+        if law_names is not None and req not in set(law_names):
+            out.append(Finding(
+                rule="gc-kernel-unlawed",
+                path="crdt_tpu/analysis/lattice_laws.py", line=0,
+                message=f"storage-plane kernel {req!r} is not a "
+                        "registered law-search target",
+                detail="add it to builtin_targets() — purge + fence "
+                       "must provably stay a semilattice or replicas "
+                       "diverge permanently (docs/STORAGE.md)"))
     return out
 
 
@@ -263,19 +308,25 @@ def main(argv=None) -> int:
             # The registry gate guards exactly the law + jaxpr
             # coverage surfaces, so it runs whenever either does.
             findings.extend(_registry_completeness())
+        law_names = audit_names = None
         if not args.skip_laws:
             from .lattice_laws import builtin_targets, run_laws
-            findings.extend(run_laws(builtin_targets(), seeds=seeds))
+            law_targets = builtin_targets()
+            law_names = tuple(t.name for t in law_targets)
+            findings.extend(run_laws(law_targets, seeds=seeds))
         if not args.skip_jaxpr:
             from .jaxpr_audit import audit_all, builtin_targets as \
                 audit_targets
             targets = audit_targets()
             names = tuple(t.name for t in targets)
+            audit_names = names
             findings.extend(_fastpath_completeness(names))
             findings.extend(_merkle_completeness(names))
             findings.extend(_ledger_completeness())
             reports, audit_findings = audit_all(targets)
             findings.extend(audit_findings)
+        if not args.skip_laws or not args.skip_jaxpr:
+            findings.extend(_gc_completeness(audit_names, law_names))
 
     findings.sort(key=lambda f: (f.path, f.line, f.rule))
     if args.json:

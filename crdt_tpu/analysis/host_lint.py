@@ -103,6 +103,16 @@ reproduced bugs):
   A scale decision acted on a stale epoch can retire an arc a
   concurrent change just made hot, and overlapping changes race each
   other's ``_control`` hold (docs/FEDERATION.md).
+- ``purge-watermark-unfenced`` — a ``.gc_purge(...)`` invocation
+  without a stability-watermark consult (any name or attribute
+  containing ``stability``) lexically at or before it in the same
+  function. Epoch GC is only sound against a fleet stability
+  watermark (`GossipNode.stability_hlc` / `ServeTier.stability_hlc`
+  — min over every peer's durable delivery mark, pinned on any
+  unmeasured input); purging against a local clock, a guess, or a
+  single peer's ack physically deletes tombstones other replicas
+  still need, and the resulting resurrection is silent data
+  corruption (docs/STORAGE.md).
 
 The linter is purely lexical/AST — no imports of the linted code — so
 it runs on broken or unimportable files (the self-test fixtures).
@@ -141,6 +151,7 @@ RULES = (
     "collective-socket-fallback-silent",
     "ack-before-replicate",
     "scale-decision-unfenced",
+    "purge-watermark-unfenced",
     "thread-unnamed",
     "histogram-ceiling-gate",
     "suppression-without-reason",
@@ -1224,6 +1235,51 @@ def _check_scale_fence(tree: ast.AST, path: str) -> List[Finding]:
     return out
 
 
+def _check_purge_watermark(tree: ast.AST, path: str) -> List[Finding]:
+    """Any function invoking ``.gc_purge(...)`` must consult a
+    stability watermark lexically at or before the call: a Load of a
+    name or attribute containing ``stability`` (the
+    `GossipNode.stability_hlc` / `ServeTier.stability_hlc` surfaces,
+    or a local bound from them — including the call's own argument).
+    Epoch GC against anything weaker than the fleet stability
+    watermark physically deletes tombstones some replica still
+    needs, and the eventual resurrection is silent data corruption
+    (docs/STORAGE.md)."""
+    out: List[Finding] = []
+    for fn in ast.walk(tree):
+        if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        evidence: Optional[int] = None
+        calls: List[ast.Call] = []
+        for n in ast.walk(fn):
+            if isinstance(n, ast.Attribute) \
+                    and isinstance(n.ctx, ast.Load) \
+                    and _ident_contains(n.attr, ("stability",)):
+                if evidence is None or n.lineno < evidence:
+                    evidence = n.lineno
+            if isinstance(n, ast.Name) \
+                    and isinstance(n.ctx, ast.Load) \
+                    and _ident_contains(n.id, ("stability",)):
+                if evidence is None or n.lineno < evidence:
+                    evidence = n.lineno
+            if isinstance(n, ast.Call) \
+                    and isinstance(n.func, ast.Attribute) \
+                    and n.func.attr == "gc_purge":
+                calls.append(n)
+        for call in calls:
+            if evidence is None or call.lineno < evidence:
+                out.append(Finding(
+                    rule="purge-watermark-unfenced", path=path,
+                    line=call.lineno,
+                    message=f"{fn.name}() invokes gc_purge() without "
+                            "consulting a stability watermark first "
+                            "— purging against anything weaker than "
+                            "the fleet stability floor deletes "
+                            "tombstones other replicas still need "
+                            "(docs/STORAGE.md)"))
+    return out
+
+
 _BUDGET_NEEDLES = ("budget",)
 
 
@@ -1325,6 +1381,7 @@ _ALL_CHECKS = (
     _check_collective_fallback,
     _check_ack_before_replicate,
     _check_scale_fence,
+    _check_purge_watermark,
     _check_thread_unnamed,
     _check_histogram_ceiling_gate,
 )

@@ -331,6 +331,28 @@ def builtin_targets(include_sharded: bool = True) -> List[AuditTarget]:
         build=lambda: jax.make_jaxpr(dense_ops._range_mask_jit())(
             dense_store(), np.int64(0), i64(2), i64(2))))
 
+    # Storage-plane kernels (docs/STORAGE.md): physically destructive
+    # by design, so audit coverage is gated by the CLI
+    # (_GC_REQUIRED) — an order hazard here destroys state no merge
+    # can repair.
+    targets.append(AuditTarget(
+        name="dense.gc_purge",
+        notes="epoch tombstone purge: elementwise lane masking under "
+              "one stability-floor predicate — no gather, no "
+              "scatter, order-insensitive by shape",
+        build=lambda: jax.make_jaxpr(dense_ops._gc_purge_jit(False))(
+            dense_store(), np.int64(0))))
+
+    targets.append(AuditTarget(
+        name="dense.compact_remap", unique_slots=True,
+        notes="slot remap to span-dense prefixes: scatter targets are "
+              "a masked per-span survivor-rank cumsum, bijective over "
+              "occupied rows by construction (spans are host-validated "
+              "non-overlapping, so each slot lands in at most one)",
+        build=lambda: jax.make_jaxpr(
+            dense_ops._compact_remap_jit(False, 8, False))(
+            dense_store(), i64(2), i64(2))))
+
     # Typed lane kernels (crdt_tpu/semantics): the shared sparse
     # scatter and fan-in shapes here, plus one per-tag elementwise
     # wire-join target per registered semantics from the registry

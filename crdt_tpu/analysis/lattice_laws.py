@@ -393,6 +393,79 @@ def builtin_targets() -> List[LawTarget]:
                 notes="group join as all-reduce over a 2-member mesh; "
                       "all three laws on member 0's lanes"))
 
+    # --- storage plane (docs/STORAGE.md) ---
+    #
+    # dense.gc_purge: the COMPOSITE operator the deployed system runs
+    # — floor-masked join (the merge-side resurrection fence, modeled
+    # as its stability premise: nothing at or below the floor is
+    # still in flight, so sub-floor inbound rows are masked) followed
+    # by the purge kernel at the same fixed floor. The fresh store is
+    # pre-seeded with sub-floor tombstones AND sub-floor live rows,
+    # so the purge genuinely fires (tombs vanish, live rows survive)
+    # on every law path. All three laws on the above-floor
+    # sublattice.
+    _FLOOR = np.int64(1) << 30
+
+    def gc_fresh():
+        lt = np.zeros(_N, np.int64)
+        node = np.zeros(_N, np.int32)
+        val = np.zeros(_N, np.int64)
+        occ = np.zeros(_N, bool)
+        tomb = np.zeros(_N, bool)
+        for i in range(8):
+            lt[i] = int(_FLOOR) - 1 - i
+            node[i] = np.int32(1 + (i % 4))
+            occ[i] = True
+            tomb[i] = (i % 2 == 0)
+            val[i] = 0 if tomb[i] else 100 + i
+        return dense_ops.DenseStore(
+            lt=lt, node=node, val=val,
+            mod_lt=np.zeros(_N, np.int64),
+            mod_node=np.zeros(_N, np.int32),
+            occupied=occ, tomb=tomb)
+
+    def gc_apply(store, batch):
+        stability_floor = np.int64(_FLOOR)  # fixed modeled watermark
+        fenced = np.asarray(batch["valid"]) \
+            & (np.asarray(batch["lt"]) > stability_floor)
+        joined, _win = dense_ops.wire_join_step(
+            store, batch["lt"], batch["node"], batch["val"],
+            batch["tomb"], fenced, np.int64(_WALL << 16),
+            np.int32(_LOCAL_NODE))
+        purged, _count, _mask = dense_ops.gc_purge(
+            joined, stability_floor)
+        return purged
+
+    _wire = make_wire_join_target(dense_ops.wire_join_step,
+                                  "dense.gc_purge")
+    targets.append(LawTarget(
+        name="dense.gc_purge", fresh=gc_fresh,
+        gen=_wire.gen, apply=gc_apply, extract=_extract_store,
+        combine=_wire.combine,
+        notes="floor-masked join + purge at a fixed stability floor; "
+              "all three laws on the above-floor sublattice, purge "
+              "fires on the seeded sub-floor tombstones"))
+
+    # dense.compact_remap: join laws preserved under the compaction
+    # quotient — extract compares the REMAPPED lanes (full-span
+    # compact), so law-equal stores must also compact identically:
+    # the remap is a deterministic, slot-order-preserving function of
+    # the store, never of the delivery order.
+    def compact_extract(store):
+        out = dense_ops.compact_remap(
+            store, np.asarray([0], np.int64),
+            np.asarray([_N], np.int64), None, leaf_width=8)
+        new_store, _translation, _live, _levels = out
+        return _extract_store(new_store)
+
+    compacted = make_wire_join_target(
+        dense_ops.wire_join_step, "dense.compact_remap",
+        notes="wire join compared through the compaction quotient: "
+              "the remap must be order-independent or replicas that "
+              "compact diverge")
+    compacted.extract = compact_extract
+    targets.append(compacted)
+
     # The semantics registry contributes one typed wire-join target
     # per registered lane type (crdt_tpu/semantics/types.py) — a new
     # type gets law coverage by registering, zero hand-listed targets.

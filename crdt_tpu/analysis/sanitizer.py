@@ -89,6 +89,30 @@ def check_dense_sparse_join(store, slots, lt, node, valid=None) -> None:
             f"node={int(r_node[i])})")
 
 
+def check_dense_no_resurrection(store, purged_slots, floor_lt) -> None:
+    """Post-merge invariant after an epoch GC pass (docs/STORAGE.md):
+    no purged slot may re-occupy with a record stamped BELOW the purge
+    floor — that would be a resurrection of purged state (a replayed
+    pre-purge delta got past the merge fence). Re-occupation at or
+    above the floor is a legitimate fresh write and passes. Armed by
+    `DenseCrdt.gc_purge` under ``CRDT_TPU_SANITIZE=1``; retired on
+    `compact` (the remap invalidates the recorded slot indices)."""
+    import numpy as np
+    purged = np.asarray(purged_slots)
+    if not purged.size:
+        return
+    occ = np.asarray(store.occupied)[purged]
+    lt = np.asarray(store.lt)[purged]
+    revived = occ & (lt <= int(floor_lt))
+    if bool(np.any(revived)):
+        i = int(np.argmax(revived))
+        raise LatticeViolation(
+            f"sanitizer: purged slot {int(purged[i])} re-occupied "
+            f"at or below the GC floor (lt={int(lt[i])} <= floor "
+            f"{int(floor_lt)}) — a pre-purge delta resurrected "
+            f"purged state past the merge fence")
+
+
 def check_dense_join(store, cs) -> None:
     """Post-merge invariant for a wide [R, N] DenseChangeset: per
     slot, the store dominates the lex max over the valid replica

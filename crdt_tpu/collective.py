@@ -230,7 +230,8 @@ class CollectiveGroup:
             # canonical IS max(member canonicals, every lt joined).
             m._canonical_time = Hlc.from_logical_time(canonical,
                                                       m.node_id)
-            m._digest_cache = ((canonical, m._sem_version), tree)
+            m._digest_cache = ((canonical, m._sem_version,
+                                m._store_gen), tree)
             m.stats.merges += 1
             win_counts.append(int(win_h[i].sum()))
             if seed_packs:
@@ -255,5 +256,5 @@ class CollectiveGroup:
         packed = m._pack_host_columns(mask, lt, node, val, tomb,
                                       resolved)
         key = (watermark.logical_time, canonical, m._sem_version,
-               resolved, None)
+               m._store_gen, resolved, None)
         m._pack_cache_store(key, (packed, m._table.ids()))

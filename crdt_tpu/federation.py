@@ -69,8 +69,11 @@ import time
 from typing import Any, Dict, List, Optional, Tuple
 
 from .analysis.concurrency import make_lock
-from .net import (FrameCodec, WireTally, _pack_for_peer, recv_frame,
-                  recv_bytes_frame, send_bytes_frame, send_frame)
+from .net import (BINOP_DELETE, BINOP_GET, BINOP_PUT, BINOP_ST_BUSY,
+                  BINOP_ST_MOVED, BINOP_ST_OK, BINOP_ST_OK_NULL,
+                  FrameCodec, WireTally, _pack_for_peer, binop_round,
+                  recv_frame, recv_bytes_frame, send_bytes_frame,
+                  send_frame)
 from .routing import PartitionRouter, RoutingTable
 from .serve import ServeTier
 
@@ -126,6 +129,18 @@ def _metrics():
             "crdt_tpu_topology_change_progress_ms",
             "wall-clock ms of the in-flight topology change's last "
             "progress (0 = idle)"),
+        # Byte split for the GC payoff story (docs/STORAGE.md): how
+        # much of every anti-entropy stream was live state vs
+        # tombstones. Post-GC donors should ship tombstone_bytes ≈ 0.
+        "live_bytes": reg.counter(
+            "crdt_tpu_shipped_live_bytes_total",
+            "packed bytes of live rows shipped by migration streams "
+            "and rejoin walks (surface label: migrate|rejoin)"),
+        "tomb_bytes": reg.counter(
+            "crdt_tpu_shipped_tombstone_bytes_total",
+            "packed bytes of tombstone rows shipped by migration "
+            "streams and rejoin walks (surface label: "
+            "migrate|rejoin)"),
     }
 
 
@@ -137,7 +152,8 @@ class _Upstream:
 
     def __init__(self, addr: str, timeout: float = 30.0,
                  caps: Tuple[str, ...] = ("zlib", "packed",
-                                          "semantics", "federation")):
+                                          "semantics", "federation",
+                                          "binop")):
         host, _, port = addr.rpartition(":")
         self.addr = addr
         self.sock = socket.create_connection((host, int(port)),
@@ -702,6 +718,16 @@ class FederatedTier:
                                          ranges=tuple(spans))
         if not packed.k:
             return 0, wm
+        # Live/tombstone byte split (docs/STORAGE.md): every row costs
+        # the same wire bytes, so the split is exact row accounting.
+        # Donors that ran an epoch-GC pass first stream tomb_bytes ≈ 0
+        # — the measurable payoff of purge-before-retire.
+        per_row = packed.nbytes // packed.k
+        tomb_rows = int(packed.tomb.sum())
+        m = _metrics()
+        m["tomb_bytes"].inc(tomb_rows * per_row, surface="migrate")
+        m["live_bytes"].inc((packed.k - tomb_rows) * per_row,
+                            surface="migrate")
         meta, bufs = pack_rows(packed)
         msg = {"op": "push_packed", "meta": meta,
                "node_ids": list(ids)}
@@ -824,6 +850,14 @@ class FederatedTier:
         recipient = self.tier_at(dst_addr)
         stream_addr = dst_addr_override or dst_addr
 
+        # Spend the GC bytes (docs/STORAGE.md): purge the donor's
+        # stable tombstones BEFORE streaming, so retiring a churned
+        # partition ships live rows only — the recipient never pays
+        # pack/merge/digest cost for deletes every replica already
+        # observed. Zero-cost when the stability watermark is pinned
+        # or has not advanced (gc_pass dispatches nothing).
+        gc_purged = donor.gc_pass()
+
         rounds = 0
         migrated = 0
         mark = None
@@ -923,6 +957,7 @@ class FederatedTier:
             "src": src, "src_addr": donor_addr, "dst_addr": dst_addr,
             "spans": [list(s) for s in spans],
             "rounds": rounds, "migrated_rows": migrated,
+            "gc_purged": gc_purged,
             "epoch": self.table.epoch, "seconds": dt,
             "drain_rows": shipped, "rehomed_watchers": rehomed,
             "flip_to_drain_seconds": time.perf_counter() - flip_at,
@@ -962,6 +997,13 @@ class FederatedClient:
         self.moved_redirects = 0
         self.busy_retries = 0
         self.redirect_resets = 0
+        # Binary op lane adoption accounting (docs/WIRE.md): rounds
+        # sent on the negotiated `binop` lane, and sessions demoted to
+        # framed JSON after a malformed binary reply (sticky for the
+        # session's lifetime — one framing fault means the peer's
+        # binary half cannot be trusted, but its JSON half still can).
+        self.binop_rounds = 0
+        self.binop_fallbacks = 0
         self.refresh()
 
     # --- plumbing ---
@@ -981,21 +1023,25 @@ class FederatedClient:
     def _backoff(self, attempt: int) -> None:
         time.sleep(min(0.25, 0.01 * (1 << attempt)))
 
-    def _try_refresh(self) -> None:
+    def _try_refresh(self, hint: Optional[str] = None) -> None:
         """Refresh, absorbing total unreachability: mid-failover the
         fleet can briefly answer nothing at all, and the op retry
         loop — not this probe — owns the failure budget."""
         try:
-            self.refresh()
+            self.refresh(hint)
         except ConnectionError:
             pass
 
-    def refresh(self) -> RoutingTable:
-        """Fetch the newest routing table from any reachable tier
-        (seeds first, then every known owner)."""
+    def refresh(self, hint: Optional[str] = None) -> RoutingTable:
+        """Fetch the newest routing table from any reachable tier.
+        ``hint`` (the owner address a ``moved`` reply named) is tried
+        FIRST — it is the freshest routing signal available, and
+        mid-topology-change it may be the only address that already
+        serves the new epoch; then seeds, then every known owner."""
         candidates = list(dict.fromkeys(
-            self._seeds + (list(self.table.owners())
-                           if self.table is not None else [])))
+            ([hint] if hint else [])
+            + self._seeds + (list(self.table.owners())
+                             if self.table is not None else [])))
         last: Optional[BaseException] = None
         for addr in candidates:
             try:
@@ -1079,16 +1125,98 @@ class FederatedClient:
             f"op {msg.get('op')!r} on slot {slot} still redirecting "
             f"after {self._max_redirects} attempts")
 
+    _JSON_OP = {BINOP_PUT: "put", BINOP_DELETE: "delete",
+                BINOP_GET: "get"}
+
+    def _json_msg(self, opcode: int, slot: int, value: int) -> dict:
+        msg = {"op": self._JSON_OP[opcode], "slot": int(slot)}
+        if opcode == BINOP_PUT:
+            msg["value"] = int(value)
+        return msg
+
+    def _op(self, opcode: int, slot: int, value: int = 0) -> dict:
+        """One keyspace op, preferring the binary op lane
+        (docs/WIRE.md) when the owner's session negotiated the
+        ``binop`` cap: fixed columnar frames instead of per-op JSON.
+        Same retry protocol as `_keyspace` — MOVED replies carry the
+        owner address + epoch in the detail tail, which feeds the
+        refresh as a routing hint; BUSY backs off and refreshes. A
+        malformed binary reply demotes that session to framed JSON
+        permanently (sticky fallback) and replays the op there; a
+        session that never negotiated the cap routes through
+        `_keyspace` untouched."""
+        if self.table is None:
+            self.refresh()
+        attempt = 0
+        while attempt < self._max_redirects:
+            epoch_seen = -1 if self.table is None \
+                else self.table.epoch
+            owner = self.table.owner_of(slot)
+            try:
+                up = self._session(owner)
+                if "binop" not in up.caps \
+                        or getattr(up, "json_ops", False):
+                    return self._keyspace(
+                        self._json_msg(opcode, slot, value), slot)
+                self.binop_rounds += 1
+                try:
+                    status, values, details = binop_round(
+                        up.sock, [opcode], [int(slot)], [int(value)],
+                        epoch=self.table.epoch, tally=up.tally,
+                        codec=up.codec)
+                except ValueError:
+                    # A well-framed but undecodable binary reply: the
+                    # peer's binop half is broken, its JSON half is
+                    # not — demote the session for good and replay.
+                    up.json_ops = True
+                    self.binop_fallbacks += 1
+                    return self._keyspace(
+                        self._json_msg(opcode, slot, value), slot)
+            except (ConnectionError, OSError):
+                self._drop_session(owner)
+                self._backoff(attempt)
+                self._try_refresh()
+                attempt = self._next_attempt(attempt, epoch_seen)
+                continue
+            st = int(status[0])
+            if st == BINOP_ST_OK:
+                return {"ok": True,
+                        "value": (int(values[0])
+                                  if values is not None else None)}
+            if st == BINOP_ST_OK_NULL:
+                return {"ok": True, "value": None}
+            det = next((d for d in details
+                        if isinstance(d, dict) and d.get("i") == 0),
+                       None)
+            if det is None:
+                det = next((d for d in details
+                            if isinstance(d, dict) and "i" not in d),
+                           {})
+            if st == BINOP_ST_MOVED:
+                self.moved_redirects += 1
+                self._try_refresh(det.get("owner"))
+                attempt = self._next_attempt(attempt, epoch_seen)
+                continue
+            if st == BINOP_ST_BUSY:
+                self.busy_retries += 1
+                self._backoff(attempt)
+                self._try_refresh()
+                attempt = self._next_attempt(attempt, epoch_seen)
+                continue
+            raise ValueError(
+                f"op {self._JSON_OP[opcode]!r} rejected: {det!r}")
+        raise ConnectionError(
+            f"op {self._JSON_OP[opcode]!r} on slot {slot} still "
+            f"redirecting after {self._max_redirects} attempts")
+
     def put(self, slot: int, value: int) -> None:
-        self._keyspace({"op": "put", "slot": int(slot),
-                        "value": int(value)}, slot)
+        self._op(BINOP_PUT, slot, int(value))
 
     def delete(self, slot: int) -> None:
-        self._keyspace({"op": "delete", "slot": int(slot)}, slot)
+        self._op(BINOP_DELETE, slot)
 
     def get(self, slot: int):
-        return self._keyspace({"op": "get", "slot": int(slot)},
-                              slot).get("value")
+        return self._op(BINOP_GET, slot).get("value")
 
     # --- watch ---
 

@@ -866,6 +866,64 @@ class GossipNode:
             out["routing_epoch"] = router.epoch
         return out
 
+    # --- tombstone GC (docs/STORAGE.md) ---
+
+    def stability_hlc(self) -> Optional[Hlc]:
+        """Fleet stability watermark: the min over every configured
+        peer's delivery watermark (the PR 3 `lag_snapshot` signal —
+        the local canonical captured when that peer's last round
+        completed, i.e. everything this node holds below it has been
+        offered to the peer) and, when a replica-group tier is
+        attached, the group's durable floor
+        (`ServeTier.stability_hlc`). A tombstone below this mark has
+        been delivered everywhere, so purging it can never be
+        observed. ANY unmeasured input — a never-synced peer, a
+        follower without a durable head — pins the watermark to
+        ``None``: unmeasured ≠ safe-to-purge, the same discipline as
+        the autoscaler's degraded freeze. With no peers and no tier,
+        this node is the fleet, and its own head is the watermark.
+        Raw watermark — `DenseCrdt.gc_purge` applies the HLC drift
+        slack."""
+        with self._peers_lock:
+            peers = list(self.peers.values())
+        marks = []
+        for p in peers:
+            if p.watermark is None:
+                return None
+            marks.append(p.watermark)
+        tier = self._replica_tier
+        if tier is not None:
+            t = tier.stability_hlc()
+            if t is None:
+                return None
+            marks.append(t)
+        if not marks:
+            with self.server.lock:
+                return self.crdt.canonical_time
+        return min(marks)
+
+    def gc_pass(self, drift_slack_ms: Optional[int] = None) -> int:
+        """One epoch-GC pass: fold the fleet stability watermark and
+        purge tombstones it has passed (`DenseCrdt.gc_purge`, one
+        dispatch — zero when the watermark hasn't advanced). Returns
+        slots purged; 0 when the watermark is pinned or the replica
+        has no dense GC surface (record-dict backends purge nothing).
+        Call it from the sweep cadence — GC is idempotent and cheap
+        when idle, so over-calling is safe."""
+        from .obs.registry import default_registry
+        stability = self.stability_hlc()
+        if stability is None:
+            default_registry().counter(
+                "crdt_tpu_gc_pinned_total",
+                "GC passes skipped on a pinned stability watermark"
+            ).inc(surface="gossip")
+            return 0
+        if not hasattr(self.crdt, "gc_purge"):
+            return 0
+        with self.server.lock:
+            return self.crdt.gc_purge(stability,
+                                      drift_slack_ms=drift_slack_ms)
+
     def attach_group(self, group) -> None:
         """Declare (or replace, or with ``None`` detach) this node's
         pod-local replica group after construction — the usual order,
@@ -924,6 +982,18 @@ class GossipNode:
             part = tier.partition_info()
             if part is not None:
                 extra["partition"] = part
+        # Stability watermark (docs/STORAGE.md): gossiped so peers and
+        # the fleet poller see each node's GC posture — the watermark
+        # it would purge at (or the pin), and the armed floor.
+        stability = self.stability_hlc()
+        gc: Dict[str, Any] = {
+            "stability_hlc": (None if stability is None
+                              else str(stability)),
+            "pinned": stability is None}
+        floor = getattr(self.crdt, "gc_floor", None)
+        if floor:
+            gc["gc_floor"] = int(floor)
+        extra["stability"] = gc
         return extra
 
     # --- fleet canary (obs/probe.py) ---

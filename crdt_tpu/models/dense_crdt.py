@@ -166,6 +166,25 @@ class DenseCrdt:
         # digest_tree cache: one (key, DigestTree) pair, same
         # invalidation discipline as the pack cache (docs/ANTIENTROPY.md).
         self._digest_cache: Optional[Tuple[Any, Any]] = None
+        # Tombstone-GC state (docs/STORAGE.md). The generation counts
+        # store replacements; gc_purge/compact advance it WITHOUT
+        # advancing the canonical clock, so cache keys carry it — a
+        # purely clock-keyed cache would alias across a purge. The
+        # floor is the armed resurrection fence (merge paths drop
+        # sub-floor rows targeting empty slots); the last-floor latch
+        # makes an unadvanced watermark cost zero dispatches.
+        self._store_gen = 0
+        self._gc_floor_lt = 0
+        self._last_gc_floor_lt = 0
+        self._gc_purged: Optional[Tuple[np.ndarray, int]] = None
+        # Device bool[n_slots]: slots epoch GC physically purged.
+        # The resurrection fence drops sub-floor inbound rows ONLY on
+        # these slots — an empty slot that was never purged has
+        # nothing to resurrect, and legitimately receives old rows
+        # for the first time (a migration stream re-homing an arc, a
+        # peer's initial full sync). Retired by compact (the remap
+        # invalidates slot identity).
+        self._gc_fence_dev = None
         self._store = store if store is not None else empty_dense_store(
             n_slots)
         if self._store.n_slots != n_slots:  # must survive `python -O`
@@ -216,6 +235,7 @@ class DenseCrdt:
         # invalidates cached outbound packs, so `pack_since` can trust
         # a cache hit without re-deriving what changed.
         self._store_lanes = store
+        self._store_gen = self.__dict__.get("_store_gen", 0) + 1
         cache = self.__dict__.get("_pack_cache")
         if cache:
             cache.clear()
@@ -231,6 +251,21 @@ class DenseCrdt:
         self.drain_ingest()
         self._store_escaped = True
         return self._store
+
+    @property
+    def store_generation(self) -> int:
+        """Monotonic count of store replacements. Every mutation lands
+        through the ``_store`` setter and bumps it — including
+        `gc_purge`/`compact`, which do NOT advance the canonical clock,
+        so pack/digest cache keys fold this in (docs/STORAGE.md)."""
+        return self._store_gen
+
+    @property
+    def gc_floor(self) -> int:
+        """The armed resurrection fence: the highest purge floor (a
+        packed logical time) any `gc_purge` ran at, or 0. Merge paths
+        drop inbound rows below it that target unoccupied slots."""
+        return self._gc_floor_lt
 
     def refresh_canonical_time(self) -> None:
         self.drain_ingest()
@@ -643,6 +678,163 @@ class DenseCrdt:
         self.drain_ingest()
         self._store = empty_dense_store(self.n_slots)
 
+    # --- tombstone epoch GC + online compaction (docs/STORAGE.md) ---
+
+    def gc_purge(self, stability: Hlc, *,
+                 drift_slack_ms: Optional[int] = None) -> int:
+        """Epoch tombstone GC: physically drop every tombstone whose
+        delete stamp every peer's durable watermark has passed —
+        ``stability`` MUST be a fleet stability watermark
+        (`GossipNode.stability_hlc` / `ServeTier.stability_hlc`; the
+        crdtlint ``purge-watermark-unfenced`` rule holds library call
+        sites to that). One donated device dispatch masks the purged
+        rows out of all lanes (`ops.dense.gc_purge`); an unadvanced
+        watermark short-circuits BEFORE dispatch, so idle GC passes
+        cost nothing (ledger-asserted in the tests).
+
+        The purge floor is the watermark minus a clock-drift slack
+        (``hlc.MAX_DRIFT`` unless overridden — single-node callers
+        whose watermark IS their own head pass 0): with the slack, any
+        row a peer legitimately holds undelivered sits ABOVE the
+        floor, which is what makes the merge-side resurrection fence
+        precise — inbound rows below the floor targeting a PURGED
+        slot are provably-dominated replays and are dropped (slots
+        never purged here are untouched: an old row arriving at one
+        for the first time — a migration stream, an initial sync — is
+        new information, not a replay). Returns the number of slots
+        purged."""
+        self._refuse_in_pipeline("gc_purge")
+        self.drain_ingest()
+        from ..hlc import MAX_DRIFT, SHIFT
+        slack = MAX_DRIFT if drift_slack_ms is None else int(drift_slack_ms)
+        if slack < 0:
+            raise ValueError(f"drift_slack_ms must be >= 0, got {slack}")
+        floor = int(stability.logical_time) - (slack << SHIFT)
+        if floor <= 0 or floor <= self._last_gc_floor_lt:
+            return 0  # watermark hasn't advanced: zero dispatches
+        from ..obs.registry import default_registry
+        from ..ops.dense import gc_purge as _gc_purge_op
+        new_store, purged_count, purged_mask = _gc_purge_op(
+            self._store, jnp.int64(floor),
+            donate=self._donate_writes(),
+            sharding=self._write_sharding())
+        mask_h = None
+        if self._sem is not None or _sanitizer.enabled():
+            n_purged, mask_h = jax.device_get((purged_count, purged_mask))
+            mask_h = np.asarray(mask_h)
+        else:
+            n_purged = jax.device_get(purged_count)
+        n_purged = int(n_purged)
+        self._store = self._postprocess_store(new_store)
+        self._store_escaped = False
+        self._last_gc_floor_lt = floor
+        self._gc_floor_lt = max(self._gc_floor_lt, floor)
+        # Accumulate the device fence mask the merge paths consult —
+        # purged slots only, so the fence can never eat first-time
+        # deliveries (migration, initial sync) to slots it never GC'd.
+        if self._gc_fence_dev is None:
+            self._gc_fence_dev = purged_mask
+        else:
+            self._gc_fence_dev = jnp.logical_or(
+                self._gc_fence_dev, purged_mask)
+        if n_purged and self._sem is not None:
+            typed_purged = mask_h & (self._sem != 0)
+            if typed_purged.any():
+                # Purged slots revert to the LWW default — the typed
+                # tag described the tombstoned record, which is gone.
+                sem = self._sem.copy()
+                sem[typed_purged] = 0
+                self._sem = sem if sem.any() else None
+                self._sem_dev = None
+                self._sem_version += 1
+        if _sanitizer.enabled():
+            # Arm the post-purge resurrection check: every later merge
+            # asserts no recorded slot re-occupies below the floor
+            # (sanitizer.check_dense_no_resurrection). Compaction
+            # remaps slots, so it retires the record.
+            slots = np.nonzero(mask_h)[0]
+            if self._gc_purged is not None:
+                prev_slots, _ = self._gc_purged
+                slots = np.union1d(prev_slots, slots)
+            self._gc_purged = (slots, floor)
+        default_registry().counter(
+            "crdt_tpu_gc_purged_slots_total",
+            "tombstoned slots physically reclaimed by epoch GC").inc(
+                n_purged, node=str(self._node_id))
+        default_registry().counter(
+            "crdt_tpu_gc_passes_total",
+            "gc_purge dispatches (watermark advanced)").inc(
+                node=str(self._node_id))
+        return n_purged
+
+    def compact(self, ranges=None) -> np.ndarray:
+        """Online store compaction: remap surviving rows to a dense
+        prefix (per span — the default spans the whole store) and
+        rebuild the digest-tree levels, all in ONE donated device
+        dispatch (`ops.dense.compact_remap`). Returns the slot
+        translation table ``int32[n_slots]`` — ``translation[old] =
+        new`` for occupied rows, ``-1`` for empty slots — which the
+        caller MUST apply to every external slot reference
+        (`KeyedDenseCrdt.compact` rewrites its intern map; raw-slot
+        callers compact only when they own the slot space,
+        docs/STORAGE.md). ``ranges`` restricts compaction to half-open
+        ``(lo, hi)`` spans; rows outside keep their slots, so routing
+        arcs stay range-preserving. The digest cache is re-seeded from
+        the in-program rebuild, so the next anti-entropy walk costs
+        zero digest dispatches."""
+        self._refuse_in_pipeline("compact")
+        self.drain_ingest()
+        spans = self._normalize_ranges(
+            ((0, self.n_slots),) if ranges is None else ranges)
+        k = max(1, len(spans))
+        pad = 1
+        while pad < k:
+            pad *= 2
+        los = np.zeros(pad, np.int64)
+        his = np.zeros(pad, np.int64)
+        for i, (lo, hi) in enumerate(spans):
+            los[i] = lo
+            his[i] = hi
+        from ..ops.dense import compact_remap
+        from ..ops.digest import build_digest_tree
+        sem_dev = self._sem_device() if self._sem is not None else None
+        out = compact_remap(self._store, jnp.asarray(los),
+                            jnp.asarray(his), sem_dev,
+                            leaf_width=self.DIGEST_LEAF_WIDTH,
+                            donate=self._donate_writes(),
+                            sharding=self._write_sharding())
+        if sem_dev is not None:
+            new_store, new_sem, translation, _live, levels = out
+        else:
+            new_store, translation, _live, levels = out
+            new_sem = None
+        translation = np.asarray(jax.device_get(translation))
+        self._store = self._postprocess_store(new_store)
+        self._store_escaped = False
+        if new_sem is not None:
+            sem_h = np.asarray(jax.device_get(new_sem)).astype(np.int8)
+            self._sem = sem_h if sem_h.any() else None
+            self._sem_dev = None
+            self._sem_version += 1
+        # Recorded purge slots predate the remap; retire the record
+        # and the device fence mask rather than translate them
+        # (purged slots are unoccupied, so their translations are -1
+        # anyway, and post-compact slot identity belongs to the
+        # single remap owner — docs/STORAGE.md).
+        self._gc_purged = None
+        self._gc_fence_dev = None
+        # Seed AFTER the store swap (the setter cleared the cache) and
+        # the sem version bump, under the exact key the next
+        # `digest_tree` lookup builds.
+        tree = build_digest_tree(self.n_slots, self.DIGEST_LEAF_WIDTH,
+                                 levels)
+        self._digest_cache = (self._digest_key(), tree)
+        from ..obs.registry import default_registry
+        default_registry().counter(
+            "crdt_tpu_compact_passes_total",
+            "compact_remap dispatches").inc(node=str(self._node_id))
+        return translation
+
     def grow(self, n_slots: int) -> None:
         """Grow the slot capacity to ``n_slots`` (records keep their
         slots; new slots start empty). The dense analogue of the
@@ -676,6 +868,12 @@ class DenseCrdt:
             self._sem = np.concatenate(
                 [self._sem, np.zeros(n_slots - self.n_slots, np.int8)])
             self._sem_dev = None
+        if self._gc_fence_dev is not None:
+            # New slots were never purged — the fence must not cover
+            # them (first-time deliveries land there).
+            self._gc_fence_dev = jnp.concatenate(
+                [self._gc_fence_dev,
+                 jnp.zeros(n_slots - self.n_slots, jnp.bool_)])
         pad = empty_dense_store(n_slots - self.n_slots)
         self._store = DenseStore(*(
             jnp.concatenate([lane, pad_lane])
@@ -1405,6 +1603,38 @@ class DenseCrdt:
                         self._canonical_time,
                         millis=self._wall_clock())
                     return None
+        floor = self._gc_floor_lt
+        if floor and self._gc_fence_dev is not None and len(slots):
+            # Resurrection fence (docs/STORAGE.md): a row below the GC
+            # floor targeting a slot this replica PURGED is a replay
+            # of purged state — the stability watermark proves every
+            # peer delivered everything below the floor (drift slack
+            # included), so nothing below it is legitimately still in
+            # flight for a purged slot. Rows at or above the floor,
+            # sub-floor rows for never-purged slots (first-time
+            # deliveries: migration streams, initial syncs), and rows
+            # the join would dominate anyway all pass through.
+            fenced = np.asarray(jax.device_get(
+                self._gc_fence_dev[np.asarray(slots)]))
+            stale = (lt <= floor) & fenced
+            if stale.any():
+                from ..obs.registry import default_registry
+                default_registry().counter(
+                    "crdt_tpu_gc_fenced_rows_total",
+                    "inbound rows dropped by the post-GC resurrection "
+                    "fence").inc(int(stale.sum()),
+                                 node=str(self._node_id))
+                keep = ~stale
+                slots, lt, node, val, tomb = (
+                    slots[keep], lt[keep], node[keep], val[keep],
+                    tomb[keep])
+                if not len(slots):
+                    # Same two ticks as the withheld-empty path above.
+                    self._wall_clock()
+                    self._canonical_time = Hlc.send(
+                        self._canonical_time,
+                        millis=self._wall_clock())
+                    return None
         k = len(slots)
         my_ord = self._table.ordinal(self._node_id)
         wall = self._wall_clock()
@@ -1445,6 +1675,9 @@ class DenseCrdt:
             # payload-order domination check is well-defined.
             _sanitizer.check_dense_sparse_join(self._store, slots, lt,
                                                node)
+            if self._gc_purged is not None:
+                _sanitizer.check_dense_no_resurrection(
+                    self._store, *self._gc_purged)
 
         if self._hub.active:
             win_full = np.asarray(jax.device_get(win))
@@ -1680,7 +1913,10 @@ class DenseCrdt:
                     and sem_version == crdt._sem_version
                     and tree.n_slots == crdt.n_slots
                     and tree.leaf_width == crdt.DIGEST_LEAF_WIDTH):
-                crdt._digest_cache = ((logical_time, sem_version), tree)
+                # Key under the LIVE generation: the snapshot's counter
+                # is meaningless here, and the guards above prove the
+                # tree matches the state this generation names.
+                crdt._digest_cache = (crdt._digest_key(), tree)
         return crdt
 
     # --- replication (C9/C10) ---
@@ -2023,6 +2259,14 @@ class DenseCrdt:
         cs = parts[0] if len(parts) == 1 else DenseChangeset(
             *(jnp.concatenate([getattr(p, f) for p in parts])
               for f in DenseChangeset._fields))
+        if self._gc_floor_lt and self._gc_fence_dev is not None:
+            # Device-side resurrection fence for wide changesets —
+            # same predicate as the columnar path in _merge_validated
+            # (sub-floor row onto a PURGED slot = replay of purged
+            # state); stays a mask fold, no host sync.
+            cs = cs._replace(valid=cs.valid & ~(
+                (cs.lt <= jnp.int64(self._gc_floor_lt))
+                & self._gc_fence_dev[None, :]))
         pipe = self._pipe
         if pipe is not None and not pipe.exact and self._use_pallas():
             # Coarse pipelined Mosaic merges run as ONE dispatch
@@ -2191,6 +2435,9 @@ class DenseCrdt:
             # zero host syncs per merge, which a host-side assertion
             # would break — sanitize soaks run unpipelined.
             _sanitizer.check_dense_join(self._store, cs_for_exact())
+            if self._gc_purged is not None:
+                _sanitizer.check_dense_no_resurrection(
+                    self._store, *self._gc_purged)
         self.stats.records_adopted += int(win_count)
         self._emit_merge_wins(new_store, res.win)
         self._canonical_time = Hlc.send(
@@ -2393,6 +2640,14 @@ class DenseCrdt:
     #: walk checks geometry) — override in lockstep only.
     DIGEST_LEAF_WIDTH = 8
 
+    def _digest_key(self):
+        """Digest-cache key: clock head + semantics version + store
+        generation. The generation term is what keeps a post-`gc_purge`
+        /`compact` tree distinct — those replace the store WITHOUT
+        advancing the canonical clock (docs/STORAGE.md)."""
+        return (self._canonical_time.logical_time, self._sem_version,
+                self._store_gen)
+
     def _digest_levels(self):
         """Device digest-tree levels (root-first) over the current
         store — overridden by the sharded model to fan per-shard
@@ -2423,7 +2678,7 @@ class DenseCrdt:
         # Drain BEFORE the key reads the canonical clock — same
         # aliasing hazard as pack_since.
         self.drain_ingest()
-        key = (self._canonical_time.logical_time, self._sem_version)
+        key = self._digest_key()
         counter = default_registry().counter(
             "crdt_tpu_digest_cache_total",
             "digest_tree cache lookups by outcome")
@@ -2525,7 +2780,7 @@ class DenseCrdt:
         self.drain_ingest()
         key = (None if since is None else since.logical_time,
                self._canonical_time.logical_time,
-               self._sem_version, resolved, ranges)
+               self._sem_version, self._store_gen, resolved, ranges)
         counter = default_registry().counter(
             "crdt_tpu_pack_cache_total",
             "pack_since cache lookups by outcome")
@@ -2589,7 +2844,7 @@ class DenseCrdt:
             "dispatch").inc(node=str(self._node_id))
         key = (None if since is None else since.logical_time,
                self._canonical_time.logical_time,
-               self._sem_version, resolved, None)
+               self._sem_version, self._store_gen, resolved, None)
         mask, lt, node, val, tomb = jax.device_get(
             (mask, self._store.lt, self._store.node,
              self._store.val, self._store.tomb))
@@ -2872,6 +3127,52 @@ class ShardedDenseCrdt(DenseCrdt):
         super().purge()
         self._store = self._shard(self._store)
 
+    def compact(self, ranges=None) -> np.ndarray:
+        """Per-shard compaction inside ONE `shard_map`
+        (`parallel.make_sharded_compact`): each device packs its own
+        key shard to its local prefix, so the remap never crosses
+        shard boundaries and the output is born on the key-axis
+        sharding. Restricted ``ranges`` (or leaf-straddling shard
+        geometry) fall back to the base single-program kernel, which
+        is correct but may move rows across shards before
+        `_postprocess_store` re-pins the layout."""
+        from ..parallel import KEY_AXIS, make_sharded_compact
+        k = self._mesh.shape[KEY_AXIS]
+        if (ranges is not None or self.n_slots % k
+                or (self.n_slots // k) % self.DIGEST_LEAF_WIDTH):
+            return super().compact(ranges)
+        self._refuse_in_pipeline("compact")
+        self.drain_ingest()
+        from ..ops.digest import build_digest_tree
+        has_sem = self._sem is not None
+        fn = make_sharded_compact(self._mesh, self.DIGEST_LEAF_WIDTH,
+                                  has_sem, self._donate_writes())
+        out = fn(self._store,
+                 *((self._sem_device(),) if has_sem else ()))
+        if has_sem:
+            new_store, new_sem, translation, levels = out
+        else:
+            new_store, translation, levels = out
+            new_sem = None
+        translation = np.asarray(jax.device_get(translation))
+        self._store = self._postprocess_store(new_store)
+        self._store_escaped = False
+        if new_sem is not None:
+            sem_h = np.asarray(jax.device_get(new_sem)).astype(np.int8)
+            self._sem = sem_h if sem_h.any() else None
+            self._sem_dev = None
+            self._sem_version += 1
+        self._gc_purged = None
+        self._gc_fence_dev = None
+        tree = build_digest_tree(self.n_slots, self.DIGEST_LEAF_WIDTH,
+                                 levels)
+        self._digest_cache = (self._digest_key(), tree)
+        from ..obs.registry import default_registry
+        default_registry().counter(
+            "crdt_tpu_compact_passes_total",
+            "compact_remap dispatches").inc(node=str(self._node_id))
+        return translation
+
     def grow(self, n_slots: int) -> None:
         from ..parallel import KEY_AXIS
         k = self._mesh.shape[KEY_AXIS]
@@ -2895,6 +3196,11 @@ class ShardedDenseCrdt(DenseCrdt):
             self.drain_ingest()
             self._store = DenseStore(
                 *(jnp.asarray(np.asarray(lane)) for lane in self._store))
+            if self._gc_fence_dev is not None:
+                # Same off-mesh pull as the store lanes: the base
+                # grow's concat must not run on a key-sharded mask.
+                self._gc_fence_dev = jnp.asarray(
+                    np.asarray(self._gc_fence_dev))
         super().grow(n_slots)
         self._store = self._shard(self._store)
 

@@ -28,8 +28,13 @@ Read-your-writes: ``get``/``count_modified_since``/``contains_slot``/
 ``is_deleted`` consult the staging overlay before the device store.
 Every other read/merge/pack/serialization path is a BARRIER that
 drains the combiner first (`DenseCrdt.drain_ingest`), so nothing
-outside the window can observe a store missing staged writes. See
-docs/INGEST.md for the lifecycle and visibility rules.
+outside the window can observe a store missing staged writes. The
+storage-plane passes are barriers too: `DenseCrdt.gc_purge` drains
+before purging (a staged delete must land before its stamp is judged
+against the floor) and `DenseCrdt.compact` drains before remapping
+(staged rows address PRE-remap slots; committing them after the
+translation would scatter into the wrong rows — docs/STORAGE.md).
+See docs/INGEST.md for the lifecycle and visibility rules.
 """
 
 from __future__ import annotations

@@ -252,3 +252,50 @@ class KeyedDenseCrdt(Crdt[K, int]):
 
     def purge(self) -> None:
         self._dense.purge()
+
+    # --- tombstone GC + compaction (docs/STORAGE.md) ---
+
+    def gc_purge(self, stability: Hlc, *,
+                 drift_slack_ms: Optional[int] = None) -> int:
+        """Epoch tombstone GC passthrough (`DenseCrdt.gc_purge`) —
+        ``stability`` must be a fleet stability watermark. Purged
+        keys keep their interned slots until the next `compact`, so
+        re-putting a purged key reuses its slot."""
+        return self._dense.gc_purge(stability,
+                                    drift_slack_ms=drift_slack_ms)
+
+    def compact(self, ranges=None) -> int:
+        """Compact the wrapped store (`DenseCrdt.compact`) and rewrite
+        the key→slot intern maps from the returned translation. Keys
+        whose slots were reclaimed (purged or never committed) drop
+        from the maps and re-intern on next use — the intern cursor
+        falls back to the live count, so churned capacity is actually
+        REUSED: a steady live-set workload stays at constant capacity
+        instead of doubling through `grow` (docs/STORAGE.md). This
+        adapter owns the whole slot space, so the full remap is safe
+        (the raw-slot caveat in the class docstring applies: un-
+        interned raw-slot rows move like any others). A semantics tag
+        assigned to a key that was never written rides out with its
+        empty slot — re-assert `set_semantics` after compacting such
+        keys. Returns the number of live keys retained."""
+        translation = self._dense.compact(ranges)
+        pairs = sorted(
+            (int(translation[slot]), key)
+            for slot, key in enumerate(self._slot_keys)
+            if translation[slot] >= 0)
+        slot_keys: List[Any] = []
+        key_to_slot: Dict[K, int] = {}
+        for new_slot, key in pairs:
+            while len(slot_keys) < new_slot:
+                # A surviving raw-slot row (written through `.dense`,
+                # never interned) landed between interned keys; hold
+                # its position with the slot index — the same key
+                # convention record_map/watch use for raw rows — so
+                # the intern cursor can never hand out an occupied
+                # slot.
+                slot_keys.append(len(slot_keys))
+            slot_keys.append(key)
+            key_to_slot[key] = new_slot
+        self._slot_keys = slot_keys
+        self._key_to_slot = key_to_slot
+        return len(pairs)

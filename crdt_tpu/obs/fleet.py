@@ -529,6 +529,41 @@ def format_partitions(snapshots: Dict[str, dict]) -> str:
     return "\n".join(_table(headers, rows)) + "\n"
 
 
+def format_storage(snapshots: Dict[str, dict]) -> str:
+    """Human-readable storage-plane table: per-instance stability
+    watermark (``PINNED`` when any peer is unmeasured — tombstone GC
+    is parked, docs/STORAGE.md), the last purge floor, and the
+    live/tombstone split of shipped transfer bytes (migrate + rejoin
+    surfaces summed). The split is the payoff metric: post-GC donors
+    should ship ~zero tombstone bytes. Empty string when no snapshot
+    carries storage-plane data. Pure."""
+    rows = []
+    for name, snap in sorted(snapshots.items()):
+        if not isinstance(snap, dict):
+            continue
+        st = snap.get("stability")
+        ctrs = snap.get("counters", {})
+        live = sum(s["value"] for s in ctrs.get(
+            "crdt_tpu_shipped_live_bytes_total", []))
+        tomb = sum(s["value"] for s in ctrs.get(
+            "crdt_tpu_shipped_tombstone_bytes_total", []))
+        if not isinstance(st, dict) and not live and not tomb:
+            continue
+        if isinstance(st, dict):
+            mark = ("PINNED" if st.get("pinned")
+                    else str(st.get("stability_hlc") or "-"))
+            floor = str(st.get("gc_floor") or "-")
+        else:
+            mark, floor = "-", "-"
+        rows.append([name, mark, floor,
+                     str(int(live)), str(int(tomb))])
+    if not rows:
+        return ""
+    headers = ["instance", "stability", "gc_floor",
+               "shipped_live_B", "shipped_tomb_B"]
+    return "\n".join(_table(headers, rows)) + "\n"
+
+
 def format_matrix(matrix: Dict[str, Any]) -> str:
     """Human-readable (origin × observer) lag table, seconds."""
     if not matrix["origins"]:
@@ -591,6 +626,7 @@ def fleet_main(argv: Optional[List[str]] = None, out=None) -> int:
             out.write(format_matrix(matrix))
             out.write(format_replicas(verdict["replication"]))
             out.write(format_partitions(snapshots))
+            out.write(format_storage(snapshots))
             out.write(f"slo ok={verdict['ok']} "
                       f"{json.dumps(verdict['checks'])}\n")
         out.flush()

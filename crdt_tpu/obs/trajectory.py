@@ -82,6 +82,12 @@ _SKIP_TOKENS = ("budget", "_n", "n_", "rounds", "repeats", "bytes",
                 "rows", "slots", "count", "size", "width", "port",
                 "seed", "chunk", "depth", "within", "ok", "vs_baseline",
                 "overhead_frac", "ceiling")
+# Checked BEFORE the skip list: byte/size metrics that ARE the thing
+# being optimized (churn-soak steady-state footprint, docs/STORAGE.md)
+# rather than configuration echoes. "bytes" alone stays skipped — only
+# these explicit steady-state shapes gate.
+_LOWER_OVERRIDES = ("bytes_hwm", "bytes_per_live_row", "bytes_steady",
+                    "tombstone_bytes_shipped")
 
 
 def metric_direction(name: str) -> Optional[str]:
@@ -90,6 +96,9 @@ def metric_direction(name: str) -> Optional[str]:
     deliberately conservative: an unclassifiable metric is recorded in
     the trajectory but never gated on."""
     leaf = name.rsplit(".", 1)[-1].lower()
+    for tok in _LOWER_OVERRIDES:
+        if tok in leaf:
+            return "lower"
     for tok in _SKIP_TOKENS:
         if tok in leaf:
             return None

@@ -65,7 +65,7 @@ _obs_device.register(
     "dense.delta_mask", "dense.range_delta_mask",
     "dense.max_logical_time", "dense.put_scatter",
     "dense.record_scatter", "dense.delete_scatter",
-    "dense.ingest_scatter")
+    "dense.ingest_scatter", "dense.gc_purge", "dense.compact_remap")
 
 
 class DenseStore(NamedTuple):
@@ -698,3 +698,140 @@ def ingest_scatter(store: DenseStore, slots, lt, val, tomb, me,
                             donated=store.lt if donate else None):
         return _ingest_scatter(donate, sharding)(store, slots, lt, val,
                                                  tomb, me)
+
+
+# --- tombstone epoch GC + online compaction (docs/STORAGE.md) ---
+#
+# Dense slots never reclaim on their own: a tombstone is lattice state
+# (the delete must dominate concurrent writes), so it can only leave
+# the store once the fleet stability watermark proves every peer's
+# durable state already dominates it. `gc_purge` masks those stable
+# tombstones out of every lane in one dispatch; `compact_remap` then
+# spends the reclaimed slots, packing survivors to a dense prefix and
+# rebuilding the digest tree in the same program. Both follow the
+# (donate, sharding) factory idiom of the merge kernels above.
+
+
+@_functools.lru_cache(maxsize=None)
+def _gc_purge_jit(donate: bool, sharding=None):
+    def step(store: DenseStore, floor_lt):
+        purged = store.occupied & store.tomb & (store.lt <= floor_lt)
+        keep = ~purged
+        z64 = jnp.int64(0)
+        z32 = jnp.int32(0)
+        out = DenseStore(
+            lt=jnp.where(keep, store.lt, z64),
+            node=jnp.where(keep, store.node, z32),
+            val=jnp.where(keep, store.val, z64),
+            mod_lt=jnp.where(keep, store.mod_lt, z64),
+            mod_node=jnp.where(keep, store.mod_node, z32),
+            occupied=store.occupied & keep,
+            tomb=store.tomb & keep,
+        )
+        if sharding is not None:
+            out = jax.lax.with_sharding_constraint(out, sharding)
+        return out, jnp.sum(purged).astype(jnp.int32), purged
+    return jax.jit(step, donate_argnums=(0,) if donate else ())
+
+
+def gc_purge(store: DenseStore, floor_lt, *, donate: bool = False,
+             sharding=None) -> Tuple[DenseStore, jax.Array, jax.Array]:
+    """Epoch tombstone purge: zero EVERY lane of tombstones whose
+    record stamp is at or below ``floor_lt`` (inclusive — a durable
+    watermark means delivered THROUGH the stamp) — ONE elementwise
+    dispatch, no gather, no scatter.
+
+    ``floor_lt`` must derive from a fleet stability watermark (every
+    peer's durable watermark past the delete stamp, minus the HLC
+    drift allowance — `GossipNode.stability_hlc`); the crdtlint
+    ``purge-watermark-unfenced`` rule rejects call sites that invent
+    one locally. A purged slot returns to the all-zero never-written
+    state, so the caller must also arm its merge-side resurrection
+    floor (`DenseCrdt.gc_purge`) — the kernel alone cannot stop a
+    delayed pre-purge delta from re-occupying the slot. Returns
+    ``(new_store, purged_count, purged_mask)``; the mask stays on
+    device unless the caller (sanitizer, sem-column owner) fetches
+    it."""
+    with _obs_device.record("dense.gc_purge", dim=store.lt.shape[0],
+                            donated=store.lt if donate else None):
+        return _gc_purge_jit(donate, sharding)(store, floor_lt)
+
+
+@_functools.lru_cache(maxsize=None)
+def _compact_remap_jit(donate: bool, leaf_width: int, has_sem: bool,
+                       sharding=None):
+    # Imported here (not at module top): ops/digest.py imports
+    # DenseStore from this module.
+    from .digest import digest_levels_from_lanes
+
+    def step(store: DenseStore, los, his, *sem):
+        n = store.lt.shape[0]
+        idx = jnp.arange(n, dtype=jnp.int64)
+        # [S, N] span membership; spans are half-open, non-overlapping
+        # (host-validated), padded to a power of two with lo == hi == 0
+        # like dense_range_delta_mask.
+        in_span = ((idx[None, :] >= los[:, None])
+                   & (idx[None, :] < his[:, None]))
+        keep = store.occupied
+        k_in = in_span & keep[None, :]
+        # Survivor rank within each span: running count along the slot
+        # axis. Each slot is in at most one span, so summing the
+        # masked per-span targets recovers its destination.
+        rank = jnp.cumsum(k_in.astype(jnp.int64), axis=1)
+        pos = los[:, None] + rank - 1
+        tgt_in = jnp.sum(jnp.where(k_in, pos, 0), axis=0)
+        moved = jnp.any(in_span, axis=0) & keep
+        new_slot = jnp.where(moved, tgt_in, idx)
+        translation = jnp.where(keep, new_slot, -1).astype(jnp.int32)
+        # mode="drop": dropped rows target the out-of-range sentinel n,
+        # same trick as record_scatter's padding.
+        target = jnp.where(keep, new_slot, n).astype(jnp.int32)
+
+        def scat(lane):
+            return jnp.zeros(lane.shape, lane.dtype).at[target].set(
+                lane, mode="drop")
+
+        out = DenseStore(
+            lt=scat(store.lt), node=scat(store.node),
+            val=scat(store.val), mod_lt=scat(store.mod_lt),
+            mod_node=scat(store.mod_node),
+            occupied=scat(store.occupied), tomb=scat(store.tomb))
+        new_sem = scat(sem[0]) if has_sem else None
+        if sharding is not None:
+            out = jax.lax.with_sharding_constraint(out, sharding)
+        live = jnp.sum(keep.astype(jnp.int32))
+        levels = digest_levels_from_lanes(
+            out.lt, out.val, out.tomb, out.occupied, sem=new_sem,
+            leaf_width=leaf_width)
+        if has_sem:
+            return out, new_sem, translation, live, levels
+        return out, translation, live, levels
+    return jax.jit(step, donate_argnums=(0,) if donate else ())
+
+
+def compact_remap(store: DenseStore, los, his, sem=None, *,
+                  leaf_width: int, donate: bool = False, sharding=None):
+    """Online compaction: remap surviving rows to the dense prefix of
+    their span AND rebuild the digest-tree levels in ONE donated
+    dispatch. ``(los, his)`` are half-open, non-overlapping slot spans
+    (power-of-two padded with empty ``lo == hi == 0`` spans); rows
+    outside every span keep their slot, so per-partition/per-shard
+    compaction is range-preserving by construction. ``sem`` is the
+    optional per-slot semantics tag column, remapped with the rows so
+    typed lanes keep their kernels.
+
+    Returns ``(new_store[, new_sem], translation, live_count,
+    digest_levels)`` — ``translation[old] = new`` (int32, ``-1`` for
+    unoccupied slots) is what the host layers rewrite against:
+    `KeyedDenseCrdt`'s intern map and the routing layer's range arcs.
+    Slot identity is wire identity, so a full-store remap is only
+    externally safe for single-owner stores or when every replica
+    applies the identical translation (docs/STORAGE.md)."""
+    with _obs_device.record("dense.compact_remap",
+                            dim=store.lt.shape[0],
+                            donated=store.lt if donate else None):
+        if sem is not None:
+            return _compact_remap_jit(donate, leaf_width, True,
+                                      sharding)(store, los, his, sem)
+        return _compact_remap_jit(donate, leaf_width, False,
+                                  sharding)(store, los, his)

@@ -148,6 +148,20 @@ def tree_levels_from_leaves(leaves) -> Tuple[jax.Array, ...]:
     return tuple(reversed(levels))
 
 
+def digest_levels_from_lanes(lt, val, tomb, occupied, sem=None,
+                             leaf_width: int = DEFAULT_LEAF_WIDTH,
+                             idx_offset=None) -> Tuple[jax.Array, ...]:
+    """The full traceable reduction — per-slot mix -> leaf fold ->
+    every interior combine — straight from store lanes. This is the
+    composition `_digest_tree_jit` runs standalone AND the piece
+    `ops.dense.compact_remap` fuses after its slot remap, so a
+    compacted store leaves the dispatch with its digest tree already
+    rebuilt (one program, no second dispatch)."""
+    h = slot_digests(lt, val, tomb, occupied, sem=sem,
+                     idx_offset=idx_offset)
+    return tree_levels_from_leaves(fold_leaves(h, leaf_width))
+
+
 @_ft.lru_cache(maxsize=None)
 def _digest_tree_jit(leaf_width: int, has_sem: bool):
     """jit-cached digest reduction: per-slot mix -> leaf fold -> all
@@ -156,9 +170,9 @@ def _digest_tree_jit(leaf_width: int, has_sem: bool):
     donated); the cache key mirrors the other kernel factories."""
 
     def step(lt, val, tomb, occupied, *sem):
-        h = slot_digests(lt, val, tomb, occupied,
-                         sem=sem[0] if has_sem else None)
-        return tree_levels_from_leaves(fold_leaves(h, leaf_width))
+        return digest_levels_from_lanes(
+            lt, val, tomb, occupied, sem=sem[0] if has_sem else None,
+            leaf_width=leaf_width)
 
     return jax.jit(step)
 

@@ -11,7 +11,8 @@ from .fanin import (KEY_AXIS, REPLICA_AXIS, SLICE_AXIS,
                     make_sharded_fanin, make_sharded_ingest,
                     make_sharded_pallas_fanin,
                     replica_extent, shard_changeset,
-                    make_sharded_digest, shard_store,
+                    make_sharded_compact, make_sharded_digest,
+                    shard_store,
                     sharded_delta_mask, sharded_max_logical_time,
                     store_sharding)
 
@@ -23,6 +24,6 @@ __all__ = [
     "make_multislice_fanin_mesh", "make_sharded_fanin",
     "make_sharded_ingest", "make_sharded_pallas_fanin",
     "replica_extent", "shard_changeset", "shard_store",
-    "make_sharded_digest", "sharded_delta_mask",
+    "make_sharded_compact", "make_sharded_digest", "sharded_delta_mask",
     "sharded_max_logical_time", "store_sharding",
 ]

@@ -51,7 +51,8 @@ from ..obs import device as _obs_device
 _obs_device.register(
     "parallel.sharded_fanin", "parallel.sharded_pallas_fanin",
     "parallel.sharded_ingest", "parallel.sharded_digest",
-    "parallel.sharded_delta_mask", "parallel.sharded_max_logical_time")
+    "parallel.sharded_delta_mask", "parallel.sharded_max_logical_time",
+    "parallel.sharded_compact")
 try:                                     # jax >= 0.5 re-exports P
     from jax import P
 except ImportError:                      # pragma: no cover
@@ -539,6 +540,82 @@ def make_sharded_digest(mesh: Mesh, leaf_width: int, has_sem: bool):
         return tree_levels_from_leaves(leaves(store, *sem))
 
     return _record_step("parallel.sharded_digest", jax.jit(step))
+
+
+@functools.lru_cache(maxsize=None)
+def make_sharded_compact(mesh: Mesh, leaf_width: int, has_sem: bool,
+                         donate: bool = False):
+    """Whole-store online compaction over the sharded store, ONE
+    shard_map program (docs/STORAGE.md): every device packs its key
+    shard's surviving rows to the SHARD-LOCAL prefix — the global
+    remap is per-shard and never crosses shard boundaries — emits its
+    rows of the global translation table, and rebuilds its digest
+    leaves against global positions (`idx_offset`, exactly like
+    `make_sharded_digest`); the interior combines fold in the same
+    jitted program. Requires each shard a multiple of ``leaf_width``;
+    `ShardedDenseCrdt.compact` falls back to the base single-program
+    kernel otherwise.
+
+    Returns ``step(store[, sem]) -> (new_store[, new_sem],
+    translation, levels)`` where ``translation[old] = new`` global
+    slot for occupied rows, ``-1`` for empty slots, and ``levels`` are
+    root-first digest-tree levels bit-identical to what
+    `ops.digest.digest_tree_device` would build over the compacted
+    store. ``donate=True`` consumes the store buffers in place."""
+    from ..ops.digest import (fold_leaves, slot_digests,
+                              tree_levels_from_leaves)
+
+    def _local(store: DenseStore, *sem):
+        shard = store.lt.shape[0]
+        if shard % leaf_width:
+            raise ValueError(
+                f"shard width {shard} not a multiple of leaf_width "
+                f"{leaf_width}")
+        idx = jnp.arange(shard, dtype=jnp.int64)
+        off = jax.lax.axis_index(KEY_AXIS).astype(jnp.int64) * shard
+        keep = store.occupied
+        rank = jnp.cumsum(keep.astype(jnp.int64)) - 1
+        new_local = jnp.where(keep, rank, idx)
+        translation = jnp.where(keep, new_local + off,
+                                -1).astype(jnp.int32)
+        # Empty rows scatter to the `shard` sentinel and drop; the
+        # zeros base IS the compacted tail.
+        target = jnp.where(keep, new_local, shard).astype(jnp.int32)
+
+        def scat(lane):
+            return jnp.zeros(lane.shape, lane.dtype).at[target].set(
+                lane, mode="drop")
+
+        out = DenseStore(lt=scat(store.lt), node=scat(store.node),
+                         val=scat(store.val), mod_lt=scat(store.mod_lt),
+                         mod_node=scat(store.mod_node),
+                         occupied=scat(store.occupied),
+                         tomb=scat(store.tomb))
+        new_sem = (scat(sem[0]),) if has_sem else ()
+        offu = (jax.lax.axis_index(KEY_AXIS).astype(jnp.uint64)
+                * jnp.uint64(shard))
+        h = slot_digests(out.lt, out.val, out.tomb, out.occupied,
+                         sem=new_sem[0] if has_sem else None,
+                         idx_offset=offu)
+        return (out,) + new_sem + (translation, fold_leaves(h, leaf_width))
+
+    store_spec = DenseStore(*([P(KEY_AXIS)] * len(DenseStore._fields)))
+    in_specs = ((store_spec, P(KEY_AXIS)) if has_sem
+                else (store_spec,))
+    out_specs = ((store_spec,)
+                 + ((P(KEY_AXIS),) if has_sem else ())
+                 + (P(KEY_AXIS), P(KEY_AXIS)))
+    local = _shard_map(_local, mesh=mesh, in_specs=in_specs,
+                       out_specs=out_specs, check_vma=False)
+
+    def step(store: DenseStore, *sem):
+        parts = local(store, *sem)
+        return parts[:-1] + (tree_levels_from_leaves(parts[-1]),)
+
+    return _record_step(
+        "parallel.sharded_compact",
+        jax.jit(step, donate_argnums=(0,) if donate else ()),
+        donated_store=donate)
 
 
 def sharded_max_logical_time(mesh: Mesh):

@@ -719,6 +719,34 @@ class ReplicaGroup:
 
     # --- rejoin ---
 
+    @staticmethod
+    def _count_rejoin_bytes(crdt) -> None:
+        """Live/tombstone byte split of what a rejoin walk pulled
+        into the fresh store (docs/STORAGE.md): the primary ran GC
+        first, so tombstone_bytes ≈ 0 is the measurable payoff —
+        every tombstone here is one GC could not yet prove stable.
+        Wire row width matches the packed lane layout (slot 4 + lt 8
+        + node 4 + val 8 + tomb 1)."""
+        store = getattr(crdt, "store", None)
+        if store is None or not hasattr(store, "tomb"):
+            return
+        import numpy as np
+        from .obs.registry import default_registry
+        occ = np.asarray(store.occupied)
+        tomb_rows = int((occ & np.asarray(store.tomb)).sum())
+        live_rows = int(occ.sum()) - tomb_rows
+        reg = default_registry()
+        reg.counter(
+            "crdt_tpu_shipped_live_bytes_total",
+            "packed bytes of live rows shipped by migration streams "
+            "and rejoin walks (surface label: migrate|rejoin)").inc(
+                live_rows * 25, surface="rejoin")
+        reg.counter(
+            "crdt_tpu_shipped_tombstone_bytes_total",
+            "packed bytes of tombstone rows shipped by migration "
+            "streams and rejoin walks (surface label: "
+            "migrate|rejoin)").inc(tomb_rows * 25, surface="rejoin")
+
     def rejoin(self, index: int) -> _Member:
         """Restart a down member: FRESH store, merkle catch-up from
         the current primary, then re-enter as a follower in the
@@ -735,6 +763,16 @@ class ReplicaGroup:
             m.generation += 1
             prev_port = 0 if m.tier is None else (m.tier.port or 0)
         crdt = self._make_crdt(m.index, m.generation)
+        # Spend the GC bytes (docs/STORAGE.md): one epoch-GC pass on
+        # the primary BEFORE the catch-up walk, so the rejoining
+        # member pulls live rows only — stable tombstones are purged
+        # instead of shipped. With this member down the durable set
+        # is usually short a mark, which PINS the watermark and
+        # purges nothing: unmeasured is never safe-to-purge, and the
+        # walk simply ships the tombstones too.
+        if primary.tier is not None \
+                and hasattr(primary.tier, "gc_pass"):
+            primary.tier.gc_pass()
         # Catch up BEFORE serving: the walk pulls everything the
         # group committed while this member was dead (and pushes
         # nothing — the store is fresh).
@@ -768,6 +806,7 @@ class ReplicaGroup:
             raise ConnectionError(
                 f"rejoin catch-up from {primary.addr} failed after "
                 f"retries: {last!r}")
+        self._count_rejoin_bytes(crdt)
         with self._lock:
             router = PartitionRouter()
             # Rebind the member's previous listen address: a crashed

@@ -1313,6 +1313,57 @@ class ServeTier:
             snap["partition"] = part
         return snap
 
+    # --- tombstone GC (docs/STORAGE.md) ---
+
+    def stability_hlc(self) -> "Optional[Hlc]":
+        """Partition stability watermark: the min over every
+        follower's durable HLC (the heartbeat/replicate-ack field) and
+        this tier's own head — what this partition has PROVEN
+        replicated. An unreplicated tier is its partition's sole
+        owner, so its own head is the watermark. Any follower without
+        a measured durable head pins the watermark to ``None``
+        (unmeasured ≠ safe-to-purge — the autoscaler's degraded-freeze
+        discipline), as does follower role: a follower cannot prove
+        group-wide delivery, its primary drives GC. Raw watermark —
+        `DenseCrdt.gc_purge` applies the drift slack."""
+        if self.role == "follower":
+            return None
+        rep = self.replicator
+        with self.lock:
+            head = self.crdt.canonical_time
+        if rep is None:
+            return head
+        marks = [head]
+        for st in rep.status().values():
+            d = st.get("durable")
+            if d is None:
+                return None
+            try:
+                marks.append(Hlc.parse(str(d)))
+            except (ValueError, TypeError):
+                return None
+        return min(marks)
+
+    def gc_pass(self, drift_slack_ms: Optional[int] = None) -> int:
+        """One epoch-GC pass under the tier lock: purge tombstones the
+        partition stability watermark has passed. Returns slots purged
+        (0 when the watermark is pinned, the replica has no `gc_purge`
+        surface, or the watermark hasn't advanced — the latter without
+        a dispatch)."""
+        from .obs.registry import default_registry
+        stability = self.stability_hlc()
+        if stability is None:
+            default_registry().counter(
+                "crdt_tpu_gc_pinned_total",
+                "GC passes skipped on a pinned stability watermark"
+            ).inc(surface="serve")
+            return 0
+        with self.lock:
+            if not hasattr(self.crdt, "gc_purge"):
+                return 0
+            return self.crdt.gc_purge(stability,
+                                      drift_slack_ms=drift_slack_ms)
+
     # --- replication surface (docs/REPLICATION.md) ---
 
     def _lease_ms(self) -> Optional[float]:

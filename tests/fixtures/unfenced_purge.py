@@ -1,0 +1,44 @@
+"""Deliberately UNFENCED epoch-GC stub — crdtlint self-test fixture
+for ``purge-watermark-unfenced``. Never imported by production code:
+
+    python -m crdt_tpu.analysis --lint tests/fixtures/unfenced_purge.py
+
+Expected findings: exactly ONE purge-watermark-unfenced, on the
+`reckless_sweep` call below. `fenced_sweep` and `fenced_passthrough`
+consult a stability watermark lexically first and must NOT be
+flagged (docs/STORAGE.md).
+"""
+
+
+class RecklessJanitor:
+    """Purges against the local clock — the exact corruption the rule
+    exists to catch: tombstones other replicas still need get
+    physically deleted, and their deletes later resurrect."""
+
+    def __init__(self, crdt):
+        self.crdt = crdt
+
+    def reckless_sweep(self):
+        # UNFENCED: no stability watermark anywhere in this function;
+        # the local head says nothing about what peers have seen.
+        return self.crdt.gc_purge(self.crdt.canonical_time)
+
+
+class FencedJanitor:
+    """The disciplined shape: fold the fleet watermark, pin on
+    unmeasured peers, purge only what stability proves stable."""
+
+    def __init__(self, crdt, node):
+        self.crdt = crdt
+        self.node = node
+
+    def fenced_sweep(self):
+        stability = self.node.stability_hlc()
+        if stability is None:
+            return 0                       # pinned: purge nothing
+        return self.crdt.gc_purge(stability)
+
+    def fenced_passthrough(self, stability):
+        # Evidence on the call line itself (the adapter shape:
+        # KeyedDenseCrdt.gc_purge forwards its argument).
+        return self.crdt.gc_purge(stability)

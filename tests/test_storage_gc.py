@@ -1,0 +1,608 @@
+"""Tombstone epoch GC + online compaction (docs/STORAGE.md).
+
+Covers the whole storage plane in layers: the kernels (purge masks
+only stable tombstones, compaction is a bit-identical remap), the
+ledger invariants (one dispatch per pass, zero when the watermark
+hasn't advanced), the merge-side resurrection fence (set-based: a
+stale replay onto a PURGED slot drops, a first-time delivery to any
+other slot lands — the migration case), the stability surfaces
+(gossip mesh, serve tier, replica group) with their pinning
+discipline, the shipped-bytes live/tombstone split, and a kill/
+restart GC drill (-m soak) where a short durable set pins the
+watermark until the member rejoins.
+"""
+
+import random
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import jax
+
+from crdt_tpu import DenseCrdt, FederatedClient, GossipNode
+from crdt_tpu.analysis import sanitizer
+from crdt_tpu.federation import FederatedTier
+from crdt_tpu.models.dense_crdt import ShardedDenseCrdt
+from crdt_tpu.models.keyed_dense import KeyedDenseCrdt
+from crdt_tpu.obs.device import default_ledger
+from crdt_tpu.obs.registry import default_registry
+from crdt_tpu.parallel import make_fanin_mesh
+from crdt_tpu.replication import ReplicaGroup
+from crdt_tpu.semantics import all_semantics
+from crdt_tpu.semantics.types import MVREG_MAX, ORSET_UNIVERSE
+from crdt_tpu.testing import FakeClock
+from crdt_tpu.testing_faults import FaultProxy, FaultSchedule
+
+BASE = 1_700_000_000_000
+NO_SLEEP = lambda _s: None          # collapse backoff waits in tests
+
+FAST = dict(flush_interval=0.002, heartbeat_interval=0.02,
+            heartbeat_timeout=0.15, lease_misses=3)
+
+
+def _make(node="n", n_slots=64, start=BASE, **kw):
+    return DenseCrdt(node, n_slots=n_slots,
+                     wall_clock=FakeClock(start=start), **kw)
+
+
+def _delta(before, after):
+    return {k: after.get(k, 0) - before.get(k, 0)
+            for k in set(before) | set(after)
+            if after.get(k, 0) != before.get(k, 0)}
+
+
+def _counter(name, **labels):
+    return default_registry().counter(name).value(**labels)
+
+
+def _wait(pred, timeout=10.0, interval=0.01, what="condition"):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if pred():
+            return
+        time.sleep(interval)
+    raise AssertionError(f"timed out waiting for {what}")
+
+
+# ------------------------------------------------- purge kernel + model
+
+def test_gc_purge_drops_only_stable_tombstones():
+    a = _make("a")
+    a.put_batch([1, 2, 3], [10, 20, 30])
+    a.delete_batch([1, 2])
+    stability = a.canonical_time
+    assert a.gc_purge(stability, drift_slack_ms=0) == 2
+    occ = np.asarray(a._store.occupied)
+    assert not occ[1] and not occ[2]
+    assert a.get(3) == 30
+    assert a.gc_floor > 0
+
+
+def test_gc_purge_floor_is_inclusive():
+    # The delete stamp IS the head: a durable watermark means
+    # "delivered THROUGH the stamp", so floor == stamp must purge.
+    a = _make("a")
+    a.put_batch([7], [70])
+    a.delete_batch([7])
+    stability = a.canonical_time
+    tomb_lt = int(np.asarray(a._store.lt)[7])
+    assert int(stability.logical_time) == tomb_lt
+    assert a.gc_purge(stability, drift_slack_ms=0) == 1
+
+
+def test_gc_purge_respects_drift_slack():
+    a = _make("a")
+    a.put_batch([4], [40])
+    a.delete_batch([4])
+    stability = a.canonical_time
+    # A generous slack puts the floor below the delete stamp: the
+    # tombstone is NOT provably stable yet and must survive.
+    assert a.gc_purge(stability, drift_slack_ms=1 << 20) == 0
+    assert bool(np.asarray(a._store.tomb)[4])
+    with pytest.raises(ValueError):
+        a.gc_purge(stability, drift_slack_ms=-1)
+
+
+def test_gc_pass_ledger_invariants():
+    led = default_ledger()
+    a = _make("a")
+    a.put_batch(list(range(8)), list(range(8)))
+    a.delete_batch([0, 1])
+    stability = a.canonical_time
+
+    before = led.as_dict()
+    assert a.gc_purge(stability, drift_slack_ms=0) == 2
+    moved = _delta(before, led.as_dict())
+    assert moved.get("dense.gc_purge") == 1
+
+    # Unadvanced watermark: zero purged, ZERO dispatches.
+    before = led.as_dict()
+    assert a.gc_purge(stability, drift_slack_ms=0) == 0
+    assert _delta(before, led.as_dict()) == {}
+
+    # One compaction pass is exactly one remap dispatch.
+    before = led.as_dict()
+    tr = a.compact()
+    moved = _delta(before, led.as_dict())
+    assert moved.get("dense.compact_remap") == 1
+    assert int(tr[5]) >= 0
+
+
+def test_purged_counter_and_passes_counter_move():
+    purged0 = _counter("crdt_tpu_gc_purged_slots_total", node="ctr")
+    passes0 = _counter("crdt_tpu_gc_passes_total", node="ctr")
+    a = _make("ctr")
+    a.put_batch([1, 2], [1, 2])
+    a.delete_batch([1, 2])
+    stability = a.canonical_time
+    assert a.gc_purge(stability, drift_slack_ms=0) == 2
+    assert _counter("crdt_tpu_gc_purged_slots_total",
+                    node="ctr") == purged0 + 2
+    assert _counter("crdt_tpu_gc_passes_total",
+                    node="ctr") == passes0 + 1
+
+
+# ------------------------------------------------- the resurrection fence
+
+def _typed_payload(spec, slot):
+    if spec.name == "lww":
+        return slot % 1000
+    if spec.name == "pncounter":
+        return spec.encode(slot - 32)
+    if spec.name == "orset":
+        return spec.encode({slot % ORSET_UNIVERSE})
+    if spec.name == "mvreg":
+        return spec.encode(1 + slot % MVREG_MAX)
+    return spec.encode(slot % 1000)
+
+
+# Deterministic slot-residue per typed semantics (str hash is salted
+# per process and two names can collide on the same residue).
+_LANE_RESIDUE = {name: i for i, name in enumerate(
+    spec.name for spec in all_semantics() if spec.name != "lww")}
+
+
+@pytest.mark.parametrize("spec", all_semantics(),
+                         ids=lambda s: s.name)
+def test_stale_replay_cannot_resurrect_purged_slot(spec):
+    """The adversarial shape for every registered semantics: a
+    pre-delete delta held back (delayed merge) and replayed AFTER the
+    tombstone was purged must be dropped by the fence."""
+    w = _make("w")
+    r = _make("r", start=BASE + 1_000_000)   # r's stamps dominate w's
+    if spec.name != "lww":
+        w.set_semantics([5], spec.name)
+        r.set_semantics([5], spec.name)
+    w.put_batch([5], [_typed_payload(spec, 5)])
+    stale_pk, stale_ids = w.pack_since(None, sem_mode="include")
+
+    r.merge_packed(stale_pk, stale_ids)
+    assert bool(np.asarray(r._store.occupied)[5])
+    r.delete_batch([5])
+    stability = r.canonical_time
+    assert r.gc_purge(stability, drift_slack_ms=0) == 1
+
+    if spec.name != "lww":
+        # Purged typed slots revert to the LWW default tag; without
+        # re-asserting, a stale typed replay is REJECTED by the tag
+        # validator before the fence even sees it — also safe.
+        with pytest.raises(ValueError, match="semantics tag mismatch"):
+            r.merge_packed(stale_pk, stale_ids)
+        r.set_semantics([5], spec.name)
+    fenced0 = _counter("crdt_tpu_gc_fenced_rows_total", node="r")
+    r.merge_packed(stale_pk, stale_ids)     # the delayed replay
+    assert not bool(np.asarray(r._store.occupied)[5]), \
+        f"{spec.name}: purged slot resurrected by a stale replay"
+    assert _counter("crdt_tpu_gc_fenced_rows_total", node="r") > fenced0
+
+
+def test_fence_is_set_based_first_time_deliveries_land():
+    """The migration regression: sub-floor rows to slots this replica
+    NEVER purged are new information (merge_cold streams, initial
+    syncs) and must land; only the purged set is fenced."""
+    dst = _make("d", start=BASE + 1_000_000)
+    dst.put_batch([1], [11])
+    dst.delete_batch([1])
+    stability = dst.canonical_time
+    assert dst.gc_purge(stability, drift_slack_ms=0) == 1
+
+    src = _make("s")                        # strictly older stamps
+    src.put_batch([40], [77])
+    src.put_batch([1], [99])
+    pk, ids = src.pack_since(None)
+    dst.merge_packed(pk, ids)
+    assert dst.get(40) == 77                # first delivery survives
+    assert dst.get(1) is None               # replay onto purged slot
+
+
+def test_sanitizer_post_purge_resurrection_check(monkeypatch):
+    monkeypatch.setenv("CRDT_TPU_SANITIZE", "1")
+    a = _make("sanz")
+    a.put_batch([3], [33])
+    a.delete_batch([3])
+    stability = a.canonical_time
+    assert a.gc_purge(stability, drift_slack_ms=0) == 1
+    purged_slots, floor = a._gc_purged
+    assert list(purged_slots) == [3]
+    # A clean store passes; a store where the purged slot re-occupied
+    # below the floor is the violation the check exists for.
+    sanitizer.check_dense_no_resurrection(a._store, purged_slots, floor)
+    bad = a._store._replace(
+        occupied=a._store.occupied.at[3].set(True),
+        lt=a._store.lt.at[3].set(floor - 1))
+    with pytest.raises(sanitizer.LatticeViolation):
+        sanitizer.check_dense_no_resurrection(bad, purged_slots, floor)
+    # Compaction remaps slot identity and retires the record.
+    a.compact()
+    assert a._gc_purged is None
+
+
+# ------------------------------------------------- compaction bit-identity
+
+def test_compaction_is_a_bit_identical_remap():
+    """Oracle: compaction must be EXACTLY a permutation of the live
+    rows — same lanes at remapped slots, same digest root, same pack
+    bytes, same typed reads as a reference store permuted on host."""
+    import copy
+
+    a = _make("cmp")
+    slots = list(range(0, 48))
+    a.put_batch(slots, [1000 + s for s in slots])
+    for spec in all_semantics():
+        if spec.name == "lww":
+            continue
+        lane = [s for s in slots
+                if s % 5 == _LANE_RESIDUE[spec.name]]
+        if lane:
+            a.set_semantics(lane, spec.name)
+            a.put_batch(lane, [_typed_payload(spec, s) for s in lane])
+    a.delete_batch([s for s in slots if s % 4 == 0])
+    stability = a.canonical_time
+    assert a.gc_purge(stability, drift_slack_ms=0) > 0
+
+    pre = jax.device_get(a._store)
+    pre_sem = None if a._sem is None else a._sem.copy()
+    ref = copy.deepcopy(a)
+    tr = np.asarray(a.compact())
+
+    n = a.n_slots
+    perm = {k: np.zeros(n, np.asarray(getattr(pre, k)).dtype)
+            for k in pre._fields}
+    sem = None if pre_sem is None else np.zeros(n, pre_sem.dtype)
+    for s in range(n):
+        if tr[s] >= 0:
+            for k in pre._fields:
+                perm[k][tr[s]] = np.asarray(getattr(pre, k))[s]
+            if sem is not None:
+                sem[tr[s]] = pre_sem[s]
+
+    # Lane-level identity on the replicated lanes.
+    for k in ("lt", "node", "val", "occupied", "tomb"):
+        np.testing.assert_array_equal(
+            np.asarray(getattr(a._store, k)), perm[k], err_msg=k)
+
+    # Digest root + pack bytes against the host-permuted reference.
+    import jax.numpy as jnp
+    ref._store = type(pre)(*(jnp.asarray(perm[k])
+                             for k in pre._fields))
+    ref._sem = sem if sem is not None and sem.any() else None
+    ref._sem_dev = None
+    ref._sem_version += 1
+    assert int(a.digest_tree().root) == int(ref.digest_tree().root)
+    pka, idsa = a.pack_since(None, sem_mode="include")
+    pkr, idsr = ref.pack_since(None, sem_mode="include")
+    assert idsa == idsr
+    for lane_a, lane_r in zip(pka, pkr):
+        if lane_a is None or lane_r is None:
+            assert lane_a is lane_r
+        else:
+            np.testing.assert_array_equal(lane_a, lane_r)
+
+    # Typed reads through the translation.
+    for spec in all_semantics():
+        if spec.name not in _LANE_RESIDUE:
+            continue
+        lane = [s for s in slots
+                if s % 5 == _LANE_RESIDUE[spec.name] and s % 4 != 0]
+        for s in lane:
+            new = int(tr[s])
+            assert new >= 0
+            if spec.name == "pncounter":
+                assert a.counter_value(new) == s - 32
+            elif spec.name == "orset":
+                assert a.orset_members(new) == \
+                    frozenset({s % ORSET_UNIVERSE})
+            elif spec.name == "mvreg":
+                assert a.mvreg_get(new) == (1 + s % MVREG_MAX,)
+
+
+def test_keyed_churn_stays_at_constant_capacity():
+    """The bench's flatness claim as a unit test: a steady live set
+    churned through unique keys holds capacity, store bytes and
+    digest depth flat once GC + compaction run each cycle."""
+    kc = KeyedDenseCrdt(_make("churn", n_slots=128))
+    live = 64
+    prev, shapes = [], []
+    for cycle in range(4):
+        keys = [f"c{cycle}:{i}" for i in range(live)]
+        kc.put_all({k: i for i, k in enumerate(keys)})
+        for k in prev:
+            kc.delete(k)
+        stability = kc.canonical_time
+        purged = kc.gc_purge(stability, drift_slack_ms=0)
+        assert purged == (live if cycle else 0)
+        assert kc.compact() == live
+        shapes.append((kc.dense.n_slots,
+                       sum(ln.nbytes for ln in kc.dense._store),
+                       kc.digest_tree().depth))
+        prev = keys
+    assert len(set(shapes)) == 1, shapes
+    assert all(kc.get(k) == i for i, k in enumerate(prev))
+
+
+@pytest.mark.skipif(len(jax.devices()) < 8,
+                    reason="needs 8 (virtual) devices")
+def test_sharded_gc_and_compact_match_plain():
+    mesh = make_fanin_mesh(2, 4)
+    sh = ShardedDenseCrdt("ns", 64, mesh,
+                          wall_clock=FakeClock(start=BASE))
+    pl = _make("ns")
+    for c in (sh, pl):
+        c.put_batch([1, 9, 17, 33], [10, 90, 170, 330])
+        c.delete_batch([9, 33])
+        stability = c.canonical_time
+        assert c.gc_purge(stability, drift_slack_ms=0) == 2
+    np.testing.assert_array_equal(np.asarray(sh.store.occupied),
+                                  np.asarray(pl.store.occupied))
+    # Sharded compaction is range-preserving per key shard (each
+    # shard's rows settle to ITS dense prefix inside one shard_map),
+    # so translations differ from the plain full-store remap — but
+    # every live row must survive with identical lanes, inside its
+    # own shard's span.
+    tr_sh, tr_pl = np.asarray(sh.compact()), np.asarray(pl.compact())
+    span = 64 // mesh.shape["key"]
+    for old, val in ((1, 10), (17, 170)):
+        new_sh, new_pl = int(tr_sh[old]), int(tr_pl[old])
+        assert new_sh >= 0 and new_sh // span == old // span
+        assert sh.get(new_sh) == pl.get(new_pl) == val
+    assert int(np.asarray(sh.store.occupied).sum()) == \
+        int(np.asarray(pl.store.occupied).sum()) == 2
+
+
+# ------------------------------------------------- stability surfaces
+
+def _node(crdt, **kw):
+    kw.setdefault("rng", random.Random(7))
+    kw.setdefault("sleep", NO_SLEEP)
+    return GossipNode(crdt, **kw)
+
+
+def test_gossip_stability_pins_until_measured_then_purges():
+    clk = FakeClock()
+    a = _node(DenseCrdt("a", 64, wall_clock=clk))
+    b = _node(DenseCrdt("b", 64, wall_clock=clk))
+    with a, b:
+        a.add_peer("b", b.host, b.port)
+        b.add_peer("a", a.host, a.port)
+        # Unmeasured peer: watermark None pins the fleet stability.
+        assert b.stability_hlc() is None
+        pinned0 = _counter("crdt_tpu_gc_pinned_total",
+                           surface="gossip")
+        assert b.gc_pass(drift_slack_ms=0) == 0
+        assert _counter("crdt_tpu_gc_pinned_total",
+                        surface="gossip") == pinned0 + 1
+
+        a.crdt.put_batch([3], [30])
+        assert a.run_round() == {"b": "ok"}
+        assert b.run_round() == {"a": "ok"}
+        b.crdt.delete_batch([3])
+        assert a.run_round() == {"b": "ok"}   # a pulls the delete
+        assert b.run_round() == {"a": "ok"}   # b's watermark advances
+        stability = b.stability_hlc()
+        assert stability is not None
+        assert b.gc_pass(drift_slack_ms=0) == 1
+        assert not bool(np.asarray(b.crdt._store.occupied)[3])
+        # The metrics extra carries the stability section.
+        extra = b._metrics_extra()
+        assert extra["stability"]["pinned"] is False
+        assert extra["stability"]["gc_floor"] > 0
+
+
+def test_solo_gossip_node_stability_is_own_head():
+    n = _node(_make("solo"))
+    with n:
+        n.crdt.put_batch([2], [20])
+        n.crdt.delete_batch([2])
+        stability = n.stability_hlc()
+        assert stability == n.crdt.canonical_time
+        assert n.gc_pass(drift_slack_ms=0) == 1
+
+
+def test_replica_group_stability_and_rejoin_byte_split():
+    with ReplicaGroup(128, replicas=3, ack_replicas=2,
+                      **FAST) as group:
+        cli = FederatedClient(group.member_addrs(), timeout=5.0)
+        try:
+            for s in range(0, 40, 2):
+                cli.put(s, 100 + s)
+            for s in range(0, 40, 4):
+                cli.delete(s)
+        finally:
+            cli.close()
+        tier = group.primary.tier
+        _wait(lambda: tier.stability_hlc() is not None,
+              what="all follower durable heads")
+        _wait(lambda: tier.gc_pass(drift_slack_ms=0) > 0,
+              what="stability watermark past the delete stamps")
+        # Post-GC rejoin ships LIVE rows only: the byte split proves
+        # the retired tombstones never hit the wire.
+        live0 = _counter("crdt_tpu_shipped_live_bytes_total",
+                         surface="rejoin")
+        tomb0 = _counter("crdt_tpu_shipped_tombstone_bytes_total",
+                         surface="rejoin")
+        victim = 1 if group.primary.index != 1 else 2
+        group.kill(victim)
+        group.rejoin(victim)
+        assert _counter("crdt_tpu_shipped_live_bytes_total",
+                        surface="rejoin") > live0
+        assert _counter("crdt_tpu_shipped_tombstone_bytes_total",
+                        surface="rejoin") == tomb0
+
+
+def test_merge_cold_after_recipient_gc_ships_and_survives():
+    """Integration regression for the set-based fence: a recipient
+    that ran GC (fence armed, floor > 0) must still absorb every
+    migrated row from the donor — including rows stamped below its
+    floor, which it sees for the first time."""
+    with FederatedTier(256, partitions=2,
+                       flush_interval=0.002) as fed:
+        cli = FederatedClient(fed.addrs())
+        try:
+            for slot in range(0, 256, 5):
+                cli.put(slot, slot + 7)
+            # A deleted slot on each side arms fences everywhere.
+            cli.delete(0)
+            cli.delete(255)
+        finally:
+            cli.close()
+        live0 = _counter("crdt_tpu_shipped_live_bytes_total",
+                         surface="migrate")
+        tomb0 = _counter("crdt_tpu_shipped_tombstone_bytes_total",
+                         surface="migrate")
+        for tier in fed.tiers:
+            tier.gc_pass(drift_slack_ms=0)
+        stats = fed.merge_cold()
+        assert stats["gc_purged"] >= 0       # donor pass ran
+        cli = FederatedClient(fed.addrs())
+        try:
+            for slot in range(5, 255, 5):
+                assert cli.get(slot) == slot + 7
+            assert cli.get(0) is None and cli.get(255) is None
+        finally:
+            cli.close()
+        # Post-GC donor: live bytes moved, ~zero tombstone bytes.
+        assert _counter("crdt_tpu_shipped_live_bytes_total",
+                        surface="migrate") > live0
+        assert _counter("crdt_tpu_shipped_tombstone_bytes_total",
+                        surface="migrate") == tomb0
+
+
+def test_purge_races_delayed_transport_without_resurrection():
+    """FaultProxy-delayed rounds racing concurrent GC passes: every
+    pull from the writer crosses a delaying proxy while the receiver
+    purges on a timer — convergence must hold and nothing purged may
+    resurrect (the fence drops the late frames' stale rows)."""
+    clk = FakeClock()
+    a = _node(DenseCrdt("a", 64, wall_clock=clk))
+    b = _node(DenseCrdt("b", 64, wall_clock=clk))
+    schedule = FaultSchedule(seed=11, rate=1.0,
+                             kinds={"delay": 1}, max_delay=0.02)
+    with a, b, FaultProxy(a.host, a.port, schedule) as proxy:
+        b.add_peer("a", proxy.host, proxy.port)
+        a.add_peer("b", b.host, b.port)
+        stop = threading.Event()
+        purged_total = [0]
+
+        def reaper():
+            while not stop.is_set():
+                purged_total[0] += b.gc_pass(drift_slack_ms=0)
+                time.sleep(0.002)
+
+        t = threading.Thread(target=reaper, daemon=True)
+        t.start()
+        try:
+            for i in range(12):
+                a.crdt.put_batch([i], [100 + i])
+                if i % 3 == 0:
+                    b.crdt.delete_batch([max(0, i - 1)])
+                a.run_round()
+                b.run_round()
+        finally:
+            stop.set()
+            t.join(timeout=5)
+        # Settle: both directions clean.
+        a.run_round()
+        b.run_round()
+        occ = np.asarray(b.crdt.store.occupied)
+        tomb = np.asarray(b.crdt.store.tomb)
+        if purged_total[0]:
+            # Purged slots stayed dead or were re-written ABOVE the
+            # floor — never silently resurrected below it.
+            floor = b.crdt.gc_floor
+            lt = np.asarray(b.crdt.store.lt)
+            revived = occ & (lt <= floor) & tomb
+            assert not bool(revived.any())
+        assert proxy.counters.get("delay", 0) > 0
+
+
+# ------------------------------------------------- the kill/restart drill
+
+@pytest.mark.soak
+def test_gc_drill_kill_pins_watermark_until_rejoin():
+    """The -m soak GC drill. Two distinct pin regimes, both real:
+
+    1. Kill a follower with the health monitor deliberately slow
+       (lease_misses high): the dead member stays in the primary's
+       write-concern set with its durable mark FROZEN below every
+       post-kill stamp, so repeated passes purge nothing. (With a
+       fast monitor the member is dropped from the set and GC
+       legitimately proceeds with the live quorum — replication.py
+       `_drop_follower` — which is why this drill pins detection.)
+    2. `rejoin` re-adds the member with durable=None — unmeasured
+       pins — until the first post-rejoin barrier records an ack;
+       then the watermark frees and the purge fires, with zero
+       acked rows lost."""
+    slow = dict(FAST, heartbeat_interval=0.25,
+                heartbeat_timeout=0.5, lease_misses=200)
+    with ReplicaGroup(128, replicas=3, ack_replicas=1,
+                      **slow) as group:
+        cli = FederatedClient(group.member_addrs(), timeout=5.0)
+        try:
+            for s in range(0, 60, 2):
+                cli.put(s, 500 + s)
+            for s in range(0, 20, 2):
+                cli.delete(s)
+            tier = group.primary.tier
+            # Drain ALL pre-kill tombstones before the kill so the
+            # pinned-window assertion below starts from zero debt.
+            drained = [0]
+
+            def _drain():
+                drained[0] += tier.gc_pass(drift_slack_ms=0)
+                return drained[0] >= 10
+            _wait(_drain, what="pre-kill purge", timeout=10.0)
+            assert drained[0] == 10
+
+            victim = 1 if group.primary.index != 1 else 2
+            group.kill(victim)
+            for s in range(20, 40, 2):      # post-kill tombstones
+                cli.delete(s)
+            # The dead member's durable head is frozen below the new
+            # stamps: repeated passes purge NOTHING.
+            deadline = time.monotonic() + 0.6
+            while time.monotonic() < deadline:
+                assert tier.gc_pass(drift_slack_ms=0) == 0
+                time.sleep(0.03)
+
+            group.rejoin(victim)
+            # Resume traffic: the rejoined member re-enters with
+            # durable=None (unmeasured pins), and barriers only run
+            # when a flush tick has rows to ship — one write kicks
+            # the full-pack barrier that records its first ack.
+            cli.put(100, 777)
+            freed = [0]
+
+            def _freed():
+                freed[0] += group.primary.tier.gc_pass(
+                    drift_slack_ms=0)
+                return freed[0] >= 10
+            _wait(_freed, what="post-rejoin purge", timeout=15.0)
+            assert freed[0] == 10
+            for s in range(40, 60, 2):      # acked live rows survive
+                assert cli.get(s) == 500 + s
+            for s in range(20, 40, 2):
+                assert cli.get(s) is None
+        finally:
+            cli.close()
